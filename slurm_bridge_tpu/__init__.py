@@ -1,0 +1,27 @@
+"""slurm_bridge_tpu — a TPU-native Kubernetes↔Slurm bridge framework.
+
+A ground-up rebuild of the capability set of chriskery/slurm-bridge-operator
+(reference layer map: SURVEY.md §1) with the placement path re-founded on
+JAX/XLA:
+
+- ``core``         typed job/partition/node model + Slurm dialect parsers
+                   (reference: apis/kubecluster.org/v1alpha1, pkg/slurm-agent/parse.go)
+- ``wire``         the WorkloadManager gRPC contract
+                   (reference: pkg/workload/workload.proto)
+- ``agent``        Slurm CLI driver + gRPC server on the login node
+                   (reference: pkg/slurm-agent, cmd/slurm-agent)
+- ``solver``       the new thing: JAX/TPU batch placement solver
+                   (auction/LP sweep under jit/shard_map; greedy parity baseline)
+- ``bridge``       the SlurmBridgeJob reconciler ("operator")
+                   (reference: pkg/slurm-bridge-operator)
+- ``vnode``        virtual node: capacity advertiser, status translation, logs
+                   (reference: pkg/slurm-virtual-kubelet)
+- ``configurator`` partition watcher → virtual-node lifecycle
+                   (reference: pkg/configurator)
+- ``fetcher``      result fetcher (reference: cmd/result-fetcher)
+- ``kube``         minimal in-process kube-like object store + watch machinery
+- ``parallel``     device mesh / sharding helpers for the solver
+- ``obs``          metrics, events, structured logging
+"""
+
+__version__ = "0.1.0"

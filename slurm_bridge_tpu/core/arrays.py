@@ -1,0 +1,54 @@
+"""Slurm job-array spec grammar.
+
+Forms (sbatch(1) --array): "0-31", "1,3,5,7", "1-7:2" (step), and a
+"%N" max-simultaneous suffix, composable: "0-15%4", "1,3,9-12%2".
+
+Reference parity: parseArrayLen (pkg/slurm-bridge-operator/parse.go:126-135)
+only counted a plain "a-b" range; we implement the full grammar since the
+array length multiplies placement demand (pod.go:153-156).
+"""
+
+from __future__ import annotations
+
+
+def parse_array_spec(spec: str) -> list[int]:
+    """Expand an --array spec into the sorted list of task ids."""
+    s = spec.strip()
+    if not s:
+        return []
+    # strip %N throttle suffix (applies to the whole spec)
+    if "%" in s:
+        s, _, throttle = s.rpartition("%")
+        if not throttle.isdigit():
+            raise ValueError(f"bad array throttle in {spec!r}")
+    ids: set[int] = set()
+    for chunk in s.split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            raise ValueError(f"bad array spec {spec!r}")
+        step = 1
+        if ":" in chunk:
+            chunk, _, step_s = chunk.partition(":")
+            if not step_s.isdigit() or int(step_s) < 1:
+                raise ValueError(f"bad array step in {spec!r}")
+            step = int(step_s)
+        if "-" in chunk:
+            lo_s, _, hi_s = chunk.partition("-")
+            if not (lo_s.isdigit() and hi_s.isdigit()):
+                raise ValueError(f"bad array range in {spec!r}")
+            lo, hi = int(lo_s), int(hi_s)
+            if hi < lo:
+                raise ValueError(f"inverted array range in {spec!r}")
+            ids.update(range(lo, hi + 1, step))
+        else:
+            if not chunk.isdigit():
+                raise ValueError(f"bad array id in {spec!r}")
+            ids.add(int(chunk))
+    return sorted(ids)
+
+
+def array_len(spec: str) -> int:
+    """Number of array tasks; 1 for the empty spec (non-array job)."""
+    if not spec.strip():
+        return 1
+    return max(1, len(parse_array_spec(spec)))

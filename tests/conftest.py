@@ -1,0 +1,29 @@
+"""Test harness config.
+
+Solver/parallel tests run on a virtual 8-device CPU mesh: force the host
+platform before anything imports jax, per the driver contract.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+
+import pytest
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+@pytest.fixture
+def fixtures_dir() -> pathlib.Path:
+    return FIXTURES
+
+
+def load_fixture(name: str) -> str:
+    return (FIXTURES / name).read_text()

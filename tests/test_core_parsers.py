@@ -1,0 +1,344 @@
+"""Golden-fixture parser tests — the reference's dominant test strategy
+(SURVEY.md §4: verbatim scontrol/sacct outputs, table-driven duration cases)."""
+
+import pytest
+
+from slurm_bridge_tpu.core import (
+    UNLIMITED,
+    JobStatus,
+    array_len,
+    extract_batch_resources,
+    format_duration,
+    parse_array_spec,
+    parse_duration,
+    parse_job_info,
+    parse_node_info,
+    parse_partition_info,
+    parse_sacct_steps,
+)
+from slurm_bridge_tpu.core.hostlist import compress_hostlist, expand_hostlist
+from slurm_bridge_tpu.core.sbatch import parse_mem_mb
+
+from conftest import load_fixture
+
+
+# ---------------------------------------------------------------- durations
+
+
+@pytest.mark.parametrize(
+    "raw,want",
+    [
+        ("10", 600),
+        ("0", 0),
+        ("90", 5400),
+        ("10:30", 630),
+        ("01:00:00", 3600),
+        ("1:2:3", 3723),
+        ("1-0", 86400),
+        ("1-12", 129600),
+        ("2-03:04", 183840),
+        ("1-00:00:30", 86430),
+        ("3-23:59:59", 345599),
+    ],
+)
+def test_parse_duration(raw, want):
+    assert parse_duration(raw) == want
+
+
+@pytest.mark.parametrize("raw", ["UNLIMITED", "INFINITE", "unlimited", "N/A"])
+def test_parse_duration_unlimited(raw):
+    assert parse_duration(raw) == UNLIMITED
+
+
+@pytest.mark.parametrize("raw", ["", "abc", "1:2:3:4", "1-", "--", "1-2-3"])
+def test_parse_duration_bad(raw):
+    with pytest.raises(ValueError):
+        parse_duration(raw)
+
+
+@pytest.mark.parametrize(
+    "secs,want",
+    [(0, "00:00:00"), (630, "00:10:30"), (86430, "1-00:00:30"), (UNLIMITED, "UNLIMITED")],
+)
+def test_format_duration(secs, want):
+    assert format_duration(secs) == want
+
+
+def test_duration_roundtrip():
+    for s in (0, 59, 60, 3599, 3600, 86399, 86400, 987654):
+        assert parse_duration(format_duration(s)) == s
+
+
+# ---------------------------------------------------------------- arrays
+
+
+@pytest.mark.parametrize(
+    "spec,want",
+    [
+        ("0-3", [0, 1, 2, 3]),
+        ("1,3,5", [1, 3, 5]),
+        ("0-15%4", list(range(16))),
+        ("1-7:2", [1, 3, 5, 7]),
+        ("1,3,9-12%2", [1, 3, 9, 10, 11, 12]),
+        ("5", [5]),
+        ("", []),
+    ],
+)
+def test_parse_array_spec(spec, want):
+    assert parse_array_spec(spec) == want
+
+
+def test_array_len():
+    assert array_len("") == 1
+    assert array_len("0-31") == 32
+    assert array_len("1-7:2") == 4
+
+
+@pytest.mark.parametrize("spec", ["a-b", "3-1", "1-7:0", "1,,2"])
+def test_bad_array_spec(spec):
+    with pytest.raises(ValueError):
+        parse_array_spec(spec)
+
+
+# ---------------------------------------------------------------- hostlists
+
+
+@pytest.mark.parametrize(
+    "expr,want",
+    [
+        ("node1", ["node1"]),
+        ("node[1-3]", ["node1", "node2", "node3"]),
+        ("tpu-[001-003]", ["tpu-001", "tpu-002", "tpu-003"]),
+        ("node[1-2,5]", ["node1", "node2", "node5"]),
+        ("a1,b[2-3]", ["a1", "b2", "b3"]),
+        ("gpu[01-02],node7", ["gpu01", "gpu02", "node7"]),
+    ],
+)
+def test_expand_hostlist(expr, want):
+    assert expand_hostlist(expr) == want
+
+
+def test_compress_roundtrip():
+    hosts = [f"node{i}" for i in range(1, 10)] + ["gpu01", "gpu02", "login"]
+    assert expand_hostlist(compress_hostlist(hosts)) == hosts
+
+
+# ---------------------------------------------------------------- sbatch
+
+
+SCRIPT = """#!/bin/bash
+#SBATCH --job-name=demo --partition=gpu
+#SBATCH -N 2
+#SBATCH --ntasks=8 --cpus-per-task=4
+#SBATCH --mem-per-cpu=2G
+#SBATCH -t 1-00:00:00
+#SBATCH --array=0-15%4
+#SBATCH --gres=gpu:a100:2
+# a plain comment
+echo hello
+#SBATCH --nodes=99   # after first command: must be ignored
+"""
+
+
+def test_extract_batch_resources():
+    d = extract_batch_resources(SCRIPT)
+    dem = d.demand
+    assert dem.job_name == "demo"
+    assert dem.partition == "gpu"
+    assert dem.nodes == 2
+    assert dem.ntasks == 8
+    assert dem.cpus_per_task == 4
+    assert dem.mem_per_cpu_mb == 2048
+    assert dem.time_limit_s == 86400
+    assert dem.array == "0-15%4"
+    assert dem.gres == "gpu:a100:2"
+    assert d.array_count == 16
+    # sizecar sizing rule: cpus_per_task × ntasks × array_len (pod.go:143-162)
+    assert dem.total_cpus(d.array_count) == 4 * 8 * 16
+
+
+def test_extract_space_and_equals_forms():
+    a = extract_batch_resources("#!/bin/sh\n#SBATCH --nodes=3\ntrue\n")
+    b = extract_batch_resources("#!/bin/sh\n#SBATCH --nodes 3\ntrue\n")
+    c = extract_batch_resources("#!/bin/sh\n#SBATCH -N 3\ntrue\n")
+    d = extract_batch_resources("#!/bin/sh\n#SBATCH -N3\ntrue\n")
+    assert a.demand.nodes == b.demand.nodes == c.demand.nodes == d.demand.nodes == 3
+
+
+def test_defaults_when_no_directives():
+    d = extract_batch_resources("#!/bin/bash\necho hi\n")
+    assert d.demand.nodes == 1 and d.demand.cpus_per_task == 1 and d.demand.ntasks == 1
+
+
+@pytest.mark.parametrize(
+    "raw,want",
+    [("1024", 1024), ("2G", 2048), ("512M", 512), ("1T", 1024 * 1024), ("2048K", 2)],
+)
+def test_parse_mem(raw, want):
+    assert parse_mem_mb(raw) == want
+
+
+# ---------------------------------------------------------------- scontrol job
+
+
+def test_parse_job_running():
+    jobs = parse_job_info(load_fixture("scontrol_job_running.txt"))
+    assert len(jobs) == 1
+    j = jobs[0]
+    assert j.id == 52
+    assert j.name == "sbatch-job.sh"
+    assert j.user_id == "worker"
+    assert j.state == JobStatus.RUNNING
+    assert j.run_time_s == 304
+    assert j.time_limit_s == 21600
+    assert j.partition == "debug"
+    assert j.node_list == "node[1-2]"
+    assert j.batch_host == "node1"
+    assert j.num_nodes == 2
+    assert j.std_out == "/home/worker/slurm-52.out"
+    assert j.working_dir == "/home/worker"
+    assert j.exit_code == "0:0"
+    assert j.submit_time is not None and j.submit_time.year == 2024
+    assert j.array_id == ""
+    assert j.reason == ""  # Reason=None normalises to empty
+
+
+def test_parse_job_array():
+    jobs = parse_job_info(load_fixture("scontrol_job_array.txt"))
+    assert len(jobs) == 2
+    a, b = jobs
+    assert a.array_id == "60_1" and a.state == JobStatus.COMPLETED
+    assert a.time_limit_s == UNLIMITED
+    assert b.array_id == "60_2" and b.state == JobStatus.PENDING
+    assert b.start_time is None  # StartTime=Unknown
+    assert b.reason == "Resources"
+    assert b.node_list == ""  # (null)
+
+
+# ---------------------------------------------------------------- scontrol partition
+
+
+def test_parse_partitions():
+    parts = parse_partition_info(load_fixture("scontrol_partition.txt"))
+    assert [p.name for p in parts] == ["debug", "gpu"]
+    debug, gpu = parts
+    # UNLIMITED fallbacks (parse.go:113-190): MaxNodes→TotalNodes,
+    # MaxCPUsPerNode→TotalCPUs/TotalNodes
+    assert debug.max_nodes == 4
+    assert debug.max_cpus_per_node == 32
+    assert debug.max_time_s == UNLIMITED
+    assert debug.nodes == ("node1", "node2", "node3", "node4")
+    assert debug.total_cpus == 128
+    assert gpu.max_nodes == 8
+    assert gpu.max_time_s == 86400
+    assert gpu.max_cpus_per_node == 64
+    assert gpu.max_mem_per_node_mb == 262144
+    assert gpu.nodes[0] == "gpu01" and len(gpu.nodes) == 8
+
+
+# ---------------------------------------------------------------- scontrol nodes
+
+
+def test_parse_nodes():
+    nodes = parse_node_info(load_fixture("scontrol_nodes.txt"))
+    assert len(nodes) == 2
+    n1, g1 = nodes
+    assert n1.name == "node1"
+    assert n1.cpus == 32 and n1.alloc_cpus == 8
+    assert n1.memory_mb == 128000 and n1.alloc_memory_mb == 16384
+    assert n1.free_cpus == 24 and n1.free_memory_mb == 111616
+    assert n1.gpus == 0
+    assert n1.features == ("avx512", "nvme")
+    assert n1.state == "MIXED" and n1.schedulable
+    assert g1.name == "gpu01"
+    assert g1.gpus == 4 and g1.gpu_type == "a100"
+    assert g1.alloc_gpus == 0 and g1.free_gpus == 4
+    assert g1.cpus == 64
+
+
+# ---------------------------------------------------------------- sacct
+
+
+def test_parse_sacct_steps():
+    steps = parse_sacct_steps(load_fixture("sacct_steps.txt"))
+    assert len(steps) == 4
+    assert steps[0].id == "52" and steps[0].state == JobStatus.COMPLETED
+    assert steps[1].id == "52.batch" and steps[1].name == "batch"
+    assert steps[2].state == JobStatus.RUNNING and steps[2].finish_time is None
+    assert steps[3].exit_code == 1 and steps[3].state == JobStatus.FAILED
+
+
+def test_parse_sacct_bad_row():
+    with pytest.raises(ValueError):
+        parse_sacct_steps("a|b|c\n")
+
+
+# ---------------------------------------------------------------- status map
+
+
+@pytest.mark.parametrize(
+    "raw,want",
+    [
+        ("RUNNING", JobStatus.RUNNING),
+        ("CANCELLED by 1000", JobStatus.CANCELLED),
+        ("CANCELLED+", JobStatus.CANCELLED),
+        ("NODE_FAIL", JobStatus.FAILED),
+        ("COMPLETING", JobStatus.RUNNING),
+        ("wat", JobStatus.UNKNOWN),
+        ("", JobStatus.UNKNOWN),
+    ],
+)
+def test_status_from_slurm(raw, want):
+    assert JobStatus.from_slurm(raw) == want
+
+
+def test_terminal_states():
+    assert JobStatus.COMPLETED.is_terminal
+    assert JobStatus.TIMEOUT.is_terminal
+    assert not JobStatus.RUNNING.is_terminal
+    assert not JobStatus.PENDING.is_terminal
+
+
+# ------------------------------------------------- review-finding regressions
+
+
+def test_hostlist_cross_product_capped():
+    with pytest.raises(ValueError):
+        expand_hostlist("n[1-1000000]x[1-1000000]")
+
+
+def test_alloc_tres_gpu_parsing():
+    from slurm_bridge_tpu.core.scontrol import parse_gres_gpus
+
+    assert parse_gres_gpus("cpu=8,mem=32G,gres/gpu=4") == (4, "")
+    assert parse_gres_gpus("cpu=8,gres/gpu:a100=2") == (2, "a100")
+    assert parse_gres_gpus("gpu:v100:4(S:0-1),lustre:1") == (4, "v100")
+    assert parse_gres_gpus("") == (0, "")
+
+
+def test_pending_job_ranged_numnodes():
+    text = "JobId=7 JobName=x UserId=u(1) JobState=PENDING NumNodes=1-4 Partition=p"
+    jobs = parse_job_info(text)
+    assert jobs[0].num_nodes == 1
+
+
+def test_composite_node_states():
+    from slurm_bridge_tpu.core.types import NodeInfo
+
+    assert NodeInfo(state="IDLE+CLOUD").schedulable
+    assert NodeInfo(state="MIXED+CLOUD+POWERED_UP").schedulable
+    assert not NodeInfo(state="IDLE+CLOUD+POWERED_DOWN").schedulable
+    assert not NodeInfo(state="IDLE+DRAIN").schedulable
+    assert not NodeInfo(state="DOWN*").schedulable
+    assert NodeInfo(state="ALLOCATED*").schedulable
+
+
+def test_quoted_directive_values():
+    d = extract_batch_resources('#!/bin/sh\n#SBATCH --job-name="my job" -p debug\ntrue\n')
+    assert d.demand.job_name == "my job"
+    assert d.demand.partition == "debug"
+
+
+def test_directive_trailing_comment():
+    d = extract_batch_resources("#!/bin/sh\n#SBATCH --nodes=3  # three nodes\ntrue\n")
+    assert d.demand.nodes == 3
