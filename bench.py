@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Headline benchmark: place 50k pending pods against a 10k-node snapshot.
+
+Prints ONE JSON line:
+  {"metric": "pods_placed_per_sec_50kx10k", "value": N, "unit": "pods/s",
+   "vs_baseline": X}
+
+where ``vs_baseline`` is the speedup of the JAX auction solver (on the
+available accelerator) over the native C++ greedy packer — the stand-in for
+the reference's in-process Go-side placement path (BASELINE.md: the
+reference publishes no numbers, so the greedy packer we built at parity IS
+the measured baseline).
+
+Extra per-scenario detail goes to stderr; stdout carries only the one line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _steady_state_ms(fn, *, warmup: int = 1, iters: int = 5) -> float:
+    for _ in range(warmup):
+        fn()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(times))
+
+
+def main() -> None:
+    from slurm_bridge_tpu.solver import AuctionConfig, auction_place
+    from slurm_bridge_tpu.solver.greedy_native import greedy_place_native
+    from slurm_bridge_tpu.solver.snapshot import random_scenario
+
+    import jax
+
+    backend = jax.default_backend()
+    n_dev = len(jax.devices())
+    print(f"# backend={backend} devices={n_dev}", file=sys.stderr)
+
+    # BASELINE.md scenario #3-shaped: 50k pods, 10k nodes, gres + gangs
+    snap, batch = random_scenario(
+        10_000, 50_000, seed=42, load=0.7, gpu_fraction=0.15, gang_fraction=0.05
+    )
+    p = batch.num_shards
+    print(f"# scenario: {p} shards x {snap.num_nodes} nodes", file=sys.stderr)
+
+    # --- baseline: native greedy (CPU) ---
+    t_greedy = _steady_state_ms(
+        lambda: greedy_place_native(snap, batch), warmup=0, iters=3
+    )
+    g = greedy_place_native(snap, batch)
+    print(
+        f"# greedy_native: {t_greedy:.1f} ms, placed {int(g.placed.sum())}",
+        file=sys.stderr,
+    )
+
+    # --- JAX auction ---
+    cfg = AuctionConfig(rounds=12, dtype="bfloat16")
+    if n_dev > 1:
+        from slurm_bridge_tpu.solver.sharded import sharded_place
+
+        solve = lambda: sharded_place(snap, batch, cfg)  # noqa: E731
+    else:
+        solve = lambda: auction_place(snap, batch, cfg)  # noqa: E731
+    t_auction = _steady_state_ms(solve, warmup=1, iters=5)
+    a = solve()
+    placed = int(a.placed.sum())
+    print(
+        f"# auction[{backend}x{n_dev}]: {t_auction:.1f} ms, placed {placed} "
+        f"(greedy placed {int(g.placed.sum())})",
+        file=sys.stderr,
+    )
+
+    pods_per_sec = placed / (t_auction / 1e3)
+    print(
+        json.dumps(
+            {
+                "metric": "pods_placed_per_sec_50kx10k",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(t_greedy / t_auction, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

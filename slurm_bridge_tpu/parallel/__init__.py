@@ -1,0 +1,5 @@
+"""Device-mesh and sharding helpers for the solver's distributed path."""
+
+from slurm_bridge_tpu.parallel.mesh import solver_mesh, pad_to_multiple
+
+__all__ = ["solver_mesh", "pad_to_multiple"]

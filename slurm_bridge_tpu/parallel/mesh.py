@@ -1,0 +1,64 @@
+"""Mesh construction for the sharded solver.
+
+The solve's two big axes map onto a 2-D device mesh:
+- ``dp`` shards the *pods* axis (the "sequence" of pending work — the
+  SP-style axis called out in SURVEY.md §5 "Long-context");
+- ``mp`` shards the *nodes* axis (the model/capacity axis).
+
+Intra-slice these collectives ride ICI; across slices jax.distributed +
+DCN carry the same program (the gRPC control plane stays on the host —
+SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def solver_mesh(
+    devices: list | None = None,
+    *,
+    dp: int | None = None,
+    mp: int | None = None,
+) -> Mesh:
+    """Build a 2-D ("dp", "mp") mesh over the given (default: all) devices.
+
+    Without explicit factors, devices are split as square as possible with
+    the larger factor on "dp" (the pods axis usually dwarfs the nodes axis).
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if dp is None and mp is None:
+        mp = 1
+        for f in range(int(math.isqrt(n)), 0, -1):
+            if n % f == 0:
+                mp = f
+                break
+        dp = n // mp
+    elif dp is None:
+        if n % mp:
+            raise ValueError(f"mp={mp} does not divide {n} devices")
+        dp = n // mp
+    elif mp is None:
+        if n % dp:
+            raise ValueError(f"dp={dp} does not divide {n} devices")
+        mp = n // dp
+    if dp * mp != n:
+        raise ValueError(f"dp×mp = {dp}×{mp} != {n} devices")
+    arr = np.asarray(devs).reshape(dp, mp)
+    return Mesh(arr, axis_names=("dp", "mp"))
+
+
+def pad_to_multiple(x: np.ndarray, multiple: int, *, axis: int = 0, value=0):
+    """Pad ``x`` along ``axis`` to the next multiple; returns (padded, orig_len)."""
+    size = x.shape[axis]
+    target = ((size + multiple - 1) // multiple) * multiple if size else multiple
+    if target == size:
+        return x, size
+    pad_width = [(0, 0)] * x.ndim
+    pad_width[axis] = (0, target - size)
+    return np.pad(x, pad_width, constant_values=value), size

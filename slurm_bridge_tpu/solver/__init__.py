@@ -1,0 +1,40 @@
+"""The TPU-native batch placement solver.
+
+This package replaces the reference's placement path — one kube-scheduler
+decision plus one `scontrol` exec per pod per tick
+(SURVEY.md §3.2, pkg/slurm-agent/slurm.go:263-277) — with a single batched
+solve per reconcile tick: pending jobs and the node inventory are lowered
+into dense matrices (:mod:`snapshot`) and bin-packed by a fixed-iteration
+auction sweep under ``jit`` (:mod:`auction`), sharded over a device mesh for
+the 50k×10k case (:mod:`sharded`).
+
+Solver paths (BASELINE.md scenarios):
+- ``greedy``        numpy reference packer — correctness oracle
+- ``greedy_native`` C++ first-fit-decreasing packer via ctypes — the
+                    in-process baseline the ≥10× target is measured against
+- ``auction``       jit/vmap auction-LP sweep, single device
+- ``sharded``       shard_map/psum multi-device sweep
+"""
+
+from slurm_bridge_tpu.solver.snapshot import (
+    ClusterSnapshot,
+    JobBatch,
+    Placement,
+    encode_cluster,
+    encode_jobs,
+    RESOURCE_DIMS,
+)
+from slurm_bridge_tpu.solver.greedy import greedy_place
+from slurm_bridge_tpu.solver.auction import auction_place, AuctionConfig
+
+__all__ = [
+    "ClusterSnapshot",
+    "JobBatch",
+    "Placement",
+    "encode_cluster",
+    "encode_jobs",
+    "RESOURCE_DIMS",
+    "greedy_place",
+    "auction_place",
+    "AuctionConfig",
+]

@@ -1,0 +1,89 @@
+"""Reference greedy packer — the correctness oracle and parity baseline.
+
+Priority-ordered first-fit/best-fit, one shard at a time, gang groups
+admitted all-or-nothing. This reproduces (in spirit) what the reference's
+stack achieves with kube-scheduler defaults plus partition affinity
+(SURVEY.md §6 "Scheduling algorithm") as an in-process packer, and is the
+baseline the JAX solver's ≥10× target is measured against (BASELINE.md).
+
+This implementation is intentionally simple and sequential; the C++
+sibling (:mod:`greedy_native`) is the performance-tuned version of the
+same algorithm.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from slurm_bridge_tpu.solver.snapshot import ClusterSnapshot, JobBatch, Placement
+
+
+def greedy_place(
+    snapshot: ClusterSnapshot,
+    batch: JobBatch,
+    *,
+    best_fit: bool = True,
+) -> Placement:
+    """Place shards in priority order; gangs are all-or-nothing.
+
+    For each gang (in max-priority order), tentatively place every shard via
+    best-fit (least leftover cpu) or first-fit; commit only if all shards fit.
+    """
+    free = snapshot.free.copy()
+    part_of = snapshot.partition_of
+    feats = snapshot.features
+    p = batch.num_shards
+    node_of = np.full(p, -1, dtype=np.int32)
+
+    # group shards by gang, order gangs by priority (desc), stable
+    order = np.argsort(-batch.priority, kind="stable")
+    gangs: dict[int, list[int]] = {}
+    gang_order: list[int] = []
+    for idx in order:
+        g = int(batch.gang_id[idx])
+        if g not in gangs:
+            gangs[g] = []
+            gang_order.append(g)
+        gangs[g].append(int(idx))
+
+    for g in gang_order:
+        shards = gangs[g]
+        trial = free  # copy lazily only for multi-shard gangs
+        if len(shards) > 1:
+            trial = free.copy()
+        chosen: list[tuple[int, int]] = []
+        gang_nodes: set[int] = set()  # multi-node gangs need distinct nodes
+        ok = True
+        for s in shards:
+            dem = batch.demand[s]
+            mask = np.all(trial >= dem, axis=1)
+            jp = batch.partition_of[s]
+            if jp >= 0:
+                mask &= part_of == jp
+            rf = np.uint32(batch.req_features[s])
+            if rf:
+                mask &= (feats & rf) == rf
+            if gang_nodes:
+                mask[list(gang_nodes)] = False
+            cand = np.nonzero(mask)[0]
+            if cand.size == 0:
+                ok = False
+                break
+            if best_fit:
+                leftover = trial[cand, 0] - dem[0]
+                pick = int(cand[np.argmin(leftover)])
+            else:
+                pick = int(cand[0])
+            trial[pick] -= dem
+            chosen.append((s, pick))
+            if len(shards) > 1:
+                gang_nodes.add(pick)
+        if ok:
+            if trial is not free:
+                free = trial
+            for s, pick in chosen:
+                node_of[s] = pick
+        # else: gang dropped, free unchanged (trial copy discarded)
+
+    placed = node_of >= 0
+    return Placement(node_of=node_of, placed=placed, free_after=free)

@@ -1,0 +1,217 @@
+"""Multi-device auction sweep — shard_map over a ("dp", "mp") mesh.
+
+The P×N score/argmax work — the only part that scales with the product of
+queue size and cluster size — is sharded both ways: each device owns a
+[P/dp, N/mp] block. Everything O(P) or O(N) (admission sort, pricing, gang
+bookkeeping) is replicated, so the only collectives per round are:
+
+- ``psum``-free: the assignment is replicated, so current free capacity is
+  recomputed locally (no traffic);
+- ``all_gather`` over "mp": per-pod best (score, node) across node blocks —
+  [P/dp × mp] elements;
+- ``all_gather`` over "dp": the winning choices back to full [P] —
+  P elements.
+
+Both gathers ride ICI within a slice; across slices the same program runs
+over DCN via jax.distributed (SURVEY.md §2.9's TPU-native equivalent of the
+reference's gRPC data plane).
+
+Padding: P is padded to a multiple of dp with shards whose partition code
+can never match (2**30), N to a multiple of mp with nodes advertising -1
+free capacity — unchoosable by construction.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from slurm_bridge_tpu.parallel.mesh import pad_to_multiple, solver_mesh
+from slurm_bridge_tpu.solver.auction import (
+    AuctionConfig,
+    admit,
+    gang_dedup,
+    gang_revoke,
+    hash_jitter,
+    multi_mask,
+    price_step,
+    resource_scale,
+    used_capacity,
+)
+from slurm_bridge_tpu.solver.snapshot import ClusterSnapshot, JobBatch, Placement
+
+_PAD_PART = np.int32(2**30)
+
+
+@lru_cache(maxsize=32)
+def _make_sharded_kernel(
+    mesh: Mesh, rounds: int, n_total: int, eta, jitter, affinity_weight, dtype
+):
+    """Build + jit the sharded kernel once per (mesh, shape, config) — a
+    fresh closure per call would force full XLA recompilation every tick."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P("mp", None),  # free0 [N, R]
+            P("mp"),  # node_part
+            P("mp"),  # node_feat
+            P("dp", None),  # dem [P, R]
+            P("dp"),  # job_part
+            P("dp"),  # req_feat
+            P("dp"),  # prio
+            P("dp"),  # gang
+            P(),  # scale [R]
+        ),
+        out_specs=(P(), P()),  # assign [P], free_after [N, R] — replicated
+        # the control path (admission/pricing) is computed redundantly on
+        # every device from all_gathered inputs — identical by determinism,
+        # which the static varying-axes analysis cannot prove
+        check_vma=False,
+    )
+    def kernel(
+        free0_blk, node_part_blk, node_feat_blk,
+        dem_blk, job_part_blk, req_feat_blk, prio_blk, gang_blk, scale,
+    ):
+        pblk = dem_blk.shape[0]
+        nblk = free0_blk.shape[0]
+        n = n_total
+        dp_i = jax.lax.axis_index("dp")
+        mp_i = jax.lax.axis_index("mp")
+        p_off = dp_i * pblk
+        n_off = mp_i * nblk
+        neg_inf = jnp.float32(-jnp.inf)
+
+        # full (replicated) pod-side arrays — O(P), tiny next to the blocks
+        dem = jax.lax.all_gather(dem_blk, "dp", tiled=True)  # [P, R]
+        prio = jax.lax.all_gather(prio_blk, "dp", tiled=True)
+        gang = jax.lax.all_gather(gang_blk, "dp", tiled=True)
+        free0 = jax.lax.all_gather(free0_blk, "mp", tiled=True)  # [N, R]
+        p = dem.shape[0]
+        multi = multi_mask(gang, p)
+        dem_n_blk = (dem_blk * scale).astype(dtype)
+        dem_n = (dem * scale).astype(dtype)
+
+        # static local feasibility block
+        part_ok = (job_part_blk[:, None] == node_part_blk[None, :]) | (
+            job_part_blk[:, None] < 0
+        )
+        feat_ok = (node_feat_blk[None, :] & req_feat_blk[:, None]) == req_feat_blk[
+            :, None
+        ]
+        static_ok = part_ok & feat_ok  # [P/dp, N/mp]
+
+        def round_body(rnd, carry):
+            assign, price = carry  # replicated [P], [N]
+            free = free0 - used_capacity(dem, assign, n)  # replicated, no comms
+            free_blk = jax.lax.dynamic_slice_in_dim(free, n_off, nblk, axis=0)
+            price_blk = jax.lax.dynamic_slice_in_dim(price, n_off, nblk, axis=0)
+            free_n_blk = (free_blk * scale).astype(dtype)
+
+            # ---- sharded P×N block: score + local argmax ----
+            cap_ok = jnp.all(dem_blk[:, None, :] <= free_blk[None, :, :] + 1e-6, -1)
+            feasible = static_ok & cap_ok
+            affinity = -(dem_n_blk @ free_n_blk.T)  # [P/dp, N/mp]
+            jit_mat = hash_jitter(
+                pblk, nblk, rnd, dtype, p_off=p_off, n_off=n_off
+            ) * jnp.asarray(jitter, dtype)
+            bid = (
+                jnp.asarray(affinity_weight, dtype) * affinity
+                + jit_mat
+                - price_blk[None, :].astype(dtype)
+            )
+            bid = jnp.where(feasible, bid, neg_inf)
+            lidx = jnp.argmax(bid, axis=1).astype(jnp.int32)  # [P/dp]
+            lval = jnp.take_along_axis(bid, lidx[:, None], axis=1)[:, 0]
+            gidx = n_off + lidx
+
+            # ---- winner across node blocks (all_gather over mp) ----
+            vals = jax.lax.all_gather(lval.astype(jnp.float32), "mp")  # [mp, P/dp]
+            gidxs = jax.lax.all_gather(gidx, "mp")
+            w = jnp.argmax(vals, axis=0)
+            bval = jnp.take_along_axis(vals, w[None, :], axis=0)[0]
+            bchoice = jnp.take_along_axis(gidxs, w[None, :], axis=0)[0]
+
+            # ---- full choices (all_gather over dp), then replicated steps
+            bval_full = jax.lax.all_gather(bval, "dp", tiled=True)  # [P]
+            choice = jax.lax.all_gather(bchoice, "dp", tiled=True)
+            unplaced = assign < 0
+            valid = unplaced & jnp.isfinite(bval_full)
+            choice = jnp.where(valid, choice, n)
+
+            choice, valid = gang_dedup(choice, valid, assign, gang, multi, n)
+            admitted = admit(choice, valid, dem, prio, free, n)
+            assign = jnp.where(
+                admitted & unplaced, jnp.where(choice < n, choice, -1), assign
+            )
+            price = price_step(price, choice, valid, dem_n, free, scale, n, eta)
+            return assign, price
+
+        assign0 = jnp.full((p,), -1, jnp.int32)
+        price0 = jnp.zeros((n,), jnp.float32)
+        assign, _ = jax.lax.fori_loop(0, rounds, round_body, (assign0, price0))
+        assign = gang_revoke(assign, gang, p)
+        free_after = free0 - used_capacity(dem, assign, n)
+        return assign, free_after
+
+    return jax.jit(kernel)
+
+
+def sharded_place(
+    snapshot: ClusterSnapshot,
+    batch: JobBatch,
+    config: AuctionConfig | None = None,
+    *,
+    mesh: Mesh | None = None,
+) -> Placement:
+    """Solve one tick sharded over every available device."""
+    cfg = config or AuctionConfig()
+    mesh = mesh or solver_mesh()
+    dp, mp = mesh.shape["dp"], mesh.shape["mp"]
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+
+    p_real = batch.num_shards
+    n_real = snapshot.num_nodes
+
+    free0, _ = pad_to_multiple(snapshot.free, mp, value=-1.0)
+    node_part, _ = pad_to_multiple(snapshot.partition_of, mp, value=_PAD_PART)
+    node_feat, _ = pad_to_multiple(snapshot.features, mp)
+    n_total = free0.shape[0]
+
+    dem, _ = pad_to_multiple(batch.demand, dp)
+    job_part, _ = pad_to_multiple(batch.partition_of, dp, value=_PAD_PART)
+    req_feat, _ = pad_to_multiple(batch.req_features, dp)
+    prio, _ = pad_to_multiple(batch.priority, dp, value=np.float32(-1e30))
+    # padded shards get fresh singleton gang ids so they never merge
+    p_total = dem.shape[0]
+    gang = np.arange(p_total, dtype=np.int32)
+    gang[:p_real] = batch.gang_id
+
+    kernel = _make_sharded_kernel(
+        mesh, cfg.rounds, n_total, cfg.eta, cfg.jitter, cfg.affinity_weight, dtype
+    )
+    with jax.set_mesh(mesh):
+        assign, free_after = kernel(
+            jnp.asarray(free0),
+            jnp.asarray(node_part),
+            jnp.asarray(node_feat),
+            jnp.asarray(dem),
+            jnp.asarray(job_part),
+            jnp.asarray(req_feat),
+            jnp.asarray(prio),
+            jnp.asarray(gang),
+            jnp.asarray(resource_scale(snapshot)),
+        )
+    assign_np = np.asarray(assign)[:p_real]
+    # padded shards can never place (impossible partition), padded nodes can
+    # never be chosen (negative free); strip rows and we are done
+    return Placement(
+        node_of=assign_np,
+        placed=assign_np >= 0,
+        free_after=np.asarray(free_after)[:n_real],
+    )

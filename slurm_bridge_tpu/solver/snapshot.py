@@ -1,0 +1,290 @@
+"""Lowering cluster state and pending jobs into dense solver matrices.
+
+The reference advertises capacity per partition by summing node cpus/mem/gpus
+(pkg/slurm-virtual-kubelet/node.go:169-199) and places pods one at a time.
+Here the whole inventory becomes one ``[N, R]`` matrix and the pending queue
+one ``[P, R]`` matrix so a single jitted sweep places everything at once.
+
+Encoding decisions (TPU-first):
+- resources are float32 columns normalised later by the solver; the dims are
+  fixed and static (``RESOURCE_DIMS``) so shapes never depend on data;
+- partition membership is an int32 code per row (compared, not one-hot — the
+  P×N feasibility product is formed on the fly inside the kernel);
+- node features are a uint32 bitmask; a job's required features must be a
+  subset of its node's mask (gres strings / features per
+  apis slurmbridgejob_types.go:55, agent api/slurm.go:74-78);
+- multi-node jobs (``nodes>1``) are split into per-node shards sharing a
+  gang id — the solver admits gangs all-or-nothing, which is also how MPI
+  jobsets (BASELINE config #4) are expressed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from slurm_bridge_tpu.core.arrays import array_len
+from slurm_bridge_tpu.core.types import JobDemand, NodeInfo, PartitionInfo
+
+#: Static resource dimensions, in matrix column order.
+RESOURCE_DIMS = ("cpus", "mem_mb", "gpus")
+NUM_RES = len(RESOURCE_DIMS)
+
+
+@dataclass
+class ClusterSnapshot:
+    """Dense view of the node inventory at one tick."""
+
+    node_names: list[str]
+    capacity: np.ndarray  # [N, R] float32 total capacity
+    free: np.ndarray  # [N, R] float32 free capacity
+    partition_of: np.ndarray  # [N] int32 partition code
+    features: np.ndarray  # [N] uint32 feature bitmask
+    partition_codes: dict[str, int]  # name -> code
+    feature_codes: dict[str, int]  # name -> bit index
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.node_names)
+
+
+@dataclass
+class JobBatch:
+    """Dense view of the pending queue at one tick.
+
+    One row per *placement shard*: a single-node job is one row; an
+    ``n``-node job is ``n`` rows sharing a ``gang_id``. ``job_of`` maps each
+    row back to the submitting job's index in the original list.
+    """
+
+    demand: np.ndarray  # [P, R] float32 per-shard demand
+    partition_of: np.ndarray  # [P] int32 partition code (-1 = any)
+    req_features: np.ndarray  # [P] uint32 required feature bits
+    priority: np.ndarray  # [P] float32 (higher places first)
+    gang_id: np.ndarray  # [P] int32 gang group (unique per job)
+    job_of: np.ndarray  # [P] int32 original job index
+
+    @property
+    def num_shards(self) -> int:
+        return int(self.demand.shape[0])
+
+
+@dataclass
+class Placement:
+    """Solver output: shard→node assignment (-1 = unplaced)."""
+
+    node_of: np.ndarray  # [P] int32
+    placed: np.ndarray  # [P] bool
+    free_after: np.ndarray  # [N, R] float32
+
+    def by_job(self, batch: JobBatch) -> dict[int, list[int]]:
+        """Map original job index → list of assigned node indices."""
+        out: dict[int, list[int]] = {}
+        for shard in np.nonzero(self.placed)[0]:
+            out.setdefault(int(batch.job_of[shard]), []).append(
+                int(self.node_of[shard])
+            )
+        return out
+
+
+def encode_cluster(
+    nodes: list[NodeInfo],
+    partitions: list[PartitionInfo],
+    *,
+    feature_codes: dict[str, int] | None = None,
+) -> ClusterSnapshot:
+    """Lower NodeInfo/PartitionInfo lists into a ClusterSnapshot.
+
+    Unschedulable nodes (DRAIN/DOWN/…) keep their rows (stable indices
+    across ticks — see SURVEY.md §7 determinism note) but advertise zero
+    free capacity.
+    """
+    partition_codes = {p.name: i for i, p in enumerate(partitions)}
+    node_part: dict[str, int] = {}
+    for p in partitions:
+        for name in p.nodes:
+            node_part.setdefault(name, partition_codes[p.name])
+
+    feature_codes = dict(feature_codes or {})
+    n = len(nodes)
+    capacity = np.zeros((n, NUM_RES), dtype=np.float32)
+    free = np.zeros((n, NUM_RES), dtype=np.float32)
+    partition_of = np.full(n, -1, dtype=np.int32)
+    features = np.zeros(n, dtype=np.uint32)
+    names = []
+    for i, nd in enumerate(nodes):
+        names.append(nd.name)
+        capacity[i] = (nd.cpus, nd.memory_mb, nd.gpus)
+        if nd.schedulable:
+            free[i] = (nd.free_cpus, nd.free_memory_mb, nd.free_gpus)
+        partition_of[i] = node_part.get(nd.name, -1)
+        mask = 0
+        for f in nd.features:
+            if f not in feature_codes:
+                # bit 31 is reserved as the "impossible requirement" sentinel
+                # (_required_features) — real features stop at bit 30
+                if len(feature_codes) >= 31:
+                    continue  # bitmask full: extra features are unmatchable
+                feature_codes[f] = len(feature_codes)
+            mask |= 1 << feature_codes[f]
+        features[i] = mask
+    return ClusterSnapshot(
+        node_names=names,
+        capacity=capacity,
+        free=free,
+        partition_of=partition_of,
+        features=features,
+        partition_codes=partition_codes,
+        feature_codes=feature_codes,
+    )
+
+
+def _required_features(demand: JobDemand, feature_codes: dict[str, int]) -> int:
+    """Map a job's constraint strings onto the snapshot's feature bits.
+
+    A gres type (e.g. `gpu:a100:2` → "a100") participates as a feature bit
+    when the cluster advertises it; unknown features make the job
+    unplaceable by requiring an impossible bit (bit 31 reserved)."""
+    mask = 0
+    wanted: list[str] = []
+    if demand.gres:
+        parts = demand.gres.split(":")
+        if len(parts) == 3:  # gpu:type:count
+            wanted.append(parts[1])
+    for feat in wanted:
+        if feat in feature_codes:
+            mask |= 1 << feature_codes[feat]
+        else:
+            mask |= 1 << 31
+    return mask
+
+
+def _gres_gpu_count(gres: str) -> int:
+    parts = gres.split(":")
+    if not parts or parts[0] != "gpu":
+        return 0
+    try:
+        return int(parts[-1].split("(")[0])
+    except ValueError:
+        return 0
+
+
+def encode_jobs(
+    demands: list[JobDemand],
+    snapshot: ClusterSnapshot,
+    *,
+    priorities: list[float] | None = None,
+) -> JobBatch:
+    """Lower pending JobDemands into a JobBatch of placement shards.
+
+    Sizing follows the sizecar rule (pkg/slurm-bridge-operator/pod.go:143-162):
+    cpu = cpus_per_task × ntasks × array_len, spread evenly across ``nodes``
+    shards; mem = mem_per_cpu × cpu (defaulting 1024 MB/cpu as pod.go:91-95).
+    """
+    rows_dem: list[tuple[float, float, float]] = []
+    rows_part: list[int] = []
+    rows_feat: list[int] = []
+    rows_prio: list[float] = []
+    rows_gang: list[int] = []
+    rows_job: list[int] = []
+    for j, d in enumerate(demands):
+        arr = array_len(d.array)
+        total_cpus = float(d.total_cpus(arr))
+        nshards = max(1, d.nodes)
+        mem_per_cpu = float(d.mem_per_cpu_mb or 1024.0)
+        cpu_per_shard = total_cpus / nshards
+        # gres is a PER-NODE quantity in Slurm (--gres=gpu:4 means 4 GPUs on
+        # every allocated node), so it is NOT divided across shards; the
+        # array fan-out multiplies it like the sizecar cpu rule does
+        gpu_per_shard = float(_gres_gpu_count(d.gres)) * max(1, arr)
+        part = snapshot.partition_codes.get(d.partition, -1)
+        feat = _required_features(d, snapshot.feature_codes)
+        prio = float(priorities[j]) if priorities is not None else float(d.priority)
+        for _ in range(nshards):
+            rows_dem.append((cpu_per_shard, cpu_per_shard * mem_per_cpu, gpu_per_shard))
+            rows_part.append(part)
+            rows_feat.append(feat)
+            rows_prio.append(prio)
+            rows_gang.append(j)
+            rows_job.append(j)
+    return JobBatch(
+        demand=np.asarray(rows_dem, dtype=np.float32).reshape(-1, NUM_RES),
+        partition_of=np.asarray(rows_part, dtype=np.int32),
+        req_features=np.asarray(rows_feat, dtype=np.uint32),
+        priority=np.asarray(rows_prio, dtype=np.float32),
+        gang_id=np.asarray(rows_gang, dtype=np.int32),
+        job_of=np.asarray(rows_job, dtype=np.int32),
+    )
+
+
+def random_scenario(
+    num_nodes: int,
+    num_jobs: int,
+    *,
+    seed: int = 0,
+    num_partitions: int = 4,
+    gpu_fraction: float = 0.0,
+    gang_fraction: float = 0.0,
+    gang_size: int = 4,
+    load: float = 0.7,
+) -> tuple[ClusterSnapshot, JobBatch]:
+    """Synthetic benchmark scenario generator (BASELINE.md configs #2-#5).
+
+    ``load`` scales total job demand relative to total cluster capacity.
+    """
+    rng = np.random.default_rng(seed)
+    cpus = rng.choice([32, 64, 128], size=num_nodes).astype(np.float32)
+    mem = cpus * rng.choice([2048, 4096], size=num_nodes).astype(np.float32)
+    has_gpu = rng.random(num_nodes) < gpu_fraction
+    gpus = np.where(has_gpu, rng.choice([4, 8], size=num_nodes), 0).astype(np.float32)
+    part = rng.integers(0, num_partitions, size=num_nodes).astype(np.int32)
+    features = np.where(has_gpu, np.uint32(1), np.uint32(0))
+
+    capacity = np.stack([cpus, mem, gpus], axis=1)
+    # start with some pre-existing allocation
+    used_frac = rng.uniform(0.0, 0.3, size=(num_nodes, 1)).astype(np.float32)
+    free = np.round(capacity * (1.0 - used_frac))
+
+    snapshot = ClusterSnapshot(
+        node_names=[f"node{i:05d}" for i in range(num_nodes)],
+        capacity=capacity,
+        free=free.astype(np.float32),
+        partition_of=part,
+        features=features,
+        partition_codes={f"part{i}": i for i in range(num_partitions)},
+        feature_codes={"gpu_type0": 0},
+    )
+
+    # jobs: scale mean demand so total ≈ load × total free capacity
+    mean_cpu_free = float(free[:, 0].mean())
+    lam = max(1.0, load * mean_cpu_free * num_nodes / max(1, num_jobs))
+    jcpu = np.maximum(1, rng.poisson(lam, size=num_jobs)).astype(np.float32)
+    jmem = jcpu * rng.choice([1024, 2048, 4096], size=num_jobs).astype(np.float32)
+    is_gpu_job = rng.random(num_jobs) < gpu_fraction
+    jgpu = np.where(is_gpu_job, rng.integers(1, 5, size=num_jobs), 0).astype(np.float32)
+    jpart = rng.integers(0, num_partitions, size=num_jobs).astype(np.int32)
+    jfeat = np.where(is_gpu_job, np.uint32(1), np.uint32(0))
+    prio = rng.uniform(0, 100, size=num_jobs).astype(np.float32)
+
+    is_gang = rng.random(num_jobs) < gang_fraction
+    rows = []
+    for j in range(num_jobs):
+        n = gang_size if is_gang[j] else 1
+        for _ in range(n):
+            rows.append(j)
+    job_of = np.asarray(rows, dtype=np.int32)
+    batch = JobBatch(
+        demand=np.stack(
+            [jcpu[job_of] / np.where(is_gang[job_of], gang_size, 1),
+             jmem[job_of] / np.where(is_gang[job_of], gang_size, 1),
+             jgpu[job_of]],
+            axis=1,
+        ).astype(np.float32),
+        partition_of=jpart[job_of],
+        req_features=jfeat[job_of],
+        priority=prio[job_of],
+        gang_id=job_of.copy(),
+        job_of=job_of,
+    )
+    return snapshot, batch

@@ -1,0 +1,305 @@
+"""Solver correctness: greedy oracle vs auction kernel.
+
+Strategy per SURVEY.md §7 step 5: verify *feasibility parity* (no
+oversubscription, partition/feature constraints hold, gangs all-or-nothing)
+plus placement-quality bounds vs the greedy oracle on synthetic snapshots.
+"""
+
+import numpy as np
+import pytest
+
+from slurm_bridge_tpu.core.types import JobDemand, NodeInfo, PartitionInfo
+from slurm_bridge_tpu.solver import (
+    AuctionConfig,
+    auction_place,
+    encode_cluster,
+    encode_jobs,
+    greedy_place,
+)
+from slurm_bridge_tpu.solver.snapshot import random_scenario
+
+
+def _check_feasible(snapshot, batch, placement):
+    """No node over capacity; every placed shard respects constraints."""
+    used = np.zeros_like(snapshot.free)
+    for s in np.nonzero(placement.placed)[0]:
+        nd = placement.node_of[s]
+        assert nd >= 0
+        used[nd] += batch.demand[s]
+        jp = batch.partition_of[s]
+        if jp >= 0:
+            assert snapshot.partition_of[nd] == jp, f"shard {s} wrong partition"
+        rf = np.uint32(batch.req_features[s])
+        assert (snapshot.features[nd] & rf) == rf, f"shard {s} missing features"
+    assert np.all(used <= snapshot.free + 1e-3), "node oversubscribed"
+    # gangs all-or-nothing
+    for g in np.unique(batch.gang_id):
+        members = batch.gang_id == g
+        flags = placement.placed[members]
+        assert flags.all() or not flags.any(), f"gang {g} partially placed"
+
+
+def _placed_count(placement):
+    return int(placement.placed.sum())
+
+
+# ---------------------------------------------------------------- encoders
+
+
+def test_encode_cluster_and_jobs():
+    nodes = [
+        NodeInfo(name="n1", cpus=32, memory_mb=64000, state="IDLE"),
+        NodeInfo(name="n2", cpus=32, alloc_cpus=16, memory_mb=64000, state="MIXED"),
+        NodeInfo(name="g1", cpus=64, memory_mb=128000, gpus=4, gpu_type="a100",
+                 features=("a100",), state="IDLE"),
+        NodeInfo(name="bad", cpus=32, memory_mb=64000, state="DOWN"),
+    ]
+    parts = [
+        PartitionInfo(name="debug", nodes=("n1", "n2", "bad")),
+        PartitionInfo(name="gpu", nodes=("g1",)),
+    ]
+    snap = encode_cluster(nodes, parts)
+    assert snap.num_nodes == 4
+    assert snap.free[0, 0] == 32 and snap.free[1, 0] == 16
+    assert snap.free[3].sum() == 0  # DOWN node advertises nothing
+    assert snap.partition_of.tolist() == [0, 0, 1, 0]
+    assert snap.features[2] != 0
+
+    jobs = [
+        JobDemand(partition="debug", cpus_per_task=2, ntasks=4),
+        JobDemand(partition="gpu", gres="gpu:a100:2", cpus_per_task=8),
+        JobDemand(partition="debug", nodes=2, ntasks=2, cpus_per_task=4),
+        JobDemand(partition="debug", array="0-3", cpus_per_task=1),
+    ]
+    batch = encode_jobs(jobs, snap)
+    # job 2 splits into 2 gang shards
+    assert batch.num_shards == 5
+    assert batch.demand[0, 0] == 8  # 2cpu × 4 tasks
+    assert batch.demand[1, 2] == 2  # gpus
+    assert (batch.gang_id == 2).sum() == 2
+    assert batch.demand[4, 0] == 4  # array 0-3 → ×4 cpus
+
+
+# ---------------------------------------------------------------- greedy
+
+
+def test_greedy_simple():
+    snap, batch = random_scenario(16, 40, seed=1, load=0.5)
+    pl = greedy_place(snap, batch)
+    _check_feasible(snap, batch, pl)
+    assert _placed_count(pl) > 0
+
+
+def test_greedy_respects_capacity_exactly():
+    nodes = [NodeInfo(name="n1", cpus=4, memory_mb=4096, state="IDLE")]
+    parts = [PartitionInfo(name="p", nodes=("n1",))]
+    snap = encode_cluster(nodes, parts)
+    jobs = [JobDemand(partition="p", cpus_per_task=3, mem_per_cpu_mb=1024),
+            JobDemand(partition="p", cpus_per_task=3, mem_per_cpu_mb=1024)]
+    batch = encode_jobs(jobs, snap, priorities=[10, 5])
+    pl = greedy_place(snap, batch)
+    # only the higher-priority job fits
+    assert pl.placed.tolist() == [True, False]
+
+
+def test_greedy_gang_all_or_nothing():
+    nodes = [NodeInfo(name=f"n{i}", cpus=4, memory_mb=8192, state="IDLE") for i in range(2)]
+    parts = [PartitionInfo(name="p", nodes=tuple(n.name for n in nodes))]
+    snap = encode_cluster(nodes, parts)
+    # 3-node gang cannot fit on a 2-node cluster; singleton can
+    jobs = [JobDemand(partition="p", nodes=3, ntasks=3, cpus_per_task=2),
+            JobDemand(partition="p", cpus_per_task=1)]
+    batch = encode_jobs(jobs, snap, priorities=[100, 1])
+    pl = greedy_place(snap, batch)
+    assert not pl.placed[:3].any()
+    assert pl.placed[3]
+
+
+# ---------------------------------------------------------------- auction
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_auction_feasibility(seed):
+    snap, batch = random_scenario(32, 200, seed=seed, load=0.6,
+                                  gpu_fraction=0.2, gang_fraction=0.1)
+    pl = auction_place(snap, batch)
+    _check_feasible(snap, batch, pl)
+
+
+def test_auction_vs_greedy_quality():
+    snap, batch = random_scenario(64, 400, seed=7, load=0.6)
+    g = greedy_place(snap, batch)
+    a = auction_place(snap, batch, AuctionConfig(rounds=12))
+    _check_feasible(snap, batch, a)
+    # auction must place at least 90% of what greedy places
+    assert _placed_count(a) >= 0.9 * _placed_count(g), (
+        f"auction {_placed_count(a)} vs greedy {_placed_count(g)}"
+    )
+
+
+def test_auction_deterministic():
+    snap, batch = random_scenario(32, 100, seed=3)
+    a1 = auction_place(snap, batch)
+    a2 = auction_place(snap, batch)
+    assert np.array_equal(a1.node_of, a2.node_of)
+
+
+def test_auction_empty_batch():
+    snap, _ = random_scenario(8, 10, seed=0)
+    from slurm_bridge_tpu.solver.snapshot import JobBatch
+
+    empty = JobBatch(
+        demand=np.zeros((0, 3), np.float32),
+        partition_of=np.zeros(0, np.int32),
+        req_features=np.zeros(0, np.uint32),
+        priority=np.zeros(0, np.float32),
+        gang_id=np.zeros(0, np.int32),
+        job_of=np.zeros(0, np.int32),
+    )
+    pl = auction_place(snap, empty)
+    assert pl.node_of.shape == (0,)
+
+
+def test_auction_priority_wins_scarce_node():
+    nodes = [NodeInfo(name="n1", cpus=4, memory_mb=4096, state="IDLE")]
+    parts = [PartitionInfo(name="p", nodes=("n1",))]
+    snap = encode_cluster(nodes, parts)
+    jobs = [JobDemand(partition="p", cpus_per_task=3, mem_per_cpu_mb=1024),
+            JobDemand(partition="p", cpus_per_task=3, mem_per_cpu_mb=1024)]
+    batch = encode_jobs(jobs, snap, priorities=[1, 99])
+    pl = auction_place(snap, batch)
+    assert pl.placed.tolist() == [False, True]
+
+
+# ---------------------------------------------------------------- native greedy
+
+
+@pytest.mark.parametrize("seed", [0, 5, 9])
+def test_native_greedy_matches_python(seed):
+    from slurm_bridge_tpu.solver.greedy_native import greedy_place_native
+
+    snap, batch = random_scenario(48, 300, seed=seed, load=0.7,
+                                  gpu_fraction=0.2, gang_fraction=0.1)
+    py = greedy_place(snap, batch)
+    nat = greedy_place_native(snap, batch)
+    assert np.array_equal(py.node_of, nat.node_of)
+    assert np.allclose(py.free_after, nat.free_after, atol=1e-3)
+
+
+def test_native_greedy_empty():
+    from slurm_bridge_tpu.solver.greedy_native import greedy_place_native
+    from slurm_bridge_tpu.solver.snapshot import JobBatch
+
+    snap, _ = random_scenario(8, 10, seed=0)
+    empty = JobBatch(
+        demand=np.zeros((0, 3), np.float32),
+        partition_of=np.zeros(0, np.int32),
+        req_features=np.zeros(0, np.uint32),
+        priority=np.zeros(0, np.float32),
+        gang_id=np.zeros(0, np.int32),
+        job_of=np.zeros(0, np.int32),
+    )
+    pl = greedy_place_native(snap, empty)
+    assert pl.node_of.shape == (0,)
+
+
+# ---------------------------------------------------------------- sharded
+
+
+def _empty_batch():
+    from slurm_bridge_tpu.solver.snapshot import JobBatch
+
+    return JobBatch(
+        demand=np.zeros((0, 3), np.float32),
+        partition_of=np.zeros(0, np.int32),
+        req_features=np.zeros(0, np.uint32),
+        priority=np.zeros(0, np.float32),
+        gang_id=np.zeros(0, np.int32),
+        job_of=np.zeros(0, np.int32),
+    )
+
+
+def test_solver_mesh_shapes():
+    import jax
+    from slurm_bridge_tpu.parallel import solver_mesh
+
+    mesh = solver_mesh()
+    assert mesh.shape["dp"] * mesh.shape["mp"] == len(jax.devices())
+
+
+@pytest.mark.parametrize("seed", [0, 4])
+def test_sharded_matches_quality(seed):
+    from slurm_bridge_tpu.solver.sharded import sharded_place
+
+    snap, batch = random_scenario(33, 197, seed=seed, load=0.6,
+                                  gpu_fraction=0.2, gang_fraction=0.1)
+    # deliberately non-divisible sizes to exercise padding
+    single = auction_place(snap, batch, AuctionConfig(rounds=10))
+    multi = sharded_place(snap, batch, AuctionConfig(rounds=10))
+    _check_feasible(snap, batch, multi)
+    assert _placed_count(multi) >= 0.95 * _placed_count(single), (
+        f"sharded {_placed_count(multi)} vs single {_placed_count(single)}"
+    )
+
+
+def test_sharded_deterministic():
+    from slurm_bridge_tpu.solver.sharded import sharded_place
+
+    snap, batch = random_scenario(16, 64, seed=2)
+    a = sharded_place(snap, batch)
+    b = sharded_place(snap, batch)
+    assert np.array_equal(a.node_of, b.node_of)
+
+
+# ------------------------------------------------- review-finding regressions
+
+
+def test_gres_is_per_node_not_divided():
+    from slurm_bridge_tpu.core.types import JobDemand, NodeInfo, PartitionInfo
+
+    nodes = [NodeInfo(name=f"g{i}", cpus=16, memory_mb=65536, gpus=4,
+                      features=("a100",), state="IDLE") for i in range(2)]
+    parts = [PartitionInfo(name="gpu", nodes=tuple(n.name for n in nodes))]
+    snap = encode_cluster(nodes, parts)
+    # --nodes=2 --gres=gpu:a100:4 => 4 GPUs on EACH node
+    jobs = [JobDemand(partition="gpu", nodes=2, ntasks=2, gres="gpu:a100:4")]
+    batch = encode_jobs(jobs, snap)
+    assert batch.num_shards == 2
+    assert batch.demand[0, 2] == 4 and batch.demand[1, 2] == 4
+
+
+def test_feature_bit31_reserved():
+    from slurm_bridge_tpu.core.types import JobDemand, NodeInfo, PartitionInfo
+    from slurm_bridge_tpu.solver.snapshot import _required_features
+
+    nodes = [NodeInfo(name="n0", cpus=8, memory_mb=8192, state="IDLE",
+                      features=tuple(f"f{i}" for i in range(40)))]
+    parts = [PartitionInfo(name="p", nodes=("n0",))]
+    snap = encode_cluster(nodes, parts)
+    assert len(snap.feature_codes) == 31  # bit 31 never allocated
+    # a job wanting a gres type the cluster doesn't advertise is unplaceable
+    mask = _required_features(JobDemand(gres="gpu:h100:1"), snap.feature_codes)
+    assert mask & (1 << 31)
+    assert (snap.features[0] & np.uint32(mask)) != np.uint32(mask)
+
+
+def test_solver_mesh_partial_factors():
+    from slurm_bridge_tpu.parallel import solver_mesh
+
+    m = solver_mesh(dp=8)
+    assert m.shape["dp"] == 8 and m.shape["mp"] == 1
+    m = solver_mesh(mp=4)
+    assert m.shape["mp"] == 4 and m.shape["dp"] == 2
+    with pytest.raises(ValueError):
+        solver_mesh(dp=3)
+
+
+def test_sharded_kernel_cached():
+    from slurm_bridge_tpu.solver.sharded import _make_sharded_kernel
+    from slurm_bridge_tpu.parallel import solver_mesh
+    import jax.numpy as jnp
+
+    mesh = solver_mesh()
+    k1 = _make_sharded_kernel(mesh, 4, 16, 0.5, 1.0, 0.25, jnp.float32)
+    k2 = _make_sharded_kernel(mesh, 4, 16, 0.5, 1.0, 0.25, jnp.float32)
+    assert k1 is k2
