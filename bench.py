@@ -72,10 +72,12 @@ def main() -> None:
         solve = lambda: auction_place(snap, batch, cfg)  # noqa: E731
     t_auction = _steady_state_ms(solve, warmup=1, iters=5)
     a = solve()
-    placed = int(a.placed.sum())
+    # denominate in JOBS (pods), not gang shards — gangs are all-or-nothing
+    # so a job appears in by_job iff fully placed
+    placed = len(a.by_job(batch))
     print(
-        f"# auction[{backend}x{n_dev}]: {t_auction:.1f} ms, placed {placed} "
-        f"(greedy placed {int(g.placed.sum())})",
+        f"# auction[{backend}x{n_dev}]: {t_auction:.1f} ms, placed {placed} jobs "
+        f"/ {int(a.placed.sum())} shards (greedy placed {len(g.by_job(batch))} jobs)",
         file=sys.stderr,
     )
 

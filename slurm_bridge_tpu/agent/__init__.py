@@ -1,0 +1,19 @@
+"""The login-node agent: Slurm CLI driver + WorkloadManager gRPC server.
+
+Reference parity: pkg/slurm-agent (CLI client slurm.go, gRPC server
+api/slurm.go) and cmd/slurm-agent (main). The driver interface is pluggable
+(the reference hints at this with its wlmName abstraction api/slurm.go:355):
+anything implementing :class:`cli.WorkloadDriver` can back the server.
+"""
+
+from slurm_bridge_tpu.agent.cli import SlurmClient, SlurmError, WorkloadDriver
+from slurm_bridge_tpu.agent.server import WorkloadServicer
+from slurm_bridge_tpu.agent.config import load_partition_config
+
+__all__ = [
+    "SlurmClient",
+    "SlurmError",
+    "WorkloadDriver",
+    "WorkloadServicer",
+    "load_partition_config",
+]

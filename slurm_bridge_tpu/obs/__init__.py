@@ -1,0 +1,17 @@
+"""Observability: structured logging, metrics registry, event recorder."""
+
+from slurm_bridge_tpu.obs.logging import setup_logging
+from slurm_bridge_tpu.obs.metrics import Counter, Gauge, Histogram, MetricsRegistry, REGISTRY
+from slurm_bridge_tpu.obs.events import Event, EventRecorder, Reason
+
+__all__ = [
+    "setup_logging",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "Event",
+    "EventRecorder",
+    "Reason",
+]

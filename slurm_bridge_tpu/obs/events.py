@@ -1,0 +1,87 @@
+"""Event recorder — the user-facing audit trail.
+
+Reference parity: K8s Events with the reason taxonomy of
+pkg/common/status.go:14-35 and cmd events/event.go:20-60 (the reference
+leans on recorder.Eventf as its audit trail — SURVEY.md §5). Here events
+are structured records kept in a ring buffer and logged; the kube layer
+mirrors them onto objects so `describe` shows them.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+class Reason:
+    """Event reason taxonomy (kind-prefixed like the reference's NewReason
+    helper, common/status.go:37-39)."""
+
+    # job lifecycle
+    JOB_CREATED = "SlurmBridgeJobCreated"
+    JOB_SUBMITTED = "SlurmBridgeJobSubmitted"
+    JOB_RUNNING = "SlurmBridgeJobRunning"
+    JOB_SUCCEEDED = "SlurmBridgeJobSucceeded"
+    JOB_FAILED = "SlurmBridgeJobFailed"
+    JOB_CANCELLED = "SlurmBridgeJobCancelled"
+    # placement
+    PLACEMENT_OK = "PlacementSucceeded"
+    PLACEMENT_FAILED = "PlacementFailed"
+    # pods / virtual nodes
+    POD_CREATED = "PodCreated"
+    POD_FAILED = "PodFailed"
+    NODE_READY = "VirtualNodeReady"
+    NODE_GONE = "VirtualNodeGone"
+    # results
+    RESULT_FETCH_STARTED = "ResultFetchStarted"
+    RESULT_FETCH_DONE = "ResultFetchSucceeded"
+    RESULT_FETCH_FAILED = "ResultFetchFailed"
+
+
+@dataclass
+class Event:
+    reason: str
+    message: str
+    kind: str = ""
+    name: str = ""
+    type: str = "Normal"  # Normal | Warning
+    ts: float = field(default_factory=time.time)
+
+
+class EventRecorder:
+    def __init__(self, *, capacity: int = 1024, logger: str = "sbt.events"):
+        self._events: deque[Event] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._log = logging.getLogger(logger)
+        self._sinks: list = []
+
+    def add_sink(self, fn) -> None:
+        """fn(Event) — e.g. the kube layer appending to object events."""
+        self._sinks.append(fn)
+
+    def event(self, obj, reason: str, message: str, *, warning: bool = False) -> Event:
+        ev = Event(
+            reason=reason,
+            message=message,
+            kind=type(obj).__name__ if obj is not None else "",
+            name=getattr(obj, "name", "") if obj is not None else "",
+            type="Warning" if warning else "Normal",
+        )
+        with self._lock:
+            self._events.append(ev)
+        (self._log.warning if warning else self._log.info)(
+            "%s %s/%s: %s", ev.reason, ev.kind, ev.name, ev.message
+        )
+        for sink in self._sinks:
+            sink(ev)
+        return ev
+
+    def events(self, *, name: str | None = None) -> list[Event]:
+        with self._lock:
+            evs = list(self._events)
+        if name is not None:
+            evs = [e for e in evs if e.name == name]
+        return evs

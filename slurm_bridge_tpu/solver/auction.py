@@ -82,15 +82,24 @@ def hash_jitter(p: int, n: int, salt, dtype, *, p_off=0, n_off=0) -> jnp.ndarray
 
 def segmented_cumsum(values: jnp.ndarray, segment_change: jnp.ndarray) -> jnp.ndarray:
     """Inclusive cumsum of ``values`` [P, R] restarting where
-    ``segment_change`` [P] is True (True at each segment's first row)."""
-    p = values.shape[0]
-    cum = jnp.cumsum(values, axis=0)
-    idx = jnp.arange(p)
-    start_idx = jnp.where(segment_change, idx, 0)
-    start_idx = jax.lax.cummax(start_idx)  # index of own segment's first row
-    prev_cum = jnp.concatenate([jnp.zeros_like(cum[:1]), cum[:-1]], axis=0)
-    base = prev_cum[start_idx]  # total before own segment started
-    return cum - base
+    ``segment_change`` [P] is True (True at each segment's first row).
+
+    Implemented as a true segmented scan (associative_scan with a reset
+    flag), NOT as global-cumsum-minus-base: a global float32 running total
+    over 50k shards reaches ~1e9 where ulp is ~64, and the subtraction
+    would carry tens of MB of error into per-node admission — enough to
+    oversubscribe a node. The segmented form keeps every accumulation
+    bounded by one node's total demand.
+    """
+    flags = segment_change[:, None]  # [P, 1] broadcast over R
+
+    def combine(a, b):
+        a_sum, a_flag = a
+        b_sum, b_flag = b
+        return jnp.where(b_flag, b_sum, a_sum + b_sum), a_flag | b_flag
+
+    out, _ = jax.lax.associative_scan(combine, (values, flags), axis=0)
+    return out
 
 
 def used_capacity(dem: jnp.ndarray, assign: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -186,9 +195,10 @@ def _auction_kernel(
     *,
     rounds: int,
     num_nodes: int,
-    eta: float = 0.5,
-    jitter: float = 1.0,
-    affinity_weight: float = 0.25,
+    # defaults mirror AuctionConfig — keep them in lockstep
+    eta: float = AuctionConfig.eta,
+    jitter: float = AuctionConfig.jitter,
+    affinity_weight: float = AuctionConfig.affinity_weight,
     dtype=jnp.float32,
 ):
     p = dem.shape[0]
@@ -256,6 +266,16 @@ def resource_scale(snapshot: ClusterSnapshot) -> np.ndarray:
     return (1.0 / np.maximum(mean_cap, 1.0)).astype(np.float32)
 
 
+def normalize_gangs(gang: np.ndarray) -> np.ndarray:
+    """Remap arbitrary gang ids onto [0, P) — the kernels use them as
+    segment ids with num_segments=P, and the native packer as array
+    indices, so out-of-range ids must never reach either."""
+    if gang.size == 0:
+        return gang.astype(np.int32)
+    _, inverse = np.unique(gang, return_inverse=True)
+    return inverse.astype(np.int32)
+
+
 def auction_place(
     snapshot: ClusterSnapshot,
     batch: JobBatch,
@@ -263,6 +283,12 @@ def auction_place(
 ) -> Placement:
     """Solve one tick on the default JAX device."""
     cfg = config or AuctionConfig()
+    if batch.num_shards == 0:
+        return Placement(
+            node_of=np.zeros(0, np.int32),
+            placed=np.zeros(0, bool),
+            free_after=snapshot.free.copy(),
+        )
     scale = resource_scale(snapshot)
     assign, free_after = _auction_kernel(
         jnp.asarray(snapshot.free),
@@ -272,7 +298,7 @@ def auction_place(
         jnp.asarray(batch.partition_of),
         jnp.asarray(batch.req_features),
         jnp.asarray(batch.priority),
-        jnp.asarray(batch.gang_id),
+        jnp.asarray(normalize_gangs(batch.gang_id)),
         jnp.asarray(scale),
         rounds=cfg.rounds,
         num_nodes=snapshot.num_nodes,

@@ -87,8 +87,11 @@ def greedy_place_native(
     job_part = np.ascontiguousarray(batch.partition_of, dtype=np.int32)
     req_feat = np.ascontiguousarray(batch.req_features, dtype=np.uint32)
     prio = np.ascontiguousarray(batch.priority, dtype=np.float32)
-    gang = np.ascontiguousarray(batch.gang_id, dtype=np.int32)
-    lib.sbt_greedy_place(
+    # gang ids index a p-sized table in C++ — remap arbitrary ids into [0, p)
+    from slurm_bridge_tpu.solver.auction import normalize_gangs
+
+    gang = np.ascontiguousarray(normalize_gangs(batch.gang_id), dtype=np.int32)
+    rc = lib.sbt_greedy_place(
         n,
         r,
         _ptr(free_io, ctypes.c_float),
@@ -103,4 +106,6 @@ def greedy_place_native(
         1 if best_fit else 0,
         _ptr(assign, ctypes.c_int32),
     )
+    if rc < 0:
+        raise ValueError("native greedy rejected gang ids (out of [0, p) range)")
     return Placement(node_of=assign, placed=assign >= 0, free_after=free_io)

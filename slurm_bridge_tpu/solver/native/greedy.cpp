@@ -24,6 +24,11 @@ int sbt_greedy_place(int n, int r, float* free_io, const int32_t* node_part,
                      const float* prio, const int32_t* gang, int best_fit,
                      int32_t* out_assign) {
   if (p <= 0) return 0;
+  // gang ids are segment ids in [0, p) — the Python wrapper remaps them;
+  // reject anything else instead of indexing out of bounds
+  for (int i = 0; i < p; ++i) {
+    if (gang[i] < 0 || gang[i] >= p) return -1;
+  }
   // stable order by priority descending
   std::vector<int32_t> order(p);
   std::iota(order.begin(), order.end(), 0);

@@ -1,0 +1,27 @@
+"""The WorkloadManager + PlacementSolver gRPC contract.
+
+``workload_pb2.py`` is generated from ``workload.proto`` with plain protoc
+(`protoc --python_out=. workload.proto` from this directory) and committed;
+:mod:`rpc` derives stubs and handlers from its descriptors at runtime, so no
+grpc_tools plugin is required.
+"""
+
+from slurm_bridge_tpu.wire import workload_pb2 as pb
+from slurm_bridge_tpu.wire.rpc import (
+    ServiceClient,
+    dial,
+    generic_handler,
+    normalize_endpoint,
+    serve,
+    service_methods,
+)
+
+__all__ = [
+    "pb",
+    "ServiceClient",
+    "dial",
+    "generic_handler",
+    "normalize_endpoint",
+    "serve",
+    "service_methods",
+]

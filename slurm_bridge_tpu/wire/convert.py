@@ -1,0 +1,205 @@
+"""core dataclasses ↔ wire messages.
+
+The reference's equivalents are the hand-written proto mappers in
+pkg/slurm-agent/api/slurm.go:369-473; field-by-field equality of these
+round-trips is part of the test surface (mirroring
+pkg/slurm-agent/api/slurm_test.go:26-103).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timezone
+
+from slurm_bridge_tpu.core.types import (
+    UNLIMITED,
+    JobDemand,
+    JobInfo,
+    JobStatus,
+    JobStepInfo,
+    NodeInfo,
+    PartitionInfo,
+)
+from slurm_bridge_tpu.wire import workload_pb2 as pb
+
+
+def _ts(dt: datetime | None) -> int:
+    if dt is None:
+        return 0
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return int(dt.timestamp())
+
+
+def _dt(ts: int) -> datetime | None:
+    if ts <= 0:
+        return None
+    return datetime.fromtimestamp(ts, tz=timezone.utc).replace(tzinfo=None)
+
+
+def demand_to_submit(demand: JobDemand, submitter_id: str = "") -> pb.SubmitJobRequest:
+    return pb.SubmitJobRequest(
+        script=demand.script,
+        partition=demand.partition,
+        submitter_id=submitter_id,
+        run_as_user=demand.run_as_user or 0,
+        run_as_group=demand.run_as_group or 0,
+        cpus_per_task=demand.cpus_per_task,
+        ntasks=demand.ntasks,
+        ntasks_per_node=demand.ntasks_per_node,
+        nodes=demand.nodes,
+        mem_per_cpu_mb=demand.mem_per_cpu_mb,
+        array=demand.array,
+        job_name=demand.job_name,
+        working_dir=demand.working_dir,
+        gres=demand.gres,
+        licenses=demand.licenses,
+        time_limit_s=demand.time_limit_s,
+        priority=demand.priority,
+    )
+
+
+def submit_to_demand(req: pb.SubmitJobRequest) -> JobDemand:
+    return JobDemand(
+        partition=req.partition,
+        script=req.script,
+        job_name=req.job_name,
+        run_as_user=req.run_as_user or None,
+        run_as_group=req.run_as_group or None,
+        array=req.array,
+        cpus_per_task=int(req.cpus_per_task) or 1,
+        ntasks=int(req.ntasks) or 1,
+        ntasks_per_node=int(req.ntasks_per_node),
+        nodes=int(req.nodes) or 1,
+        working_dir=req.working_dir,
+        mem_per_cpu_mb=int(req.mem_per_cpu_mb),
+        gres=req.gres,
+        licenses=req.licenses,
+        time_limit_s=int(req.time_limit_s),
+        priority=int(req.priority),
+    )
+
+
+def job_info_to_proto(j: JobInfo) -> pb.JobInfo:
+    return pb.JobInfo(
+        id=j.id,
+        user_id=j.user_id,
+        name=j.name,
+        exit_code=j.exit_code,
+        status=int(j.state),
+        submit_time=_ts(j.submit_time),
+        start_time=_ts(j.start_time),
+        run_time_s=j.run_time_s,
+        time_limit_s=j.time_limit_s,
+        working_dir=j.working_dir,
+        std_out=j.std_out,
+        std_err=j.std_err,
+        partition=j.partition,
+        node_list=j.node_list,
+        batch_host=j.batch_host,
+        num_nodes=j.num_nodes,
+        array_id=j.array_id,
+        reason=j.reason,
+    )
+
+
+def job_info_from_proto(m: pb.JobInfo) -> JobInfo:
+    return JobInfo(
+        id=int(m.id),
+        user_id=m.user_id,
+        name=m.name,
+        exit_code=m.exit_code,
+        state=JobStatus(m.status),
+        submit_time=_dt(m.submit_time),
+        start_time=_dt(m.start_time),
+        run_time_s=int(m.run_time_s),
+        time_limit_s=int(m.time_limit_s),
+        working_dir=m.working_dir,
+        std_out=m.std_out,
+        std_err=m.std_err,
+        partition=m.partition,
+        node_list=m.node_list,
+        batch_host=m.batch_host,
+        num_nodes=int(m.num_nodes),
+        array_id=m.array_id,
+        reason=m.reason,
+    )
+
+
+def step_to_proto(s: JobStepInfo) -> pb.JobStepInfo:
+    return pb.JobStepInfo(
+        id=s.id,
+        name=s.name,
+        start_time=_ts(s.start_time),
+        finish_time=_ts(s.finish_time),
+        exit_code=s.exit_code,
+        status=int(s.state),
+    )
+
+
+def step_from_proto(m: pb.JobStepInfo) -> JobStepInfo:
+    return JobStepInfo(
+        id=m.id,
+        name=m.name,
+        start_time=_dt(m.start_time),
+        finish_time=_dt(m.finish_time),
+        exit_code=int(m.exit_code),
+        state=JobStatus(m.status),
+    )
+
+
+def node_to_proto(n: NodeInfo) -> pb.Node:
+    return pb.Node(
+        name=n.name,
+        cpus=n.cpus,
+        alloc_cpus=n.alloc_cpus,
+        memory_mb=n.memory_mb,
+        alloc_memory_mb=n.alloc_memory_mb,
+        gpus=n.gpus,
+        alloc_gpus=n.alloc_gpus,
+        gpu_type=n.gpu_type,
+        features=list(n.features),
+        state=n.state,
+    )
+
+
+def node_from_proto(m: pb.Node) -> NodeInfo:
+    return NodeInfo(
+        name=m.name,
+        cpus=int(m.cpus),
+        alloc_cpus=int(m.alloc_cpus),
+        memory_mb=int(m.memory_mb),
+        alloc_memory_mb=int(m.alloc_memory_mb),
+        gpus=int(m.gpus),
+        alloc_gpus=int(m.alloc_gpus),
+        gpu_type=m.gpu_type,
+        features=tuple(m.features),
+        state=m.state,
+    )
+
+
+def partition_to_proto(p: PartitionInfo) -> pb.PartitionResponse:
+    return pb.PartitionResponse(
+        name=p.name,
+        nodes=list(p.nodes),
+        max_time_s=p.max_time_s,
+        max_nodes=p.max_nodes,
+        max_cpus_per_node=p.max_cpus_per_node,
+        max_mem_per_node_mb=p.max_mem_per_node_mb,
+        total_cpus=p.total_cpus,
+        total_nodes=p.total_nodes,
+        state=p.state,
+    )
+
+
+def partition_from_proto(m: pb.PartitionResponse) -> PartitionInfo:
+    return PartitionInfo(
+        name=m.name,
+        nodes=tuple(m.nodes),
+        max_time_s=int(m.max_time_s),
+        max_nodes=int(m.max_nodes),
+        max_cpus_per_node=int(m.max_cpus_per_node),
+        max_mem_per_node_mb=int(m.max_mem_per_node_mb),
+        total_cpus=int(m.total_cpus),
+        total_nodes=int(m.total_nodes),
+        state=m.state or "UP",
+    )

@@ -1,0 +1,150 @@
+"""Descriptor-driven gRPC wiring — stubs and servicers without grpc_tools.
+
+The image has the grpc runtime and protoc but not the grpc_python_plugin, so
+instead of generated `*_grpc_pb2.py` stubs we derive everything from the
+FileDescriptor at runtime: one table per service mapping method name →
+(streaming kind, request class, response class), from which we build both
+the client stub and the server's generic handler. This is less magic than
+it sounds — it is exactly what the generated code does, minus the codegen.
+
+Endpoint grammar mirrors the reference's dial sites: an endpoint ending in
+``.sock`` dials/binds a unix-domain socket, anything else TCP
+(pkg/slurm-virtual-kubelet/virtual-kubelet.go:112-120,
+cmd/slurm-agent/slurm-agent.go:33-47).
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass
+
+import grpc
+from google.protobuf import message_factory
+
+from slurm_bridge_tpu.wire import workload_pb2 as pb
+
+
+@dataclass(frozen=True)
+class MethodSpec:
+    name: str
+    request_streaming: bool
+    response_streaming: bool
+    req_cls: type
+    resp_cls: type
+
+    @property
+    def kind(self) -> str:
+        return {
+            (False, False): "unary_unary",
+            (False, True): "unary_stream",
+            (True, False): "stream_unary",
+            (True, True): "stream_stream",
+        }[(self.request_streaming, self.response_streaming)]
+
+
+def service_methods(service_name: str) -> tuple[str, list[MethodSpec]]:
+    """(full service name, method specs) for a service in workload.proto."""
+    svc = pb.DESCRIPTOR.services_by_name[service_name]
+    specs = [
+        MethodSpec(
+            name=m.name,
+            request_streaming=m.client_streaming,
+            response_streaming=m.server_streaming,
+            req_cls=message_factory.GetMessageClass(m.input_type),
+            resp_cls=message_factory.GetMessageClass(m.output_type),
+        )
+        for m in svc.methods
+    ]
+    return svc.full_name, specs
+
+
+def normalize_endpoint(endpoint: str) -> str:
+    """Reference semantics: *.sock → unix-domain socket target."""
+    if endpoint.startswith(("unix:", "dns:", "ipv4:", "ipv6:")):
+        return endpoint
+    if endpoint.endswith(".sock"):
+        return f"unix://{endpoint}" if endpoint.startswith("/") else f"unix:{endpoint}"
+    return endpoint
+
+
+def dial(endpoint: str) -> grpc.Channel:
+    """Open an insecure channel (the reference dials insecure everywhere:
+    SURVEY.md §5 'Distributed communication backend')."""
+    return grpc.insecure_channel(normalize_endpoint(endpoint))
+
+
+class ServiceClient:
+    """Dynamic client stub: one callable attribute per RPC.
+
+    >>> client = ServiceClient(dial("localhost:9999"), "WorkloadManager")
+    >>> client.SubmitJob(pb.SubmitJobRequest(script="...", partition="debug"))
+    """
+
+    def __init__(self, channel: grpc.Channel, service_name: str):
+        self._channel = channel
+        full_name, specs = service_methods(service_name)
+        for spec in specs:
+            factory = getattr(channel, spec.kind)
+            setattr(
+                self,
+                spec.name,
+                factory(
+                    f"/{full_name}/{spec.name}",
+                    request_serializer=spec.req_cls.SerializeToString,
+                    response_deserializer=spec.resp_cls.FromString,
+                ),
+            )
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def generic_handler(servicer, service_name: str) -> grpc.GenericRpcHandler:
+    """Build the server-side handler table from a servicer object.
+
+    The servicer implements methods named after the RPCs (missing ones
+    return UNIMPLEMENTED — unlike the reference's JobState panic,
+    api/slurm.go:48-51, an absent method degrades to a clean status).
+    """
+    full_name, specs = service_methods(service_name)
+    handlers = {}
+    for spec in specs:
+        fn = getattr(servicer, spec.name, None)
+        if fn is None:
+            continue
+        maker = getattr(grpc, f"{spec.kind}_rpc_method_handler")
+        handlers[spec.name] = maker(
+            fn,
+            request_deserializer=spec.req_cls.FromString,
+            response_serializer=spec.resp_cls.SerializeToString,
+        )
+    return grpc.method_handlers_generic_handler(full_name, handlers)
+
+
+def serve(
+    servicers: dict[str, object],
+    endpoint: str,
+    *,
+    max_workers: int = 16,
+) -> grpc.Server:
+    """Start a server hosting {service_name: servicer} at endpoint.
+
+    Returns the started server; caller owns shutdown. Binding ``host:0``
+    rewrites the port into the returned server's ``bound_port`` attribute.
+    """
+    from concurrent import futures
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    for name, servicer in servicers.items():
+        server.add_generic_rpc_handlers((generic_handler(servicer, name),))
+    target = normalize_endpoint(endpoint)
+    port = server.add_insecure_port(target)
+    server.bound_port = port  # type: ignore[attr-defined]
+    server.start()
+    return server

@@ -1,0 +1,371 @@
+"""Fake Slurm CLI backing the agent's exec-path tests.
+
+The reference never fakes Slurm — its exec paths are untested
+(SURVEY.md §4 "Multi-node story"). This shim closes that gap: five PATH
+binaries backed by a state directory (env ``SBT_FAKESLURM_STATE``) that
+*really execute* submitted scripts as detached processes, so job states,
+exit codes, stdout files, and log growth behave like the real thing.
+
+Not a Slurm reimplementation: just enough surface for the driver —
+sbatch --parsable, scancel, scontrol show jobid/partition/nodes,
+sacct -p -n, sinfo -V.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+from datetime import datetime
+
+
+def state_dir() -> pathlib.Path:
+    root = os.environ.get("SBT_FAKESLURM_STATE")
+    if not root:
+        print("SBT_FAKESLURM_STATE not set", file=sys.stderr)
+        sys.exit(2)
+    p = pathlib.Path(root)
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+DEFAULT_CLUSTER = {
+    "partitions": {
+        "debug": {"nodes": ["node1", "node2", "node3", "node4"], "default": True},
+        "gpu": {"nodes": ["gpu01", "gpu02"], "max_time": "1-00:00:00"},
+    },
+    "nodes": {
+        **{
+            f"node{i}": {"cpus": 32, "memory_mb": 128000, "features": ["avx512"]}
+            for i in range(1, 5)
+        },
+        **{
+            f"gpu{i:02d}": {
+                "cpus": 64,
+                "memory_mb": 262144,
+                "gpus": 4,
+                "gpu_type": "a100",
+                "features": ["a100"],
+            }
+            for i in range(1, 3)
+        },
+    },
+}
+
+
+def cluster(root: pathlib.Path) -> dict:
+    f = root / "cluster.json"
+    if f.exists():
+        return json.loads(f.read_text())
+    return DEFAULT_CLUSTER
+
+
+def _now() -> str:
+    return datetime.now().replace(microsecond=0).isoformat()
+
+
+def _job_path(root: pathlib.Path, job_id: int) -> pathlib.Path:
+    return root / f"job_{job_id}.json"
+
+
+def _load_job(root: pathlib.Path, job_id: int) -> dict | None:
+    p = _job_path(root, job_id)
+    if not p.exists():
+        return None
+    return json.loads(p.read_text())
+
+
+def _save_job(root: pathlib.Path, rec: dict) -> None:
+    _job_path(root, rec["id"]).write_text(json.dumps(rec))
+
+
+def _next_id(root: pathlib.Path) -> int:
+    f = root / "next_id"
+    cur = int(f.read_text()) if f.exists() else 100
+    f.write_text(str(cur + 1))
+    return cur
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def _job_state(root: pathlib.Path, rec: dict) -> tuple[str, str]:
+    """(state, exit_code) — derived from the detached process."""
+    if rec.get("cancelled"):
+        return "CANCELLED", "0:15"
+    exit_file = root / f"exit_{rec['id']}"
+    if exit_file.exists():
+        try:
+            rc = int(exit_file.read_text().strip() or "0")
+        except ValueError:
+            rc = 1
+        return ("COMPLETED", "0:0") if rc == 0 else ("FAILED", f"{rc}:0")
+    if _alive(rec["pid"]):
+        return "RUNNING", "0:0"
+    return "FAILED", "1:0"  # died without writing exit file
+
+
+# ---------------------------------------------------------------- sbatch
+
+
+def sbatch(argv: list[str]) -> int:
+    root = state_dir()
+    opts: dict[str, str] = {}
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--parsable":
+            opts["parsable"] = "1"
+        elif a.startswith("--"):
+            key = a[2:]
+            if "=" in key:
+                key, _, val = key.partition("=")
+                opts[key] = val
+            elif i + 1 < len(argv) and not argv[i + 1].startswith("--"):
+                opts[key] = argv[i + 1]
+                i += 1
+            else:
+                opts[key] = "1"
+        i += 1
+    script = sys.stdin.read()
+    if not script.strip():
+        print("sbatch: error: empty script", file=sys.stderr)
+        return 1
+    if "fail-submit" in script:
+        print("sbatch: error: Invalid qos specification", file=sys.stderr)
+        return 1
+
+    job_id = _next_id(root)
+    script_file = root / f"job_{job_id}.sh"
+    script_file.write_text(script)
+    out_file = root / f"slurm-{job_id}.out"
+    out_file.touch()
+    parts = cluster(root)["partitions"]
+    default_part = next((n for n, p in parts.items() if p.get("default")), "debug")
+    partition = opts.get("partition", default_part)
+    if partition not in parts:
+        print(f"sbatch: error: invalid partition specified: {partition}", file=sys.stderr)
+        return 1
+    node = cluster(root)["partitions"][partition]["nodes"][0]
+
+    # detach fds too: an inherited stdout pipe would keep the submitter's
+    # capture_output read open until the job itself exits
+    proc = subprocess.Popen(
+        ["/bin/sh", "-c", f'/bin/sh "{script_file}" > "{out_file}" 2>&1; '
+                          f'echo $? > "{root}/exit_{job_id}"'],
+        start_new_session=True,
+        stdin=subprocess.DEVNULL,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        env={**os.environ, "SLURM_JOB_ID": str(job_id)},
+    )
+    rec = {
+        "id": job_id,
+        "name": opts.get("job-name", script_file.name),
+        "partition": partition,
+        "submit_time": _now(),
+        "start_time": _now(),
+        "pid": proc.pid,
+        "node": node,
+        "stdout": str(out_file),
+        "work_dir": os.getcwd(),
+        "array": opts.get("array", ""),
+        "user": os.environ.get("USER", "user"),
+        "cancelled": False,
+    }
+    _save_job(root, rec)
+    if "parsable" in opts:
+        print(job_id)
+    else:
+        print(f"Submitted batch job {job_id}")
+    return 0
+
+
+# ---------------------------------------------------------------- scancel
+
+
+def scancel(argv: list[str]) -> int:
+    root = state_dir()
+    for arg in argv:
+        if not arg.isdigit():
+            continue
+        rec = _load_job(root, int(arg))
+        if rec is None:
+            print(f"scancel: error: Invalid job id {arg}", file=sys.stderr)
+            return 1
+        rec["cancelled"] = True
+        _save_job(root, rec)
+        try:
+            os.killpg(os.getpgid(rec["pid"]), signal.SIGTERM)
+        except OSError:
+            pass
+    return 0
+
+
+# ---------------------------------------------------------------- scontrol
+
+
+def _print_job(root: pathlib.Path, rec: dict) -> None:
+    state, exit_code = _job_state(root, rec)
+    reason = "None"
+    lines = [
+        f"JobId={rec['id']} JobName={rec['name']}",
+        f"   UserId={rec['user']}(1000) GroupId={rec['user']}(1000) MCS_label=N/A",
+        f"   JobState={state} Reason={reason} Dependency=(null)",
+        f"   Requeue=1 Restarts=0 BatchFlag=1 Reboot=0 ExitCode={exit_code}",
+        "   RunTime=00:00:01 TimeLimit=UNLIMITED TimeMin=N/A",
+        f"   SubmitTime={rec['submit_time']} EligibleTime={rec['submit_time']}",
+        f"   StartTime={rec['start_time']} EndTime=Unknown Deadline=N/A",
+        f"   Partition={rec['partition']} AllocNode:Sid=login0:1",
+        f"   NodeList={rec['node']}",
+        f"   BatchHost={rec['node']}",
+        "   NumNodes=1 NumCPUs=1 NumTasks=1 CPUs/Task=1 ReqB:S:C:T=0:0:*:*",
+        f"   WorkDir={rec['work_dir']}",
+        f"   StdErr={rec['stdout']}",
+        "   StdIn=/dev/null",
+        f"   StdOut={rec['stdout']}",
+    ]
+    print("\n".join(lines))
+
+
+def _print_partition(name: str, part: dict, nodes_cfg: dict) -> None:
+    node_names = part["nodes"]
+    total_cpus = sum(nodes_cfg[n]["cpus"] for n in node_names)
+    max_time = part.get("max_time", "UNLIMITED")
+    print(
+        f"PartitionName={name}\n"
+        f"   AllowGroups=ALL AllowAccounts=ALL AllowQos=ALL\n"
+        f"   MaxNodes=UNLIMITED MaxTime={max_time} MinNodes=0 MaxCPUsPerNode=UNLIMITED\n"
+        f"   Nodes={','.join(node_names)}\n"
+        f"   State=UP TotalCPUs={total_cpus} TotalNodes={len(node_names)}\n"
+        f"   DefMemPerNode=UNLIMITED MaxMemPerNode=UNLIMITED"
+    )
+
+
+def _print_node(name: str, cfg: dict) -> None:
+    gpus = cfg.get("gpus", 0)
+    gres = f"gpu:{cfg.get('gpu_type','gpu')}:{gpus}" if gpus else "(null)"
+    feats = ",".join(cfg.get("features", [])) or "(null)"
+    print(
+        f"NodeName={name} Arch=x86_64 CoresPerSocket=16\n"
+        f"   CPUAlloc={cfg.get('alloc_cpus', 0)} CPUTot={cfg['cpus']} CPULoad=0.00\n"
+        f"   AvailableFeatures={feats}\n"
+        f"   ActiveFeatures={feats}\n"
+        f"   Gres={gres}\n"
+        f"   RealMemory={cfg['memory_mb']} AllocMem={cfg.get('alloc_memory_mb', 0)} "
+        f"FreeMem={cfg['memory_mb']} Sockets=2 Boards=1\n"
+        f"   State={cfg.get('state', 'IDLE')} ThreadsPerCore=1 TmpDisk=0 Weight=1\n"
+        f"   Partitions={cfg.get('partition', 'debug')}"
+    )
+
+
+def scontrol(argv: list[str]) -> int:
+    root = state_dir()
+    args = [a for a in argv if a != "-dd"]
+    if len(args) >= 2 and args[0] == "show":
+        what = args[1]
+        rest = args[2:]
+        if what in ("jobid", "job"):
+            if not rest:
+                print("scontrol: error: no job id", file=sys.stderr)
+                return 1
+            rec = _load_job(root, int(rest[0]))
+            if rec is None:
+                print(f"slurm_load_jobs error: Invalid job id specified", file=sys.stderr)
+                return 1
+            _print_job(root, rec)
+            return 0
+        if what == "partition":
+            cl = cluster(root)
+            names = rest if rest else list(cl["partitions"])
+            blocks = []
+            for n in names:
+                if n not in cl["partitions"]:
+                    print(f"Partition {n} not found", file=sys.stderr)
+                    return 1
+            first = True
+            for n in names:
+                if not first:
+                    print()
+                _print_partition(n, cl["partitions"][n], cl["nodes"])
+                first = False
+            return 0
+        if what in ("nodes", "node"):
+            cl = cluster(root)
+            names = rest[0].split(",") if rest else list(cl["nodes"])
+            first = True
+            for n in names:
+                if n not in cl["nodes"]:
+                    print(f"Node {n} not found", file=sys.stderr)
+                    return 1
+                if not first:
+                    print()
+                cfg = dict(cl["nodes"][n])
+                for pname, part in cl["partitions"].items():
+                    if n in part["nodes"]:
+                        cfg["partition"] = pname
+                _print_node(n, cfg)
+                first = False
+            return 0
+    print(f"scontrol: unsupported: {argv}", file=sys.stderr)
+    return 1
+
+
+# ---------------------------------------------------------------- sacct
+
+
+def sacct(argv: list[str]) -> int:
+    root = state_dir()
+    job_id = None
+    for i, a in enumerate(argv):
+        if a == "-j" and i + 1 < len(argv):
+            job_id = int(argv[i + 1])
+    if job_id is None:
+        print("sacct: error: no -j", file=sys.stderr)
+        return 1
+    rec = _load_job(root, job_id)
+    if rec is None:
+        return 0  # sacct prints nothing for unknown jobs
+    state, exit_code = _job_state(root, rec)
+    end = "Unknown" if state == "RUNNING" else _now()
+    rc = exit_code.replace(":", ":")
+    print(f"{rec['start_time']}|{end}|{rc}|{state}|{job_id}|{rec['name']}|")
+    print(f"{rec['start_time']}|{end}|{rc}|{state}|{job_id}.batch|batch|")
+    return 0
+
+
+# ---------------------------------------------------------------- sinfo
+
+
+def sinfo(argv: list[str]) -> int:
+    if "-V" in argv:
+        print("slurm 23.02.1-fake")
+        return 0
+    print("sinfo: unsupported", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    prog = pathlib.Path(sys.argv[0]).name
+    fn = {"sbatch": sbatch, "scancel": scancel, "scontrol": scontrol,
+          "sacct": sacct, "sinfo": sinfo}.get(prog)
+    if fn is None:
+        print(f"fakeslurm: unknown prog {prog}", file=sys.stderr)
+        return 2
+    try:
+        return fn(sys.argv[1:])
+    except BrokenPipeError:
+        return 0  # downstream consumer (e.g. | head) closed early
+
+
+if __name__ == "__main__":
+    sys.exit(main())

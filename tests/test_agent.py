@@ -1,0 +1,364 @@
+"""Agent tests: the CLI driver against the fake Slurm PATH shim, the
+tailer, the YAML config, and the full gRPC server end-to-end — the
+hermetic exec-path coverage the reference lacks (SURVEY.md §4)."""
+
+import os
+import pathlib
+import time
+
+import grpc
+import pytest
+
+from slurm_bridge_tpu.agent import (
+    SlurmClient,
+    SlurmError,
+    WorkloadServicer,
+)
+from slurm_bridge_tpu.agent.config import parse_partition_config
+from slurm_bridge_tpu.agent.server import build_container_script
+from slurm_bridge_tpu.agent.tailer import TailReader, read_file_chunks
+from slurm_bridge_tpu.core.types import JobDemand, JobStatus
+from slurm_bridge_tpu.wire import ServiceClient, dial, pb, serve
+
+FAKESLURM = str(pathlib.Path(__file__).parent / "fakeslurm")
+
+
+@pytest.fixture
+def fake_slurm(tmp_path, monkeypatch):
+    """Put the fake slurm CLI on PATH with a fresh state dir."""
+    state = tmp_path / "slurm-state"
+    monkeypatch.setenv("SBT_FAKESLURM_STATE", str(state))
+    monkeypatch.setenv("PATH", FAKESLURM + os.pathsep + os.environ["PATH"])
+    return state
+
+
+@pytest.fixture
+def client(fake_slurm):
+    return SlurmClient()
+
+
+def _wait_state(client, job_id, state, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        infos = client.job_info(job_id)
+        if infos and infos[0].state == state:
+            return infos[0]
+        time.sleep(0.05)
+    raise AssertionError(f"job {job_id} never reached {state}")
+
+
+# ---------------------------------------------------------------- driver
+
+
+def test_submit_and_query(client):
+    demand = JobDemand(
+        partition="debug",
+        script="#!/bin/sh\necho out1\nsleep 0.3\necho out2\n",
+        job_name="t1",
+    )
+    job_id = client.submit(demand)
+    assert job_id >= 100
+    info = _wait_state(client, job_id, JobStatus.COMPLETED)
+    assert info.name == "t1"
+    assert info.partition == "debug"
+    assert pathlib.Path(info.std_out).read_text() == "out1\nout2\n"
+    steps = client.job_steps(job_id)
+    assert steps and steps[0].state == JobStatus.COMPLETED
+
+
+def test_submit_failing_script(client):
+    job_id = client.submit(JobDemand(partition="debug", script="#!/bin/sh\nexit 3\n"))
+    info = _wait_state(client, job_id, JobStatus.FAILED)
+    assert info.exit_code.startswith("3")
+
+
+def test_submit_bad_partition(client):
+    with pytest.raises(SlurmError) as ei:
+        client.submit(JobDemand(partition="nope", script="#!/bin/sh\ntrue\n"))
+    assert "invalid partition" in str(ei.value)
+
+
+def test_submit_empty_script(client):
+    with pytest.raises(SlurmError):
+        client.submit(JobDemand(partition="debug", script="   "))
+
+
+def test_cancel(client):
+    job_id = client.submit(
+        JobDemand(partition="debug", script="#!/bin/sh\nsleep 30\n")
+    )
+    _wait_state(client, job_id, JobStatus.RUNNING)
+    client.cancel(job_id)
+    _wait_state(client, job_id, JobStatus.CANCELLED)
+
+
+def test_partitions_and_nodes(client):
+    parts = client.partitions()
+    assert parts == ["debug", "gpu"]
+    p = client.partition("gpu")
+    assert p.total_nodes == 2 and p.max_time_s == 86400
+    nodes = client.nodes(p.nodes)
+    assert len(nodes) == 2
+    assert nodes[0].gpus == 4 and nodes[0].gpu_type == "a100"
+    assert client.version().startswith("slurm")
+
+
+def test_missing_binaries(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATH", str(tmp_path))
+    with pytest.raises(SlurmError) as ei:
+        SlurmClient()
+    assert "missing slurm binaries" in str(ei.value)
+
+
+def test_sbatch_args_no_duplicate_flags():
+    """Each option once (the reference emitted ntasks-per-node twice,
+    slurm.go:216-221)."""
+    d = JobDemand(partition="p", cpus_per_task=2, ntasks=4, ntasks_per_node=2,
+                  nodes=2, mem_per_cpu_mb=1024, array="0-3", job_name="x",
+                  gres="gpu:1", time_limit_s=7200, script="s")
+    args = SlurmClient.sbatch_args(d)
+    flags = [a for a in args if a.startswith("--")]
+    assert len(flags) == len(set(flags))
+    assert "--time" in flags and args[args.index("--time") + 1] == "120"
+
+
+# ---------------------------------------------------------------- tailer
+
+
+def test_tail_reader_follows_growth(tmp_path):
+    f = tmp_path / "grow.log"
+    f.write_text("a")
+    r = TailReader(str(f), poll_interval=0.01)
+    assert r.read_chunk() == b"a"
+    f.write_text("ab")
+    assert r.read_chunk() == b"b"
+    r.stop()
+    assert r.read_chunk() == b""
+    assert r.finished
+
+
+def test_tail_reader_truncation(tmp_path):
+    f = tmp_path / "rot.log"
+    f.write_text("12345")
+    r = TailReader(str(f), poll_interval=0.01)
+    assert r.read_chunk() == b"12345"
+    f.write_text("x")  # rotated/truncated
+    assert r.read_chunk() == b"x"
+
+
+def test_read_file_chunks(tmp_path):
+    f = tmp_path / "big.bin"
+    f.write_bytes(b"z" * 100_000)
+    chunks = list(read_file_chunks(str(f)))
+    assert b"".join(chunks) == b"z" * 100_000
+    assert len(chunks) > 1
+
+
+# ---------------------------------------------------------------- config
+
+
+def test_partition_config():
+    cfg = parse_partition_config(
+        """
+debug:
+  auto_nodes: true
+  cpu_per_node: 32
+  wall_time: "1-00:00:00"
+  additional_features: [avx512]
+gpu: {}
+"""
+    )
+    assert cfg["debug"].auto_nodes
+    assert cfg["debug"].cpu_per_node == 32
+    assert cfg["debug"].wall_time_s == 86400
+    assert cfg["debug"].additional_features == ("avx512",)
+    assert not cfg["gpu"].auto_nodes
+
+
+def test_partition_config_rejects_non_mapping():
+    with pytest.raises(ValueError):
+        parse_partition_config("- a\n- b\n")
+
+
+# ---------------------------------------------------------------- container
+
+
+def test_build_container_script():
+    req = pb.SubmitJobContainerRequest(
+        job=pb.SubmitJobRequest(job_name="c1", partition="debug", ntasks=2,
+                                cpus_per_task=2),
+        container=pb.SingularityOptions(
+            image="docker://alpine", binds=["/data:/data"], cleanenv=True,
+        ),
+    )
+    script = build_container_script(req)
+    assert script.startswith("#!/bin/sh\n")
+    assert "#SBATCH --job-name=c1" in script
+    assert "#SBATCH --ntasks=2" in script
+    assert "singularity run --cleanenv --bind /data:/data docker://alpine" in script
+
+
+def test_build_container_script_apps():
+    req = pb.SubmitJobContainerRequest(
+        job=pb.SubmitJobRequest(partition="p"),
+        container=pb.SingularityOptions(image="img.sif", apps=["a", "b"]),
+    )
+    script = build_container_script(req)
+    assert "singularity run --app a img.sif" in script
+    assert "singularity run --app b img.sif" in script
+
+
+# ---------------------------------------------------------------- gRPC e2e
+
+
+@pytest.fixture
+def agent_rpc(fake_slurm, tmp_path):
+    servicer = WorkloadServicer(
+        SlurmClient(),
+        ledger_file=str(tmp_path / "ledger.json"),
+        tail_poll_interval=0.02,
+    )
+    sock = str(tmp_path / "agent.sock")
+    server = serve({"WorkloadManager": servicer}, sock)
+    client = ServiceClient(dial(sock), "WorkloadManager")
+    yield client, servicer
+    client.close()
+    server.stop(None)
+
+
+def test_rpc_submit_info_state(agent_rpc):
+    client, _ = agent_rpc
+    resp = client.SubmitJob(
+        pb.SubmitJobRequest(script="#!/bin/sh\necho hi\n", partition="debug",
+                            submitter_id="pod-1")
+    )
+    assert resp.job_id >= 100
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        st = client.JobState(pb.JobStateRequest(job_id=resp.job_id))
+        if st.status == pb.COMPLETED:
+            break
+        time.sleep(0.05)
+    assert st.status == pb.COMPLETED
+    info = client.JobInfo(pb.JobInfoRequest(job_id=resp.job_id))
+    assert info.info[0].partition == "debug"
+    steps = client.JobSteps(pb.JobStepsRequest(job_id=resp.job_id))
+    assert len(steps.steps) == 2  # job + batch step
+
+
+def test_rpc_submit_dedupe(agent_rpc):
+    client, _ = agent_rpc
+    req = pb.SubmitJobRequest(script="#!/bin/sh\ntrue\n", partition="debug",
+                              submitter_id="pod-dedupe")
+    a = client.SubmitJob(req)
+    b = client.SubmitJob(req)
+    assert a.job_id == b.job_id
+
+
+def test_rpc_dedupe_survives_restart(fake_slurm, tmp_path):
+    ledger = str(tmp_path / "ledger.json")
+    req = pb.SubmitJobRequest(script="#!/bin/sh\ntrue\n", partition="debug",
+                              submitter_id="pod-persist")
+    sock = str(tmp_path / "a1.sock")
+    server = serve(
+        {"WorkloadManager": WorkloadServicer(SlurmClient(), ledger_file=ledger)}, sock
+    )
+    with ServiceClient(dial(sock), "WorkloadManager") as c:
+        first = c.SubmitJob(req).job_id
+    server.stop(None)
+    # "restarted" agent, fresh servicer, same ledger
+    sock2 = str(tmp_path / "a2.sock")
+    server2 = serve(
+        {"WorkloadManager": WorkloadServicer(SlurmClient(), ledger_file=ledger)}, sock2
+    )
+    with ServiceClient(dial(sock2), "WorkloadManager") as c:
+        again = c.SubmitJob(req).job_id
+    server2.stop(None)
+    assert again == first
+
+
+def test_rpc_open_file(agent_rpc, tmp_path):
+    client, _ = agent_rpc
+    f = tmp_path / "result.txt"
+    f.write_bytes(b"abc" * 1000)
+    data = b"".join(
+        c.content for c in client.OpenFile(pb.OpenFileRequest(path=str(f)))
+    )
+    assert data == b"abc" * 1000
+
+
+def test_rpc_open_file_missing(agent_rpc):
+    client, _ = agent_rpc
+    with pytest.raises(grpc.RpcError) as ei:
+        list(client.OpenFile(pb.OpenFileRequest(path="/no/such/file")))
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_rpc_tail_follow_then_drain(agent_rpc, tmp_path):
+    client, _ = agent_rpc
+    f = tmp_path / "tail.log"
+    f.write_text("start\n")
+
+    import threading
+
+    def writer():
+        time.sleep(0.15)
+        with open(f, "a") as fh:
+            fh.write("more\n")
+        time.sleep(0.15)
+
+    t = threading.Thread(target=writer)
+    t.start()
+
+    def requests():
+        yield pb.TailFileRequest(path=str(f), action=pb.FOLLOW)
+        time.sleep(0.5)
+        yield pb.TailFileRequest(path=str(f), action=pb.READ_TO_END_AND_CLOSE)
+
+    data = b"".join(c.content for c in client.TailFile(requests()))
+    t.join()
+    assert data == b"start\nmore\n"
+
+
+def test_rpc_resources_with_overrides(fake_slurm, tmp_path):
+    cfg = parse_partition_config(
+        "gpu:\n  auto_nodes: true\n  cpu_per_node: 48\n  additional_features: [a100]\n"
+    )
+    sock = str(tmp_path / "r.sock")
+    server = serve(
+        {"WorkloadManager": WorkloadServicer(SlurmClient(), partition_config=cfg)},
+        sock,
+    )
+    with ServiceClient(dial(sock), "WorkloadManager") as c:
+        r = c.Resources(pb.ResourcesRequest(partition="gpu"))
+        assert r.cpu_per_node == 48  # fixed override
+        assert r.nodes == 2  # auto from live partition
+        assert list(r.features) == ["a100"]
+    server.stop(None)
+
+
+def test_rpc_partitions_nodes_info(agent_rpc):
+    client, _ = agent_rpc
+    parts = client.Partitions(pb.PartitionsRequest())
+    assert list(parts.partitions) == ["debug", "gpu"]
+    p = client.Partition(pb.PartitionRequest(partition="debug"))
+    assert p.total_nodes == 4
+    nodes = client.Nodes(pb.NodesRequest(names=list(p.nodes)[:2]))
+    assert len(nodes.nodes) == 2 and nodes.nodes[0].cpus == 32
+    wi = client.WorkloadInfo(pb.WorkloadInfoRequest())
+    assert wi.name == "slurm" and wi.version.startswith("slurm") and wi.uid
+
+
+def test_rpc_cancel(agent_rpc):
+    client, _ = agent_rpc
+    resp = client.SubmitJob(
+        pb.SubmitJobRequest(script="#!/bin/sh\nsleep 30\n", partition="debug")
+    )
+    client.CancelJob(pb.CancelJobRequest(job_id=resp.job_id))
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        st = client.JobState(pb.JobStateRequest(job_id=resp.job_id))
+        if st.status == pb.CANCELLED:
+            break
+        time.sleep(0.05)
+    assert st.status == pb.CANCELLED

@@ -32,11 +32,14 @@ def _check_feasible(snapshot, batch, placement):
         rf = np.uint32(batch.req_features[s])
         assert (snapshot.features[nd] & rf) == rf, f"shard {s} missing features"
     assert np.all(used <= snapshot.free + 1e-3), "node oversubscribed"
-    # gangs all-or-nothing
+    # gangs: all-or-nothing AND distinct nodes (--nodes=K => K hosts)
     for g in np.unique(batch.gang_id):
         members = batch.gang_id == g
         flags = placement.placed[members]
         assert flags.all() or not flags.any(), f"gang {g} partially placed"
+        if flags.any() and members.sum() > 1:
+            nodes = placement.node_of[members]
+            assert len(set(nodes.tolist())) == len(nodes), f"gang {g} co-located"
 
 
 def _placed_count(placement):
@@ -303,3 +306,33 @@ def test_sharded_kernel_cached():
     k1 = _make_sharded_kernel(mesh, 4, 16, 0.5, 1.0, 0.25, jnp.float32)
     k2 = _make_sharded_kernel(mesh, 4, 16, 0.5, 1.0, 0.25, jnp.float32)
     assert k1 is k2
+
+
+def test_gang_ids_arbitrary_values():
+    """Slurm-style huge gang ids must be safe in every solver path."""
+    from slurm_bridge_tpu.solver.greedy_native import greedy_place_native
+
+    snap, batch = random_scenario(16, 40, seed=1, gang_fraction=0.3, gang_size=2)
+    batch.gang_id = (batch.gang_id.astype(np.int64) + 123456).astype(np.int32)
+    g = greedy_place(snap, batch)
+    n = greedy_place_native(snap, batch)
+    a = auction_place(snap, batch, AuctionConfig(rounds=8))
+    _check_feasible(snap, batch, g)
+    _check_feasible(snap, batch, n)
+    _check_feasible(snap, batch, a)
+
+
+def test_segmented_cumsum_precision():
+    """Large magnitudes must not leak across segments (float32 cumsum-minus-
+    base at 50k-shard scale would be off by tens of units)."""
+    import jax.numpy as jnp
+    from slurm_bridge_tpu.solver.auction import segmented_cumsum
+
+    p = 50_000
+    vals = np.full((p, 1), 20_000.0, np.float32)  # ~1e9 total
+    seg = np.zeros(p, bool)
+    seg[0] = True
+    seg[-2] = True  # last segment has exactly two rows
+    out = np.asarray(segmented_cumsum(jnp.asarray(vals), jnp.asarray(seg)))
+    assert out[-2, 0] == 20_000.0
+    assert out[-1, 0] == 40_000.0
