@@ -13,6 +13,14 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The image's sitecustomize may have imported jax already (pinning the
+# platform from the env before we could touch it) — override via config,
+# which works as long as no backend has been initialised yet.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
 import pathlib
 
 import pytest
