@@ -1,0 +1,57 @@
+"""The bridge control plane: API objects, object store, reconcilers.
+
+This layer reproduces the reference's Kubernetes-side machinery (SURVEY.md
+§2.2-§2.6) as a standalone in-process control plane: the `BridgeJob` object
+mirrors the `SlurmBridgeJob` CRD, `ObjectStore` stands in for the API
+server (optimistic concurrency + watches), and the operator / virtual-node
+/ scheduler / configurator / fetcher components reproduce the five call
+stacks of SURVEY.md §3 — with the per-pod `scontrol` hot loop replaced by
+one batched snapshot per scheduler tick fed to the JAX placement solver.
+"""
+
+from slurm_bridge_tpu.bridge.objects import (
+    BridgeJob,
+    FetchState,
+    JobState,
+    BridgeJobSpec,
+    BridgeJobStatus,
+    FetchJob,
+    Meta,
+    Pod,
+    PodPhase,
+    PodRole,
+    SubjobStatus,
+    ValidationError,
+    VirtualNode,
+    validate_bridge_job,
+)
+from slurm_bridge_tpu.bridge.store import (
+    Conflict,
+    NotFound,
+    ObjectStore,
+    StoreEvent,
+)
+
+from slurm_bridge_tpu.bridge.runtime import Bridge
+
+__all__ = [
+    "Bridge",
+    "BridgeJob",
+    "FetchState",
+    "JobState",
+    "BridgeJobSpec",
+    "BridgeJobStatus",
+    "Conflict",
+    "FetchJob",
+    "Meta",
+    "NotFound",
+    "ObjectStore",
+    "Pod",
+    "PodPhase",
+    "PodRole",
+    "StoreEvent",
+    "SubjobStatus",
+    "ValidationError",
+    "VirtualNode",
+    "validate_bridge_job",
+]

@@ -1,0 +1,95 @@
+"""Configurator — partition discovery → one virtual-node provider each.
+
+Reference parity: pkg/configurator/configurator.go. A ticker (default 30s,
+:94-118) lists partitions over the agent RPC, diffs them against the
+providers currently registered (the reference diffs against nodes labeled
+``type=slurm-agent-virtual-kubelet`` and creates/deletes one VK *pod* per
+partition, :120-184; here each partition gets an in-process
+:class:`VirtualNodeProvider` plus its sync ticker), and converges.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from slurm_bridge_tpu.bridge.controller import Ticker
+from slurm_bridge_tpu.bridge.store import ObjectStore
+from slurm_bridge_tpu.bridge.vnode import VirtualNodeProvider
+from slurm_bridge_tpu.obs.events import EventRecorder, Reason
+from slurm_bridge_tpu.wire import ServiceClient, pb
+
+log = logging.getLogger("sbt.configurator")
+
+DEFAULT_WATCH_INTERVAL_S = 30.0  # cmd/configurator/configurator.go:63
+
+
+class Configurator:
+    def __init__(
+        self,
+        store: ObjectStore,
+        client: ServiceClient,
+        *,
+        agent_endpoint: str = "",
+        events: EventRecorder | None = None,
+        watch_interval: float = DEFAULT_WATCH_INTERVAL_S,
+        node_sync_interval: float = 1.0,
+    ):
+        self.store = store
+        self.client = client
+        self.agent_endpoint = agent_endpoint
+        self.events = events or EventRecorder()
+        self.node_sync_interval = node_sync_interval
+        self.providers: dict[str, VirtualNodeProvider] = {}
+        self._tickers: dict[str, Ticker] = {}
+        self._watch = Ticker(watch_interval, self.reconcile, name="configurator")
+
+    def start(self) -> None:
+        self.reconcile()
+        self._watch.start()
+
+    def stop(self) -> None:
+        self._watch.stop()
+        for t in self._tickers.values():
+            t.stop()
+
+    def reconcile(self) -> None:
+        """Diff live partitions vs registered providers (:120-184)."""
+        live = set(self.client.Partitions(pb.PartitionsRequest()).partitions)
+        for partition in sorted(live - self.providers.keys()):
+            self._add_partition(partition)
+        for partition in sorted(self.providers.keys() - live):
+            self._remove_partition(partition)
+
+    def sync_now(self) -> None:
+        """Force one synchronous provider sync (tests/converge helpers)."""
+        for p in self.providers.values():
+            p.sync()
+
+    def _add_partition(self, partition: str) -> None:
+        provider = VirtualNodeProvider(
+            self.store,
+            self.client,
+            partition,
+            agent_endpoint=self.agent_endpoint,
+            events=self.events,
+        )
+        provider.register()
+        self.providers[partition] = provider
+        ticker = Ticker(
+            self.node_sync_interval, provider.sync, name=f"vnode-{partition}"
+        )
+        ticker.start()
+        self._tickers[partition] = ticker
+        log.info("partition %s: virtual node %s up", partition, provider.node_name)
+
+    def _remove_partition(self, partition: str) -> None:
+        ticker = self._tickers.pop(partition, None)
+        if ticker:
+            ticker.stop()
+        provider = self.providers.pop(partition, None)
+        if provider:
+            provider.deregister()
+            self.events.event(
+                None, Reason.NODE_GONE, f"partition {partition} removed", warning=True
+            )
+        log.info("partition %s: virtual node removed", partition)

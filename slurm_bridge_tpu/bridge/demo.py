@@ -1,0 +1,78 @@
+"""End-to-end demo: the minimum slice of SURVEY.md §7 step 4, runnable
+anywhere — starts an in-process agent against the fake Slurm shim (or a
+real Slurm if the binaries are on PATH and ``--real`` is passed), runs the
+full bridge loop, and walks one job from submit to fetched results.
+
+    python -m slurm_bridge_tpu.bridge.demo [--scheduler auction|greedy]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import sys
+import tempfile
+
+from slurm_bridge_tpu.bridge import Bridge, BridgeJobSpec
+from slurm_bridge_tpu.wire import serve
+
+_FAKESLURM = pathlib.Path(__file__).resolve().parents[2] / "tests" / "fakeslurm"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="sbt-demo")
+    ap.add_argument("--scheduler", choices=("auction", "greedy"), default="auction")
+    ap.add_argument(
+        "--real", action="store_true",
+        help="use the Slurm binaries already on PATH instead of the fake shim",
+    )
+    args = ap.parse_args(argv)
+
+    tmp = tempfile.mkdtemp(prefix="sbt-demo-")
+    if not args.real:
+        if not _FAKESLURM.is_dir():
+            print(f"fake slurm shim not found at {_FAKESLURM}", file=sys.stderr)
+            return 2
+        os.environ["SBT_FAKESLURM_STATE"] = os.path.join(tmp, "state")
+        os.environ["PATH"] = f"{_FAKESLURM}{os.pathsep}{os.environ['PATH']}"
+
+    from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
+
+    sock = os.path.join(tmp, "agent.sock")
+    server = serve(
+        {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
+        sock,
+    )
+    results = os.path.join(tmp, "results")
+    print(f"agent up on {sock}; scheduler={args.scheduler}")
+    with Bridge(
+        sock,
+        scheduler_backend=args.scheduler,
+        scheduler_interval=0.1,
+        node_sync_interval=0.1,
+    ) as bridge:
+        bridge.submit(
+            "demo",
+            BridgeJobSpec(
+                partition="debug",
+                sbatch_script="#!/bin/sh\n#SBATCH --cpus-per-task=2\necho hello-from-slurm\n",
+                result_to=results,
+            ),
+        )
+        job = bridge.wait("demo", timeout=120, fetch_done=True)
+        print(f"job state: {job.status.state}; fetch: {job.status.fetch_result}")
+        for key, sub in job.status.subjobs.items():
+            print(f"  subjob {key}: {sub.state.name} exit={sub.exit_code}")
+        logs = b"".join(bridge.logs("demo"))
+        print(f"logs: {logs!r}")
+        for f in sorted(os.listdir(results)):
+            print(f"result file {f}: {open(os.path.join(results, f), 'rb').read()!r}")
+    server.stop(None)
+    ok = job.status.state == "Succeeded"
+    print("demo", "OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,389 @@
+"""BridgeOperator — the BridgeJob reconciler.
+
+Reference parity: pkg/slurm-bridge-operator/slurmbridgejob_controller.go.
+The reconcile branches exactly as the reference's Reconcile (:104-159):
+validate → if finished, converge the result-fetch job; else ensure the
+sizecar pod, sync CR status from it, and maintain per-sub-job worker pods.
+
+Sizecar sizing (pod.go:18-68): parse ``#SBATCH`` headers out of the script
+(extractBatchResourcesFromScript, parse.go:30-124), let explicit spec
+fields override them, default 1 node / 1 cpu / 1024 MB-per-cpu
+(pod.go:91-95); cpu multiplies by ntasks × array length
+(genResourceListForPod :143-162).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from slurm_bridge_tpu.bridge.controller import Controller, Result
+from slurm_bridge_tpu.bridge.objects import (
+    BridgeJob,
+    ContainerStatus,
+    FetchFile,
+    FetchJob,
+    FetchState,
+    JobState,
+    Meta,
+    Pod,
+    PodPhase,
+    PodRole,
+    PodSpec,
+    PodStatus,
+    SubjobStatus,
+    ValidationError,
+    validate_bridge_job,
+)
+from slurm_bridge_tpu.bridge.statusmap import (
+    container_status_for,
+    job_state_for_pod_phase,
+)
+from slurm_bridge_tpu.bridge.store import AlreadyExists, NotFound, ObjectStore
+from slurm_bridge_tpu.core.arrays import array_len
+from slurm_bridge_tpu.core.sbatch import extract_batch_resources
+from slurm_bridge_tpu.core.types import JobDemand
+from slurm_bridge_tpu.obs.events import EventRecorder, Reason
+from slurm_bridge_tpu.obs.metrics import REGISTRY
+
+log = logging.getLogger("sbt.operator")
+
+RESULT_REQUEUE_S = 30.0  # result-poll requeue (slurmbridgejob_controller.go:141)
+
+_reconciles = REGISTRY.counter("sbt_operator_reconciles_total", "operator reconciles")
+
+
+def sizecar_name(job_name: str) -> str:
+    return f"{job_name}-sizecar"
+
+
+def worker_name(job_name: str) -> str:
+    return f"{job_name}-worker"
+
+
+def fetch_job_name(job_name: str) -> str:
+    return f"{job_name}-fetch"
+
+
+def demand_for_job(job: BridgeJob) -> JobDemand:
+    """Script #SBATCH headers, overridden by explicit spec fields, with the
+    reference defaults (pod.go:18-95)."""
+    hdr = extract_batch_resources(job.spec.sbatch_script).demand
+    spec = job.spec
+    return JobDemand(
+        partition=spec.partition or hdr.partition,
+        script=spec.sbatch_script,
+        job_name=job.meta.name,
+        run_as_user=spec.run_as_user,
+        run_as_group=spec.run_as_group,
+        array=spec.array or hdr.array,
+        cpus_per_task=spec.cpus_per_task or hdr.cpus_per_task or 1,
+        ntasks=spec.ntasks or hdr.ntasks or 1,
+        ntasks_per_node=spec.ntasks_per_node or hdr.ntasks_per_node,
+        nodes=spec.nodes or hdr.nodes or 1,
+        working_dir=spec.working_dir or hdr.working_dir,
+        mem_per_cpu_mb=spec.mem_per_cpu_mb or hdr.mem_per_cpu_mb or 1024,
+        gres=spec.gres or hdr.gres,
+        licenses=spec.licenses,
+        time_limit_s=hdr.time_limit_s,
+        priority=spec.priority,
+    )
+
+
+class BridgeOperator:
+    def __init__(
+        self,
+        store: ObjectStore,
+        *,
+        agent_endpoint: str = "",
+        events: EventRecorder | None = None,
+        workers: int = 1,
+    ):
+        self.store = store
+        self.agent_endpoint = agent_endpoint
+        self.events = events or EventRecorder()
+        self.controller = Controller(
+            name="bridge-operator", reconcile=self.reconcile, workers=workers
+        )
+
+    # ---- wiring ----
+
+    def start(self) -> None:
+        self.controller.start()
+        self._watch_q = self.store.watch((BridgeJob.KIND, Pod.KIND, FetchJob.KIND))
+        import threading
+
+        threading.Thread(target=self._pump_events, daemon=True).start()
+
+    def _pump_events(self) -> None:
+        """Map watch events to reconcile keys: BridgeJobs directly, owned
+        objects via their owner ref (SetupWithManager's Owns(&Pod{}),
+        slurmbridgejob_controller.go:204)."""
+        while True:
+            ev = self._watch_q.get()
+            if ev is None:
+                return
+            if ev.kind == BridgeJob.KIND:
+                self.controller.enqueue(ev.name)
+            else:
+                obj = self.store.try_get(ev.kind, ev.name)
+                owner = obj.meta.owner if obj is not None else self._owner_from_name(ev.name)
+                if owner:
+                    self.controller.enqueue(owner)
+
+    def _owner_from_name(self, obj_name: str) -> str:
+        for suffix in ("-sizecar", "-worker", "-fetch"):
+            if obj_name.endswith(suffix):
+                return obj_name[: -len(suffix)]
+        return ""
+
+    def stop(self) -> None:
+        if hasattr(self, "_watch_q"):
+            self.store.unwatch(self._watch_q)
+            self._watch_q.put(None)  # unblock the pump thread
+        self.controller.stop()
+
+    def enqueue(self, job_name: str) -> None:
+        self.controller.enqueue(job_name)
+
+    # ---- the reconcile ----
+
+    def reconcile(self, job_name: str) -> Result | None:
+        _reconciles.inc()
+        job = self.store.try_get(BridgeJob.KIND, job_name)
+        if job is None or job.meta.deleted:
+            return None
+        try:
+            validate_bridge_job(job)
+        except ValidationError as e:
+            self._set_state(job_name, JobState.FAILED, reason=str(e))
+            self.events.event(job, Reason.JOB_FAILED, str(e), warning=True)
+            return None
+
+        if job.finished:
+            return self._reconcile_result(job)
+        self._reconcile_sizecar(job)
+        self._sync_status(job_name)
+        self._reconcile_worker(job_name)
+        return None
+
+    # ---- sizecar (ReconcileSizeCarPods, :296-319) ----
+
+    def _reconcile_sizecar(self, job: BridgeJob) -> None:
+        name = sizecar_name(job.meta.name)
+        if self.store.try_get(Pod.KIND, name) is not None:
+            return
+        if job.status.subjobs:
+            # pod vanished but sub-jobs exist ⇒ Failed (:296-303)
+            self._set_state(
+                job.meta.name, JobState.FAILED, reason="sizecar pod disappeared"
+            )
+            return
+        demand = demand_for_job(job)
+        arr = array_len(demand.array)
+        pod = Pod(
+            meta=Meta(
+                name=name,
+                owner=job.meta.name,
+                labels={
+                    "role": PodRole.SIZECAR,
+                    "partition": demand.partition,
+                    # resource-request labels (pod.go:164-187)
+                    "request-cpu": str(demand.total_cpus(arr)),
+                    "request-memory-mb": str(demand.total_mem_mb(arr)),
+                },
+            ),
+            spec=PodSpec(
+                role=PodRole.SIZECAR, partition=demand.partition, demand=demand
+            ),
+            status=PodStatus(phase=PodPhase.PENDING),
+        )
+        try:
+            self.store.create(pod)
+        except AlreadyExists:
+            return
+        self.events.event(job, Reason.POD_CREATED, f"sizecar pod {name} created")
+
+    # ---- status sync (UpdateSBJStatus, :246-294) ----
+
+    def _sync_status(self, job_name: str) -> None:
+        pod = self.store.try_get(Pod.KIND, sizecar_name(job_name))
+        if pod is None:
+            return
+        state = job_state_for_pod_phase(pod.status.phase)
+        subjobs = {
+            info.key(): SubjobStatus.from_job_info(info)
+            for info in pod.status.job_infos
+        }
+
+        def record(job: BridgeJob):
+            changed = False
+            if subjobs and job.status.subjobs != subjobs:
+                job.status.subjobs = subjobs
+                changed = True
+            new_state = state
+            # don't regress a terminal CR state on a stale pod read
+            if job.status.state in JobState.TERMINAL:
+                new_state = job.status.state
+            if job.status.state != new_state:
+                job.status.state = new_state
+                changed = True
+            reason = pod.status.reason
+            if reason and job.status.reason != reason:
+                job.status.reason = reason
+                changed = True
+            if self.agent_endpoint and not job.status.cluster_endpoint:
+                job.status.cluster_endpoint = self.agent_endpoint
+                changed = True
+            return changed  # False skips the write (no self-feeding watch loop)
+
+        try:
+            before = self.store.get(BridgeJob.KIND, job_name)
+            after = self.store.mutate(BridgeJob.KIND, job_name, record)
+        except NotFound:
+            return
+        if before.status.state != after.status.state:
+            reason_map = {
+                JobState.RUNNING: Reason.JOB_RUNNING,
+                JobState.SUCCEEDED: Reason.JOB_SUCCEEDED,
+                JobState.FAILED: Reason.JOB_FAILED,
+            }
+            r = reason_map.get(after.status.state)
+            if r:
+                self.events.event(
+                    after, r, f"state {before.status.state} -> {after.status.state}",
+                    warning=after.status.state == JobState.FAILED,
+                )
+            # a just-finished job with a result request needs another pass
+            if after.finished:
+                self.controller.enqueue(job_name)
+
+    # ---- worker pods (ReconcileWorkerPods, :365-451) ----
+
+    def _reconcile_worker(self, job_name: str) -> None:
+        job = self.store.try_get(BridgeJob.KIND, job_name)
+        if job is None or not job.status.subjobs:
+            return
+        sizecar = self.store.try_get(Pod.KIND, sizecar_name(job_name))
+        containers = [
+            container_status_for(info)
+            for info in (sizecar.status.job_infos if sizecar else [])
+        ]
+        name = worker_name(job_name)
+        existing = self.store.try_get(Pod.KIND, name)
+        if existing is None:
+            pod = Pod(
+                meta=Meta(
+                    name=name,
+                    owner=job_name,
+                    labels={"role": PodRole.WORKER, "partition": job.spec.partition},
+                ),
+                spec=PodSpec(
+                    role=PodRole.WORKER,
+                    partition=job.spec.partition,
+                    node_name=sizecar.spec.node_name if sizecar else "",
+                ),
+                status=PodStatus(
+                    phase=sizecar.status.phase if sizecar else PodPhase.PENDING,
+                    containers=containers,
+                ),
+            )
+            try:
+                self.store.create(pod)
+            except AlreadyExists:
+                pass
+            return
+
+        def refresh(p: Pod):
+            phase = sizecar.status.phase if sizecar else p.status.phase
+            if p.status.containers == containers and p.status.phase == phase:
+                return False
+            p.status.containers = containers
+            p.status.phase = phase
+
+        try:
+            self.store.mutate(Pod.KIND, name, refresh)
+        except NotFound:
+            pass
+
+    # ---- results (ReconcileSlurmBridgeJobResult, :321-361 + result.go) ----
+
+    def _reconcile_result(self, job: BridgeJob) -> Result | None:
+        # fetch for ANY terminal state: a failed job's stdout is exactly what
+        # the user wants back (the reference keys only on "finished",
+        # slurmbridgejob_controller.go:131-141)
+        if not job.spec.result_to or job.status.state not in JobState.TERMINAL:
+            return None
+        if job.status.fetch_result in (FetchState.SUCCEEDED, FetchState.FAILED):
+            return None
+        name = fetch_job_name(job.meta.name)
+        fetch = self.store.try_get(FetchJob.KIND, name)
+        if fetch is None:
+            files = [
+                FetchFile(
+                    remote_path=sub.std_out,
+                    local_path=os.path.join(
+                        job.spec.result_to, f"{job.meta.name}-{key}.out"
+                    ),
+                )
+                for key, sub in sorted(job.status.subjobs.items())
+                if sub.std_out
+            ]
+            if not files:
+                self._set_fetch_state(job.meta.name, FetchState.FAILED,
+                                      reason="no stdout paths to fetch")
+                return None
+            fetch = FetchJob(
+                meta=Meta(name=name, owner=job.meta.name),
+                files=files,
+                agent_endpoint=self.agent_endpoint,
+                state=FetchState.PENDING,
+            )
+            try:
+                self.store.create(fetch)
+            except AlreadyExists:
+                pass
+            self._set_fetch_state(job.meta.name, FetchState.PENDING)
+            self.events.event(job, Reason.RESULT_FETCH_STARTED,
+                              f"fetching {len(files)} file(s)")
+            return Result(requeue_after=RESULT_REQUEUE_S)
+        # poll the fetch job's state (FetchResultStatus :349-361)
+        if fetch.state in (FetchState.SUCCEEDED, FetchState.FAILED):
+            self._set_fetch_state(job.meta.name, fetch.state, reason=fetch.reason)
+            self.events.event(
+                job,
+                Reason.RESULT_FETCH_DONE
+                if fetch.state == FetchState.SUCCEEDED
+                else Reason.RESULT_FETCH_FAILED,
+                fetch.reason or "result fetch finished",
+                warning=fetch.state == FetchState.FAILED,
+            )
+            return None
+        return Result(requeue_after=RESULT_REQUEUE_S)
+
+    # ---- helpers ----
+
+    def _set_state(self, job_name: str, state: str, *, reason: str = "") -> None:
+        def record(job: BridgeJob):
+            if job.status.state == state and job.status.reason == reason:
+                return False
+            job.status.state = state
+            job.status.reason = reason
+
+        try:
+            self.store.mutate(BridgeJob.KIND, job_name, record)
+        except NotFound:
+            pass
+
+    def _set_fetch_state(self, job_name: str, state: str, *, reason: str = "") -> None:
+        def record(job: BridgeJob):
+            if job.status.fetch_result == state:
+                return False
+            job.status.fetch_result = state
+            if reason:
+                job.status.reason = reason
+
+        try:
+            self.store.mutate(BridgeJob.KIND, job_name, record)
+        except NotFound:
+            pass

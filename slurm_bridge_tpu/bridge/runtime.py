@@ -1,0 +1,184 @@
+"""Bridge runtime — wires operator + configurator + scheduler + fetcher.
+
+The reference runs five binaries (SURVEY.md §1); this facade runs their
+equivalents as one process against a remote agent endpoint:
+
+- :class:`BridgeOperator`   ↔ bridge-operator manager
+- :class:`Configurator`     ↔ configurator daemon (spawning VK providers)
+- :class:`PlacementScheduler` ↔ kube-scheduler's role, solver-backed
+- :class:`FetchWorker`      ↔ the result-fetcher batch jobs
+
+``submit()`` / ``wait()`` / ``logs()`` / ``cancel()`` give the kubectl-
+shaped user surface (apply CR, watch status, logs -f, delete CR).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from slurm_bridge_tpu.bridge.configurator import Configurator
+from slurm_bridge_tpu.bridge.controller import Ticker
+from slurm_bridge_tpu.bridge.fetcher import FetchWorker
+from slurm_bridge_tpu.bridge.objects import (
+    BridgeJob,
+    BridgeJobSpec,
+    FetchState,
+    JobState,
+    Meta,
+    Pod,
+    validate_bridge_job,
+)
+from slurm_bridge_tpu.bridge.operator import BridgeOperator, sizecar_name
+from slurm_bridge_tpu.bridge.scheduler import PlacementScheduler
+from slurm_bridge_tpu.bridge.store import NotFound, ObjectStore
+from slurm_bridge_tpu.obs.events import EventRecorder
+from slurm_bridge_tpu.solver.auction import AuctionConfig
+from slurm_bridge_tpu.wire import ServiceClient, dial
+
+log = logging.getLogger("sbt.bridge")
+
+
+class Bridge:
+    def __init__(
+        self,
+        agent_endpoint: str,
+        *,
+        scheduler_backend: str = "auction",
+        auction_config: AuctionConfig | None = None,
+        scheduler_interval: float = 0.2,
+        configurator_interval: float = 30.0,
+        node_sync_interval: float = 0.25,
+        operator_workers: int = 2,
+    ):
+        self.agent_endpoint = agent_endpoint
+        self.store = ObjectStore()
+        self.events = EventRecorder()
+        self.channel = dial(agent_endpoint)
+        self.client = ServiceClient(self.channel, "WorkloadManager")
+        self.operator = BridgeOperator(
+            self.store,
+            agent_endpoint=agent_endpoint,
+            events=self.events,
+            workers=operator_workers,
+        )
+        self.configurator = Configurator(
+            self.store,
+            self.client,
+            agent_endpoint=agent_endpoint,
+            events=self.events,
+            watch_interval=configurator_interval,
+            node_sync_interval=node_sync_interval,
+        )
+        self.scheduler = PlacementScheduler(
+            self.store,
+            self.client,
+            backend=scheduler_backend,
+            auction_config=auction_config,
+            events=self.events,
+        )
+        self._sched_ticker = Ticker(
+            scheduler_interval, self.scheduler.tick, name="scheduler"
+        )
+        self.fetch_worker = FetchWorker(self.store, self.client)
+        self._started = False
+
+    # ---- lifecycle ----
+
+    def start(self) -> "Bridge":
+        self.configurator.start()
+        self.operator.start()
+        self._sched_ticker.start()
+        self.fetch_worker.start()
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self._sched_ticker.stop()
+        self.configurator.stop()
+        self.operator.stop()
+        self.fetch_worker.stop()
+        self.client.close()
+        self._started = False
+
+    def __enter__(self) -> "Bridge":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- user surface (the kubectl shape) ----
+
+    def submit(self, name: str, spec: BridgeJobSpec) -> BridgeJob:
+        job = BridgeJob(meta=Meta(name=name), spec=spec)
+        validate_bridge_job(job)
+        created = self.store.create(job)
+        self.operator.enqueue(name)
+        return created
+
+    def get(self, name: str) -> BridgeJob:
+        return self.store.get(BridgeJob.KIND, name)
+
+    def list(self) -> list[BridgeJob]:
+        return self.store.list(BridgeJob.KIND)
+
+    def cancel(self, name: str) -> None:
+        """Delete the CR: mark pods deleted so providers cancel their jobs,
+        then drop the job object (cascade takes the rest)."""
+        for pod in self.store.owned_by(Pod.KIND, name):
+            def mark(p: Pod):
+                p.meta.deleted = True
+
+            try:
+                self.store.mutate(Pod.KIND, pod.name, mark)
+            except NotFound:
+                pass
+        # providers cancel + delete marked pods on their next sync
+        self.configurator.sync_now()
+        try:
+            self.store.delete(BridgeJob.KIND, name)
+        except NotFound:
+            pass
+
+    def wait(
+        self,
+        name: str,
+        *,
+        timeout: float = 60.0,
+        until: tuple[str, ...] = JobState.TERMINAL,
+        fetch_done: bool = False,
+    ) -> BridgeJob:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            job = self.get(name)
+            if job.status.state in until:
+                if not fetch_done or not job.spec.result_to or job.status.fetch_result in (
+                    FetchState.SUCCEEDED,
+                    FetchState.FAILED,
+                ):
+                    return job
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"job {name} did not reach {until} in {timeout}s "
+            f"(state={self.get(name).status.state})"
+        )
+
+    def logs(self, name: str, *, follow: bool = False):
+        """Stream the job's stdout via its partition provider
+        (kubectl logs shape, §3.4)."""
+        pod = self.store.get(Pod.KIND, sizecar_name(name))
+        provider = self.configurator.providers.get(pod.spec.partition)
+        if provider is None:
+            raise NotFound(f"no provider for partition {pod.spec.partition!r}")
+        return provider.pod_logs(pod.name, follow=follow)
+
+    def converge_once(self) -> None:
+        """Drive one full control loop synchronously (tests; also handy for
+        batch usage without background tickers)."""
+        self.configurator.reconcile()
+        self.scheduler.tick()
+        self.configurator.sync_now()
+        for job in self.list():
+            self.operator.enqueue(job.meta.name)

@@ -1,0 +1,183 @@
+"""Placement scheduler — binds pending sizecar pods to virtual nodes.
+
+This is the rebuilt placement path (SURVEY.md §7): where the reference
+leaves placement to the kube-scheduler (one decision per pod, partition
+node-affinity pod.go:109-141) and then pays one `scontrol` exec per pod per
+status tick, this scheduler takes ONE batched snapshot of the whole node
+inventory per tick, lowers the entire pending queue into dense matrices,
+and solves the assignment with the JAX auction kernel (or the greedy packer
+behind ``backend="greedy"`` — the reference-parity path kept intact per
+BASELINE.md's north star).
+
+A placed job's pod is bound to its partition's virtual node; the exact
+Slurm nodes the solver chose ride along as ``spec.placement_hint`` (the
+agent may pass them to ``sbatch --nodelist``; Slurm remains the final
+arbiter). Unplaceable pods stay Pending with reason ``Unschedulable`` and
+are retried next tick.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from slurm_bridge_tpu.bridge.objects import (
+    Pod,
+    PodPhase,
+    PodRole,
+    VirtualNode,
+    partition_node_name,
+)
+from slurm_bridge_tpu.bridge.store import NotFound, ObjectStore
+from slurm_bridge_tpu.core.types import JobDemand, NodeInfo, PartitionInfo
+from slurm_bridge_tpu.obs.events import EventRecorder, Reason
+from slurm_bridge_tpu.obs.metrics import REGISTRY
+from slurm_bridge_tpu.solver import AuctionConfig, auction_place, greedy_place
+from slurm_bridge_tpu.solver.snapshot import encode_cluster, encode_jobs
+from slurm_bridge_tpu.wire import ServiceClient, pb
+from slurm_bridge_tpu.wire.convert import node_from_proto, partition_from_proto
+
+log = logging.getLogger("sbt.scheduler")
+
+_tick_seconds = REGISTRY.histogram(
+    "sbt_scheduler_tick_seconds", "placement solve wall time per tick"
+)
+_pods_placed = REGISTRY.counter("sbt_scheduler_pods_placed_total", "pods bound")
+_pods_unplaced = REGISTRY.gauge(
+    "sbt_scheduler_pods_unschedulable", "pods left pending after last tick"
+)
+
+
+class PlacementScheduler:
+    def __init__(
+        self,
+        store: ObjectStore,
+        client: ServiceClient,
+        *,
+        backend: str = "auction",
+        auction_config: AuctionConfig | None = None,
+        events: EventRecorder | None = None,
+    ):
+        if backend not in ("auction", "greedy"):
+            raise ValueError(f"unknown scheduler backend {backend!r}")
+        self.store = store
+        self.client = client
+        self.backend = backend
+        self.auction_config = auction_config or AuctionConfig()
+        self.events = events or EventRecorder()
+
+    # ---- inventory ----
+
+    def cluster_state(self) -> tuple[list[PartitionInfo], list[NodeInfo]]:
+        """One batched inventory query: every partition, every node, in two
+        RPC round-trips — not one exec per pod (SURVEY.md §3.2)."""
+        names = list(self.client.Partitions(pb.PartitionsRequest()).partitions)
+        partitions = [
+            partition_from_proto(self.client.Partition(pb.PartitionRequest(partition=n)))
+            for n in names
+        ]
+        seen: set[str] = set()
+        node_names: list[str] = []
+        for p in partitions:
+            for n in p.nodes:
+                if n not in seen:
+                    seen.add(n)
+                    node_names.append(n)
+        nodes = [
+            node_from_proto(m)
+            for m in self.client.Nodes(pb.NodesRequest(names=node_names)).nodes
+        ]
+        return partitions, nodes
+
+    # ---- the solve tick ----
+
+    def pending_pods(self) -> list[Pod]:
+        return [
+            p
+            for p in self.store.list(Pod.KIND)
+            if p.spec.role == PodRole.SIZECAR
+            and not p.spec.node_name
+            and not p.meta.deleted
+            and p.status.phase == PodPhase.PENDING
+        ]
+
+    def tick(self) -> int:
+        """Solve one placement round; returns the number of pods bound."""
+        pods = self.pending_pods()
+        if not pods:
+            _pods_unplaced.set(0)
+            return 0
+        t0 = time.perf_counter()
+        partitions, nodes = self.cluster_state()
+        snapshot = encode_cluster(nodes, partitions)
+        demands: list[JobDemand] = []
+        for pod in pods:
+            d = pod.spec.demand or JobDemand(partition=pod.spec.partition)
+            demands.append(d)
+        batch = encode_jobs(demands, snapshot)
+        if self.backend == "greedy":
+            placement = greedy_place(snapshot, batch)
+        else:
+            placement = auction_place(snapshot, batch, self.auction_config)
+        by_job = placement.by_job(batch)
+
+        ready_nodes = {
+            vn.partition
+            for vn in self.store.list(VirtualNode.KIND)
+            if vn.ready and not vn.meta.deleted
+        }
+        placed = 0
+        for j, pod in enumerate(pods):
+            node_idxs = by_job.get(j)
+            partition = demands[j].partition
+            if node_idxs and partition in ready_nodes:
+                hint = tuple(snapshot.node_names[i] for i in node_idxs)
+                if self._bind(pod, partition_node_name(partition), hint):
+                    placed += 1
+            else:
+                reason = (
+                    "Unschedulable: insufficient capacity"
+                    if partition in ready_nodes
+                    else f"Unschedulable: no ready virtual node for partition {partition!r}"
+                )
+                self._mark_unschedulable(pod, reason)
+        _tick_seconds.observe(time.perf_counter() - t0)
+        _pods_placed.inc(placed)
+        _pods_unplaced.set(len(pods) - placed)
+        return placed
+
+    def _bind(self, pod: Pod, node_name: str, hint: tuple[str, ...]) -> bool:
+        bound = [False]
+        try:
+
+            def record(p: Pod):
+                bound[0] = False
+                if p.spec.node_name or p.meta.deleted:
+                    return False  # someone else bound or deleted it
+                p.spec.node_name = node_name
+                p.spec.placement_hint = hint
+                p.status.reason = ""
+                bound[0] = True
+
+            self.store.mutate(Pod.KIND, pod.name, record)
+        except NotFound:
+            return False
+        if not bound[0]:
+            return False
+        self.events.event(
+            pod, Reason.PLACEMENT_OK, f"bound to {node_name} (nodes {','.join(hint)})"
+        )
+        return True
+
+    def _mark_unschedulable(self, pod: Pod, reason: str) -> None:
+        try:
+
+            def record(p: Pod):
+                if p.status.reason == reason:
+                    return False
+                p.status.reason = reason
+
+            self.store.mutate(Pod.KIND, pod.name, record)
+        except NotFound:
+            return
+        self.events.event(pod, Reason.PLACEMENT_FAILED, reason, warning=True)

@@ -1,0 +1,177 @@
+"""In-process object store — the API-server seam of the control plane.
+
+The reference's controllers converge on the K8s API server: optimistic
+concurrency via resourceVersion, label-selector lists, watches feeding
+level-triggered reconcilers, owner references for cascade behavior.
+This store reproduces exactly that contract in-process so every bridge
+component keeps the reference's architecture (SURVEY.md §3 call stacks)
+while the framework runs standalone. Swapping this for a real kube client
+retargets the bridge at an actual cluster — the interface is the seam.
+
+Objects are stored by (kind, name). Writers must pass the object they last
+read; a stale ``meta.resource_version`` raises :class:`Conflict`, same as
+a 409 from the API server (controllers retry via requeue).
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from dataclasses import dataclass
+
+
+class NotFound(KeyError):
+    pass
+
+
+class Conflict(RuntimeError):
+    pass
+
+
+class AlreadyExists(RuntimeError):
+    pass
+
+
+@dataclass(frozen=True)
+class StoreEvent:
+    """ADDED | MODIFIED | DELETED, like a watch event."""
+
+    type: str
+    kind: str
+    name: str
+
+
+class ObjectStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: dict[tuple[str, str], object] = {}
+        self._rv = 0
+        self._watchers: list[tuple[queue.Queue, tuple[str, ...] | None]] = []
+
+    # ---- plumbing ----
+
+    def _key(self, obj) -> tuple[str, str]:
+        return (obj.KIND, obj.meta.name)
+
+    def _notify(self, etype: str, kind: str, name: str) -> None:
+        for q, kinds in list(self._watchers):
+            if kinds is None or kind in kinds:
+                q.put(StoreEvent(etype, kind, name))
+
+    def watch(self, kinds: tuple[str, ...] | None = None) -> queue.Queue:
+        """A queue of StoreEvents for the given kinds (None = all).
+
+        New watchers receive synthetic ADDED events for existing objects so
+        level-triggered consumers converge from any start time.
+        """
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            for (kind, name) in self._objects:
+                if kinds is None or kind in kinds:
+                    q.put(StoreEvent("ADDED", kind, name))
+            self._watchers.append((q, kinds))
+        return q
+
+    def unwatch(self, q: queue.Queue) -> None:
+        with self._lock:
+            self._watchers = [(w, k) for (w, k) in self._watchers if w is not q]
+
+    # ---- CRUD ----
+
+    def create(self, obj) -> object:
+        with self._lock:
+            key = self._key(obj)
+            if key in self._objects:
+                raise AlreadyExists(f"{key[0]}/{key[1]} already exists")
+            self._rv += 1
+            obj.meta.resource_version = self._rv
+            stored = copy.deepcopy(obj)
+            self._objects[key] = stored
+            self._notify("ADDED", *key)
+        return copy.deepcopy(stored)
+
+    def get(self, kind: str, name: str) -> object:
+        with self._lock:
+            try:
+                return copy.deepcopy(self._objects[(kind, name)])
+            except KeyError:
+                raise NotFound(f"{kind}/{name}") from None
+
+    def try_get(self, kind: str, name: str):
+        try:
+            return self.get(kind, name)
+        except NotFound:
+            return None
+
+    def update(self, obj) -> object:
+        """Replace; raises Conflict if the caller's copy is stale."""
+        with self._lock:
+            key = self._key(obj)
+            current = self._objects.get(key)
+            if current is None:
+                raise NotFound(f"{key[0]}/{key[1]}")
+            if current.meta.resource_version != obj.meta.resource_version:
+                raise Conflict(
+                    f"{key[0]}/{key[1]}: stale resource_version "
+                    f"{obj.meta.resource_version} != {current.meta.resource_version}"
+                )
+            self._rv += 1
+            obj.meta.resource_version = self._rv
+            stored = copy.deepcopy(obj)
+            self._objects[key] = stored
+            self._notify("MODIFIED", *key)
+        return copy.deepcopy(stored)
+
+    def delete(self, kind: str, name: str) -> None:
+        """Delete an object and cascade to objects it owns (owner refs)."""
+        with self._lock:
+            if (kind, name) not in self._objects:
+                raise NotFound(f"{kind}/{name}")
+            del self._objects[(kind, name)]
+            self._notify("DELETED", kind, name)
+            owned = [
+                k
+                for k, o in self._objects.items()
+                if getattr(o.meta, "owner", "") == name
+            ]
+            for okind, oname in owned:
+                del self._objects[(okind, oname)]
+                self._notify("DELETED", okind, oname)
+
+    def list(self, kind: str, *, labels: dict[str, str] | None = None) -> list:
+        with self._lock:
+            out = []
+            for (k, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                if labels and any(
+                    obj.meta.labels.get(lk) != lv for lk, lv in labels.items()
+                ):
+                    continue
+                out.append(copy.deepcopy(obj))
+        out.sort(key=lambda o: o.meta.name)
+        return out
+
+    def owned_by(self, kind: str, owner: str) -> list:
+        with self._lock:
+            return [
+                copy.deepcopy(o)
+                for (k, _), o in self._objects.items()
+                if k == kind and o.meta.owner == owner
+            ]
+
+    # ---- convenience used by reconcilers ----
+
+    def mutate(self, kind: str, name: str, fn, *, retries: int = 8):
+        """Read-modify-write with conflict retry; fn mutates in place and
+        may return False to skip the write."""
+        for _ in range(retries):
+            obj = self.get(kind, name)
+            if fn(obj) is False:
+                return obj
+            try:
+                return self.update(obj)
+            except Conflict:
+                continue
+        raise Conflict(f"{kind}/{name}: too many conflicts")

@@ -1,0 +1,282 @@
+"""Virtual node provider — one partition mirrored as a schedulable node.
+
+Reference parity: pkg/slurm-virtual-kubelet/. One provider per partition
+(the configurator's horizontal sharding, SURVEY.md §2.9) that:
+
+- registers a node whose capacity is the summed live partition inventory
+  (node.go:18-52, GetPartitionCapacity :169-199 — fixing the reference's
+  ``allogpu += node.AlloCpus`` bug :189);
+- intercepts sizecar pods bound to it and submits them to Slurm with the
+  pod UID as the idempotency token (provider.go:35-60, :414-434);
+- converts live job state into pod status each sync (provider.go:195-219,
+  status.go) — via the typed ``PodStatus.job_infos`` field instead of the
+  JSON-in-Status.Message side-channel;
+- cancels all owned jobs on pod deletion (provider.go:156-181);
+- streams job logs: TailFile while running+follow, OpenFile otherwise
+  (provider.go:246-302, reader.go).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Iterator
+
+import grpc
+
+from slurm_bridge_tpu.bridge.objects import (
+    Meta,
+    NodeCondition,
+    Pod,
+    PodPhase,
+    PodRole,
+    VirtualNode,
+    partition_node_name,
+)
+from slurm_bridge_tpu.bridge.statusmap import pod_phase_for
+from slurm_bridge_tpu.bridge.store import NotFound, ObjectStore
+from slurm_bridge_tpu.core.types import JobInfo, JobStatus, NodeInfo, PartitionInfo
+from slurm_bridge_tpu.obs.events import EventRecorder, Reason
+from slurm_bridge_tpu.wire import ServiceClient, pb
+from slurm_bridge_tpu.wire.convert import (
+    demand_to_submit,
+    job_info_from_proto,
+    node_from_proto,
+    partition_from_proto,
+)
+
+log = logging.getLogger("sbt.vnode")
+
+
+class VirtualNodeProvider:
+    def __init__(
+        self,
+        store: ObjectStore,
+        client: ServiceClient,
+        partition: str,
+        *,
+        agent_endpoint: str = "",
+        events: EventRecorder | None = None,
+        inventory_ttl: float = 5.0,
+    ):
+        self.store = store
+        self.client = client
+        self.partition = partition
+        self.node_name = partition_node_name(partition)
+        self.agent_endpoint = agent_endpoint
+        self.events = events or EventRecorder()
+        self.inventory_ttl = inventory_ttl
+        self._inv_lock = threading.Lock()
+        self._inv: tuple[float, PartitionInfo, list[NodeInfo]] | None = None
+
+    # ---- inventory / capacity ----
+
+    def inventory(self, *, max_age: float | None = None) -> tuple[PartitionInfo, list[NodeInfo]]:
+        """Live (partition, nodes) via Partition + Nodes RPC, cached briefly
+        so the capacity advertiser and scheduler share one query per tick
+        (the batched-snapshot fix for SURVEY.md §3.2's per-pod exec)."""
+        ttl = self.inventory_ttl if max_age is None else max_age
+        with self._inv_lock:
+            if self._inv is not None and time.monotonic() - self._inv[0] < ttl:
+                return self._inv[1], self._inv[2]
+        part = partition_from_proto(
+            self.client.Partition(pb.PartitionRequest(partition=self.partition))
+        )
+        nodes = [
+            node_from_proto(n)
+            for n in self.client.Nodes(pb.NodesRequest(names=list(part.nodes))).nodes
+        ]
+        with self._inv_lock:
+            self._inv = (time.monotonic(), part, nodes)
+        return part, nodes
+
+    def capacity(self) -> tuple[dict[str, float], dict[str, float]]:
+        """(capacity, allocatable) summed over member nodes
+        (GetPartitionCapacity node.go:169-199)."""
+        _, nodes = self.inventory()
+        cap = {"cpu": 0.0, "memory_mb": 0.0, "gpu": 0.0, "pods": 0.0}
+        free = {"cpu": 0.0, "memory_mb": 0.0, "gpu": 0.0, "pods": 0.0}
+        for n in nodes:
+            cap["cpu"] += n.cpus
+            cap["memory_mb"] += n.memory_mb
+            cap["gpu"] += n.gpus
+            free["cpu"] += n.free_cpus
+            free["memory_mb"] += n.free_memory_mb
+            free["gpu"] += n.free_gpus
+        # reference: pods capacity = cpu count (node.go:197)
+        cap["pods"] = cap["cpu"]
+        free["pods"] = free["cpu"]
+        return cap, free
+
+    def register(self) -> VirtualNode:
+        """Create or refresh the VirtualNode object (the NodeController's
+        create-on-404 handler, virtual-kubelet.go:281-292)."""
+        cap, free = self.capacity()
+        existing = self.store.try_get(VirtualNode.KIND, self.node_name)
+        if existing is None:
+            node = VirtualNode(
+                meta=Meta(
+                    name=self.node_name,
+                    labels={"type": "virtual-kubelet", "partition": self.partition},
+                ),
+                partition=self.partition,
+                capacity=cap,
+                allocatable=free,
+                conditions=[NodeCondition(type="Ready", status=True)],
+                heartbeat=time.time(),
+                agent_endpoint=self.agent_endpoint,
+            )
+            node = self.store.create(node)
+            self.events.event(node, Reason.NODE_READY, f"partition {self.partition} ready")
+            return node
+
+        def refresh(node: VirtualNode):
+            node.capacity = cap
+            node.allocatable = free
+            node.heartbeat = time.time()
+            node.conditions = [NodeCondition(type="Ready", status=True)]
+
+        return self.store.mutate(VirtualNode.KIND, self.node_name, refresh)
+
+    def deregister(self) -> None:
+        try:
+            self.store.delete(VirtualNode.KIND, self.node_name)
+        except NotFound:
+            pass
+
+    # ---- pod lifecycle ----
+
+    def sync(self) -> None:
+        """One provider tick: refresh the node, then converge every bound
+        pod (the PodSyncWorkers resync, virtual-kubelet.go:298-310)."""
+        self.register()
+        for pod in self.store.list(Pod.KIND):
+            if pod.spec.node_name != self.node_name:
+                continue
+            try:
+                self.sync_pod(pod)
+            except NotFound:
+                continue  # pod deleted mid-sync
+            except Exception:
+                log.exception("sync pod %s failed", pod.name)
+
+    def sync_pod(self, pod: Pod) -> None:
+        if pod.meta.deleted:
+            self._terminate_pod(pod)
+            return
+        if pod.spec.role != PodRole.SIZECAR:
+            return
+        if not pod.status.job_ids:
+            self._submit_pod(pod)
+        else:
+            self._refresh_status(pod)
+
+    def _submit_pod(self, pod: Pod) -> None:
+        """CreatePod equivalent (provider.go:35-60): submit with the pod
+        UID as submitter id so retries dedupe agent-side."""
+        demand = pod.spec.demand
+        if demand is None or not demand.script.strip():
+            self._fail_pod(pod, "sizecar pod has no script")
+            return
+        try:
+            resp = self.client.SubmitJob(demand_to_submit(demand, submitter_id=pod.meta.uid))
+        except grpc.RpcError as e:
+            self.events.event(
+                pod, Reason.POD_FAILED, f"submit failed: {e.details()}", warning=True
+            )
+            self._fail_pod(pod, f"submit failed: {e.details()}")
+            return
+        job_id = int(resp.job_id)
+
+        def record(p: Pod):
+            p.status.job_ids = (job_id,)
+            p.status.phase = PodPhase.PENDING
+            p.status.reason = ""
+            p.meta.labels["jobid"] = str(job_id)
+            p.meta.annotations["agent-endpoint"] = self.agent_endpoint
+
+        self.store.mutate(Pod.KIND, pod.name, record)
+        self.events.event(pod, Reason.JOB_SUBMITTED, f"slurm job {job_id} submitted")
+
+    def _refresh_status(self, pod: Pod) -> None:
+        """GetPodStatus equivalent (provider.go:195-219)."""
+        infos: list[JobInfo] = []
+        for job_id in pod.status.job_ids:
+            try:
+                resp = self.client.JobInfo(pb.JobInfoRequest(job_id=job_id))
+            except grpc.RpcError:
+                infos.append(JobInfo(id=job_id, state=JobStatus.UNKNOWN))
+                continue
+            infos.extend(job_info_from_proto(m) for m in resp.info)
+        phase = pod_phase_for([i.state for i in infos])
+
+        def record(p: Pod):
+            if p.status.job_infos == infos and p.status.phase == phase:
+                return False
+            p.status.job_infos = infos
+            p.status.phase = phase
+
+        self.store.mutate(Pod.KIND, pod.name, record)
+
+    def _terminate_pod(self, pod: Pod) -> None:
+        """DeletePod equivalent (provider.go:156-181): cancel every owned
+        job, then drop the object."""
+        for job_id in pod.status.job_ids:
+            try:
+                self.client.CancelJob(pb.CancelJobRequest(job_id=job_id))
+            except grpc.RpcError as e:
+                log.warning("cancel job %d: %s", job_id, e.details())
+        try:
+            self.store.delete(Pod.KIND, pod.name)
+        except NotFound:
+            pass
+
+    def _fail_pod(self, pod: Pod, reason: str) -> None:
+        def record(p: Pod):
+            p.status.phase = PodPhase.FAILED
+            p.status.reason = reason
+
+        self.store.mutate(Pod.KIND, pod.name, record)
+
+    # ---- logs ----
+
+    def pod_logs(self, pod_name: str, *, follow: bool = False) -> Iterator[bytes]:
+        """GetContainerLogs equivalent (provider.go:246-302): while the job
+        runs and follow is set, TailFile; otherwise OpenFile stdout (and
+        stderr when distinct)."""
+        pod: Pod = self.store.get(Pod.KIND, pod_name)
+        infos = pod.status.job_infos
+        if not infos:
+            return
+        info = infos[0]
+        running = info.state == JobStatus.RUNNING
+        if follow and running:
+
+            def requests():
+                yield pb.TailFileRequest(path=info.std_out, action=pb.FOLLOW)
+                # drain-and-close once the job leaves RUNNING
+                while True:
+                    time.sleep(0.2)
+                    try:
+                        resp = self.client.JobState(pb.JobStateRequest(job_id=info.id))
+                    except grpc.RpcError:
+                        break
+                    if resp.status != pb.RUNNING:
+                        break
+                yield pb.TailFileRequest(
+                    path=info.std_out, action=pb.READ_TO_END_AND_CLOSE
+                )
+
+            for chunk in self.client.TailFile(requests()):
+                yield chunk.content
+            return
+        paths = [info.std_out]
+        if info.std_err and info.std_err != info.std_out:
+            paths.append(info.std_err)
+        for path in paths:
+            try:
+                for chunk in self.client.OpenFile(pb.OpenFileRequest(path=path)):
+                    yield chunk.content
+            except grpc.RpcError as e:
+                log.warning("open %s: %s", path, e.details())

@@ -76,7 +76,14 @@ def _load_job(root: pathlib.Path, job_id: int) -> dict | None:
     p = _job_path(root, job_id)
     if not p.exists():
         return None
-    return json.loads(p.read_text())
+    rec = json.loads(p.read_text())
+    if "alias_of" in rec:
+        # a task-id lookup resolves to the base record narrowed to that task
+        base = json.loads(_job_path(root, rec["alias_of"]).read_text())
+        base["tasks"] = [t for t in base["tasks"] if t["jid"] == job_id]
+        base["alias_jid"] = job_id
+        return base
+    return rec
 
 
 def _save_job(root: pathlib.Path, rec: dict) -> None:
@@ -98,20 +105,43 @@ def _alive(pid: int) -> bool:
         return False
 
 
-def _job_state(root: pathlib.Path, rec: dict) -> tuple[str, str]:
-    """(state, exit_code) — derived from the detached process."""
-    if rec.get("cancelled"):
+def _task_state(root: pathlib.Path, rec: dict, task: dict) -> tuple[str, str]:
+    """(state, exit_code) of one (array-)task — from its detached process."""
+    if rec.get("cancelled") or task.get("cancelled"):
         return "CANCELLED", "0:15"
-    exit_file = root / f"exit_{rec['id']}"
+    exit_file = root / f"exit_{task['jid']}"
     if exit_file.exists():
         try:
             rc = int(exit_file.read_text().strip() or "0")
         except ValueError:
             rc = 1
         return ("COMPLETED", "0:0") if rc == 0 else ("FAILED", f"{rc}:0")
-    if _alive(rec["pid"]):
+    if _alive(task["pid"]):
         return "RUNNING", "0:0"
     return "FAILED", "1:0"  # died without writing exit file
+
+
+def _job_state(root: pathlib.Path, rec: dict) -> tuple[str, str]:
+    return _task_state(root, rec, rec["tasks"][0])
+
+
+def _parse_array_spec(spec: str) -> list[int]:
+    """'0-3', '1,3,5', '0-7%2' (throttle ignored) → task id list."""
+    ids: list[int] = []
+    for part in spec.split("%")[0].split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo, _, hi = part.partition("-")
+            step = 1
+            if ":" in hi:
+                hi, _, s = hi.partition(":")
+                step = int(s)
+            ids.extend(range(int(lo), int(hi) + 1, step))
+        else:
+            ids.append(int(part))
+    return ids or [0]
 
 
 # ---------------------------------------------------------------- sbatch
@@ -157,32 +187,52 @@ def sbatch(argv: list[str]) -> int:
         return 1
     node = cluster(root)["partitions"][partition]["nodes"][0]
 
-    # detach fds too: an inherited stdout pipe would keep the submitter's
-    # capture_output read open until the job itself exits
-    proc = subprocess.Popen(
-        ["/bin/sh", "-c", f'/bin/sh "{script_file}" > "{out_file}" 2>&1; '
-                          f'echo $? > "{root}/exit_{job_id}"'],
-        start_new_session=True,
-        stdin=subprocess.DEVNULL,
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-        env={**os.environ, "SLURM_JOB_ID": str(job_id)},
-    )
+    array_spec = opts.get("array", "")
+    task_ids = _parse_array_spec(array_spec) if array_spec else [None]
+    tasks = []
+    for task_id in task_ids:
+        if task_id is None:
+            jid, out = job_id, out_file
+        else:
+            jid = job_id if task_id == task_ids[0] else _next_id(root)
+            out = root / f"slurm-{job_id}_{task_id}.out"
+            out.touch()
+        env = {**os.environ, "SLURM_JOB_ID": str(jid),
+               "SLURM_ARRAY_JOB_ID": str(job_id)}
+        if task_id is not None:
+            env["SLURM_ARRAY_TASK_ID"] = str(task_id)
+        # detach fds too: an inherited stdout pipe would keep the submitter's
+        # capture_output read open until the job itself exits
+        proc = subprocess.Popen(
+            ["/bin/sh", "-c", f'/bin/sh "{script_file}" > "{out}" 2>&1; '
+                              f'echo $? > "{root}/exit_{jid}"'],
+            start_new_session=True,
+            stdin=subprocess.DEVNULL,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            env=env,
+        )
+        tasks.append(
+            {"jid": jid, "task_id": task_id, "pid": proc.pid, "stdout": str(out)}
+        )
     rec = {
         "id": job_id,
         "name": opts.get("job-name", script_file.name),
         "partition": partition,
         "submit_time": _now(),
         "start_time": _now(),
-        "pid": proc.pid,
+        "pid": tasks[0]["pid"],
         "node": node,
-        "stdout": str(out_file),
+        "stdout": tasks[0]["stdout"],
         "work_dir": os.getcwd(),
-        "array": opts.get("array", ""),
+        "array": array_spec,
         "user": os.environ.get("USER", "user"),
         "cancelled": False,
+        "tasks": tasks,
     }
     _save_job(root, rec)
+    for t in tasks[1:]:  # thin alias records: `scontrol show jobid <task jid>`
+        _save_job(root, {"id": t["jid"], "alias_of": job_id})
     if "parsable" in opts:
         print(job_id)
     else:
@@ -202,12 +252,24 @@ def scancel(argv: list[str]) -> int:
         if rec is None:
             print(f"scancel: error: Invalid job id {arg}", file=sys.stderr)
             return 1
-        rec["cancelled"] = True
-        _save_job(root, rec)
-        try:
-            os.killpg(os.getpgid(rec["pid"]), signal.SIGTERM)
-        except OSError:
-            pass
+        if "alias_jid" in rec:
+            # cancelling one array task: flag just it on the base record
+            base = json.loads(_job_path(root, rec["id"]).read_text())
+            victims = []
+            for task in base["tasks"]:
+                if task["jid"] == rec["alias_jid"]:
+                    task["cancelled"] = True
+                    victims.append(task)
+            _save_job(root, base)
+        else:
+            rec["cancelled"] = True
+            _save_job(root, rec)
+            victims = rec["tasks"]
+        for task in victims:
+            try:
+                os.killpg(os.getpgid(task["pid"]), signal.SIGTERM)
+            except OSError:
+                pass
     return 0
 
 
@@ -215,26 +277,35 @@ def scancel(argv: list[str]) -> int:
 
 
 def _print_job(root: pathlib.Path, rec: dict) -> None:
-    state, exit_code = _job_state(root, rec)
-    reason = "None"
-    lines = [
-        f"JobId={rec['id']} JobName={rec['name']}",
-        f"   UserId={rec['user']}(1000) GroupId={rec['user']}(1000) MCS_label=N/A",
-        f"   JobState={state} Reason={reason} Dependency=(null)",
-        f"   Requeue=1 Restarts=0 BatchFlag=1 Reboot=0 ExitCode={exit_code}",
-        "   RunTime=00:00:01 TimeLimit=UNLIMITED TimeMin=N/A",
-        f"   SubmitTime={rec['submit_time']} EligibleTime={rec['submit_time']}",
-        f"   StartTime={rec['start_time']} EndTime=Unknown Deadline=N/A",
-        f"   Partition={rec['partition']} AllocNode:Sid=login0:1",
-        f"   NodeList={rec['node']}",
-        f"   BatchHost={rec['node']}",
-        "   NumNodes=1 NumCPUs=1 NumTasks=1 CPUs/Task=1 ReqB:S:C:T=0:0:*:*",
-        f"   WorkDir={rec['work_dir']}",
-        f"   StdErr={rec['stdout']}",
-        "   StdIn=/dev/null",
-        f"   StdOut={rec['stdout']}",
-    ]
-    print("\n".join(lines))
+    base_id = rec.get("alias_of", rec["id"])
+    first = True
+    for task in rec["tasks"]:
+        state, exit_code = _task_state(root, rec, task)
+        head = f"JobId={task['jid']}"
+        if task["task_id"] is not None:
+            head += f" ArrayJobId={base_id} ArrayTaskId={task['task_id']}"
+        head += f" JobName={rec['name']}"
+        lines = [
+            head,
+            f"   UserId={rec['user']}(1000) GroupId={rec['user']}(1000) MCS_label=N/A",
+            f"   JobState={state} Reason=None Dependency=(null)",
+            f"   Requeue=1 Restarts=0 BatchFlag=1 Reboot=0 ExitCode={exit_code}",
+            "   RunTime=00:00:01 TimeLimit=UNLIMITED TimeMin=N/A",
+            f"   SubmitTime={rec['submit_time']} EligibleTime={rec['submit_time']}",
+            f"   StartTime={rec['start_time']} EndTime=Unknown Deadline=N/A",
+            f"   Partition={rec['partition']} AllocNode:Sid=login0:1",
+            f"   NodeList={rec['node']}",
+            f"   BatchHost={rec['node']}",
+            "   NumNodes=1 NumCPUs=1 NumTasks=1 CPUs/Task=1 ReqB:S:C:T=0:0:*:*",
+            f"   WorkDir={rec['work_dir']}",
+            f"   StdErr={task['stdout']}",
+            "   StdIn=/dev/null",
+            f"   StdOut={task['stdout']}",
+        ]
+        if not first:
+            print()
+        print("\n".join(lines))
+        first = False
 
 
 def _print_partition(name: str, part: dict, nodes_cfg: dict) -> None:
@@ -335,11 +406,13 @@ def sacct(argv: list[str]) -> int:
     rec = _load_job(root, job_id)
     if rec is None:
         return 0  # sacct prints nothing for unknown jobs
-    state, exit_code = _job_state(root, rec)
-    end = "Unknown" if state == "RUNNING" else _now()
-    rc = exit_code.replace(":", ":")
-    print(f"{rec['start_time']}|{end}|{rc}|{state}|{job_id}|{rec['name']}|")
-    print(f"{rec['start_time']}|{end}|{rc}|{state}|{job_id}.batch|batch|")
+    base_id = rec["id"]
+    for task in rec["tasks"]:
+        state, exit_code = _task_state(root, rec, task)
+        end = "Unknown" if state == "RUNNING" else _now()
+        sid = f"{base_id}_{task['task_id']}" if task["task_id"] is not None else str(base_id)
+        print(f"{rec['start_time']}|{end}|{exit_code}|{state}|{sid}|{rec['name']}|")
+        print(f"{rec['start_time']}|{end}|{exit_code}|{state}|{sid}.batch|batch|")
     return 0
 
 

@@ -122,6 +122,40 @@ def test_sbatch_args_no_duplicate_flags():
     assert "--time" in flags and args[args.index("--time") + 1] == "120"
 
 
+def test_array_job_tasks_and_single_task_cancel(client):
+    """--array fans out into per-task records; cancelling one task id kills
+    only that task (real-Slurm semantics the shim must mirror)."""
+    base = client.submit(
+        JobDemand(partition="debug", script="#!/bin/sh\nsleep 30\n", array="0-2")
+    )
+    _wait_state(client, base, JobStatus.RUNNING)
+    infos = client.job_info(base)
+    assert len(infos) == 3
+    assert {i.array_id for i in infos} == {f"{base}_{t}" for t in range(3)}
+    victim = infos[1]
+    client.cancel(victim.id)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        infos = client.job_info(base)
+        if infos[1].state == JobStatus.CANCELLED:
+            break
+        time.sleep(0.05)
+    assert infos[1].state == JobStatus.CANCELLED
+    assert infos[0].state == JobStatus.RUNNING  # siblings untouched
+    assert infos[2].state == JobStatus.RUNNING
+    client.cancel(base)
+
+
+def test_array_job_sacct_per_task_rows(client):
+    base = client.submit(
+        JobDemand(partition="debug", script="#!/bin/sh\ntrue\n", array="0-1")
+    )
+    _wait_state(client, base, JobStatus.COMPLETED)
+    steps = client.job_steps(base)
+    ids = {s.id for s in steps}
+    assert f"{base}_0" in ids and f"{base}_1" in ids
+
+
 # ---------------------------------------------------------------- tailer
 
 

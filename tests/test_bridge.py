@@ -1,0 +1,433 @@
+"""Bridge control-plane tests: store semantics, status translation, the
+operator's sizing rules, and the hermetic end-to-end slice (submit →
+placement → sbatch via agent → status loop → logs → results) that
+SURVEY.md §7 step 4 calls the minimum slice — run against the fake Slurm
+PATH shim with an in-process agent, no K8s or real Slurm required."""
+
+import os
+import pathlib
+import time
+
+import pytest
+
+from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
+from slurm_bridge_tpu.bridge import (
+    Bridge,
+    BridgeJob,
+    BridgeJobSpec,
+    Conflict,
+    FetchState,
+    JobState,
+    Meta,
+    ObjectStore,
+    Pod,
+    PodPhase,
+    ValidationError,
+    VirtualNode,
+    validate_bridge_job,
+)
+from slurm_bridge_tpu.bridge.controller import WorkQueue
+from slurm_bridge_tpu.bridge.objects import PodRole, partition_node_name
+from slurm_bridge_tpu.bridge.operator import demand_for_job, sizecar_name, worker_name
+from slurm_bridge_tpu.bridge.statusmap import job_state_for_pod_phase, pod_phase_for
+from slurm_bridge_tpu.core.types import JobStatus
+from slurm_bridge_tpu.wire import serve
+
+FAKESLURM = str(pathlib.Path(__file__).parent / "fakeslurm")
+
+
+# ---------------------------------------------------------------- store
+
+
+def _job(name="j1", partition="debug", script="#!/bin/sh\ntrue\n", **kw):
+    return BridgeJob(
+        meta=Meta(name=name),
+        spec=BridgeJobSpec(partition=partition, sbatch_script=script, **kw),
+    )
+
+
+def test_store_crud_and_conflict():
+    s = ObjectStore()
+    created = s.create(_job())
+    assert created.meta.resource_version == 1
+
+    stale = s.get(BridgeJob.KIND, "j1")
+    fresh = s.get(BridgeJob.KIND, "j1")
+    fresh.status.state = JobState.RUNNING
+    s.update(fresh)
+    stale.status.state = JobState.FAILED
+    with pytest.raises(Conflict):
+        s.update(stale)
+    assert s.get(BridgeJob.KIND, "j1").status.state == JobState.RUNNING
+
+
+def test_store_deepcopy_isolation():
+    s = ObjectStore()
+    job = _job()
+    s.create(job)
+    job.spec.partition = "mutated-after-create"
+    assert s.get(BridgeJob.KIND, "j1").spec.partition == "debug"
+    got = s.get(BridgeJob.KIND, "j1")
+    got.spec.partition = "mutated-after-get"
+    assert s.get(BridgeJob.KIND, "j1").spec.partition == "debug"
+
+
+def test_store_cascade_delete():
+    s = ObjectStore()
+    s.create(_job())
+    s.create(
+        Pod(
+            meta=Meta(name="j1-sizecar", owner="j1"),
+            spec=__import__(
+                "slurm_bridge_tpu.bridge.objects", fromlist=["PodSpec"]
+            ).PodSpec(),
+        )
+    )
+    s.delete(BridgeJob.KIND, "j1")
+    assert s.try_get(Pod.KIND, "j1-sizecar") is None
+
+
+def test_store_watch_backfills_existing():
+    s = ObjectStore()
+    s.create(_job())
+    q = s.watch((BridgeJob.KIND,))
+    ev = q.get(timeout=1)
+    assert ev.type == "ADDED" and ev.name == "j1"
+
+
+def test_store_mutate_retries_conflicts():
+    s = ObjectStore()
+    s.create(_job())
+    calls = []
+
+    def bump(job):
+        if not calls:
+            # sneak in a concurrent write on first attempt
+            other = s.get(BridgeJob.KIND, "j1")
+            other.status.reason = "concurrent"
+            s.update(other)
+        calls.append(1)
+        job.status.state = JobState.RUNNING
+
+    s.mutate(BridgeJob.KIND, "j1", bump)
+    assert len(calls) == 2
+    final = s.get(BridgeJob.KIND, "j1")
+    assert final.status.state == JobState.RUNNING
+    assert final.status.reason == "concurrent"
+
+
+# ---------------------------------------------------------------- validation
+
+
+def test_validation_rules():
+    validate_bridge_job(_job())
+    with pytest.raises(ValidationError):
+        validate_bridge_job(_job(name="Not-Valid-DNS"))
+    with pytest.raises(ValidationError):
+        validate_bridge_job(_job(name="1starts-with-digit"))
+    with pytest.raises(ValidationError):
+        validate_bridge_job(_job(partition=""))
+    with pytest.raises(ValidationError):
+        validate_bridge_job(_job(script="   "))
+
+
+# ---------------------------------------------------------------- statusmap
+
+
+@pytest.mark.parametrize(
+    "states,phase",
+    [
+        ([], PodPhase.PENDING),
+        ([JobStatus.PENDING], PodPhase.PENDING),
+        ([JobStatus.RUNNING, JobStatus.PENDING], PodPhase.RUNNING),
+        ([JobStatus.COMPLETED, JobStatus.COMPLETED], PodPhase.SUCCEEDED),
+        ([JobStatus.COMPLETED, JobStatus.FAILED], PodPhase.FAILED),
+        ([JobStatus.COMPLETED, JobStatus.CANCELLED], PodPhase.FAILED),
+        ([JobStatus.COMPLETED, JobStatus.TIMEOUT], PodPhase.FAILED),
+        ([JobStatus.FAILED, JobStatus.PENDING], PodPhase.FAILED),
+        ([JobStatus.UNKNOWN], PodPhase.UNKNOWN),
+    ],
+)
+def test_pod_phase_table(states, phase):
+    assert pod_phase_for(states) == phase
+
+
+def test_job_state_for_pod_phase():
+    assert job_state_for_pod_phase(PodPhase.RUNNING) == JobState.RUNNING
+    assert job_state_for_pod_phase(PodPhase.SUCCEEDED) == JobState.SUCCEEDED
+    assert job_state_for_pod_phase(PodPhase.FAILED) == JobState.FAILED
+    assert job_state_for_pod_phase(PodPhase.PENDING) == JobState.SUBMITTED
+
+
+# ---------------------------------------------------------------- workqueue
+
+
+def test_workqueue_dedupes_queued_keys():
+    q = WorkQueue()
+    q.add("a")
+    q.add("a")
+    q.add("b")
+    assert q.get(timeout=0.1) == "a"
+    assert q.get(timeout=0.1) == "b"
+    assert q.get(timeout=0.05) is None
+
+
+def test_workqueue_delayed_delivery():
+    q = WorkQueue()
+    q.add_after("later", 0.05)
+    assert q.get(timeout=0.01) is None
+    assert q.get(timeout=1.0) == "later"
+
+
+def test_workqueue_rate_limit_backoff_grows():
+    q = WorkQueue(base_delay=0.01, max_delay=1.0)
+    q.add_rate_limited("k")  # ~10ms
+    t0 = time.monotonic()
+    assert q.get(timeout=1.0) == "k"
+    first = time.monotonic() - t0
+    q.add_rate_limited("k")  # ~20ms
+    t0 = time.monotonic()
+    assert q.get(timeout=1.0) == "k"
+    second = time.monotonic() - t0
+    assert second > first
+
+
+# ---------------------------------------------------------------- sizing
+
+
+def test_demand_headers_with_spec_overrides():
+    job = _job(
+        script=(
+            "#!/bin/sh\n"
+            "#SBATCH --cpus-per-task=4\n"
+            "#SBATCH --mem-per-cpu=2048\n"
+            "#SBATCH -N 2\n"
+            "#SBATCH --time=01:00:00\n"
+            "srun hostname\n"
+        ),
+        cpus_per_task=8,  # spec overrides header
+    )
+    d = demand_for_job(job)
+    assert d.cpus_per_task == 8
+    assert d.mem_per_cpu_mb == 2048
+    assert d.nodes == 2
+    assert d.time_limit_s == 3600
+    assert d.partition == "debug"
+
+
+def test_demand_defaults():
+    d = demand_for_job(_job(script="#!/bin/sh\ntrue\n"))
+    assert (d.cpus_per_task, d.ntasks, d.nodes, d.mem_per_cpu_mb) == (1, 1, 1, 1024)
+
+
+def test_demand_array_multiplies_resources():
+    job = _job(script="#!/bin/sh\n#SBATCH --array=0-3\ntrue\n", cpus_per_task=2)
+    d = demand_for_job(job)
+    assert d.total_cpus(4) == 8  # cpus × array len (pod.go:153-156)
+
+
+# ---------------------------------------------------------------- e2e
+
+
+@pytest.fixture
+def fake_slurm(tmp_path, monkeypatch):
+    state = tmp_path / "slurm-state"
+    monkeypatch.setenv("SBT_FAKESLURM_STATE", str(state))
+    monkeypatch.setenv("PATH", FAKESLURM + os.pathsep + os.environ["PATH"])
+    return state
+
+
+@pytest.fixture
+def bridge(fake_slurm, tmp_path):
+    sock = str(tmp_path / "agent.sock")
+    server = serve(
+        {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
+        sock,
+    )
+    b = Bridge(
+        sock,
+        scheduler_backend="greedy",
+        scheduler_interval=0.05,
+        configurator_interval=5.0,
+        node_sync_interval=0.05,
+    ).start()
+    yield b
+    b.stop()
+    server.stop(None)
+
+
+def test_e2e_submit_to_completion(bridge):
+    bridge.submit(
+        "hello",
+        BridgeJobSpec(
+            partition="debug", sbatch_script="#!/bin/sh\necho done-e2e\n"
+        ),
+    )
+    job = bridge.wait("hello", timeout=20.0)
+    assert job.status.state == JobState.SUCCEEDED
+    assert len(job.status.subjobs) == 1
+    sub = next(iter(job.status.subjobs.values()))
+    assert sub.state == JobStatus.COMPLETED
+    assert sub.std_out
+
+    # the sizecar pod was bound by the solver to the partition's vnode
+    pod = bridge.store.get(Pod.KIND, sizecar_name("hello"))
+    assert pod.spec.node_name == partition_node_name("debug")
+    assert pod.spec.placement_hint  # solver chose concrete Slurm nodes
+
+    # worker display pod exists with one terminated container per sub-job
+    worker = bridge.store.get(Pod.KIND, worker_name("hello"))
+    assert worker.spec.role == PodRole.WORKER
+    assert worker.status.containers and worker.status.containers[0].state == "terminated"
+
+    # logs (kubectl logs shape, §3.4)
+    logs = b"".join(bridge.logs("hello"))
+    assert b"done-e2e" in logs
+
+
+def test_e2e_virtual_nodes_advertise_capacity(bridge):
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        nodes = bridge.store.list(VirtualNode.KIND)
+        if len(nodes) == 2:
+            break
+        time.sleep(0.05)
+    by_name = {n.name: n for n in bridge.store.list(VirtualNode.KIND)}
+    debug = by_name[partition_node_name("debug")]
+    gpu = by_name[partition_node_name("gpu")]
+    assert debug.capacity["cpu"] == 4 * 32  # fake cluster: 4 nodes × 32 cpus
+    assert gpu.capacity["gpu"] == 2 * 4
+    assert debug.ready and gpu.ready
+
+
+def test_e2e_failing_job(bridge):
+    bridge.submit(
+        "boom", BridgeJobSpec(partition="debug", sbatch_script="#!/bin/sh\nexit 7\n")
+    )
+    job = bridge.wait("boom", timeout=20.0)
+    assert job.status.state == JobState.FAILED
+    sub = next(iter(job.status.subjobs.values()))
+    assert sub.state == JobStatus.FAILED
+    assert sub.exit_code.startswith("7")
+
+
+def test_e2e_result_fetch(bridge, tmp_path):
+    results = tmp_path / "results"
+    bridge.submit(
+        "fetchme",
+        BridgeJobSpec(
+            partition="debug",
+            sbatch_script="#!/bin/sh\necho payload-xyz\n",
+            result_to=str(results),
+        ),
+    )
+    job = bridge.wait("fetchme", timeout=20.0, fetch_done=True)
+    assert job.status.fetch_result == FetchState.SUCCEEDED
+    files = list(results.iterdir())
+    assert len(files) == 1
+    assert b"payload-xyz" in files[0].read_bytes()
+
+
+def test_e2e_cancel(bridge):
+    bridge.submit(
+        "longjob",
+        BridgeJobSpec(partition="debug", sbatch_script="#!/bin/sh\nsleep 30\n"),
+    )
+    # wait until it's actually running in (fake) slurm
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        pod = bridge.store.try_get(Pod.KIND, sizecar_name("longjob"))
+        if pod is not None and pod.status.job_ids and pod.status.phase == PodPhase.RUNNING:
+            break
+        time.sleep(0.05)
+    job_id = pod.status.job_ids[0]
+    bridge.cancel("longjob")
+    assert bridge.store.try_get(BridgeJob.KIND, "longjob") is None
+    assert bridge.store.try_get(Pod.KIND, sizecar_name("longjob")) is None
+    # the slurm job really got scancel'ed
+    client = SlurmClient()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        infos = client.job_info(job_id)
+        if infos and infos[0].state == JobStatus.CANCELLED:
+            break
+        time.sleep(0.05)
+    assert infos[0].state == JobStatus.CANCELLED
+
+
+def test_e2e_unschedulable_stays_pending(bridge):
+    bridge.submit(
+        "toobig",
+        BridgeJobSpec(
+            partition="debug",
+            sbatch_script="#!/bin/sh\ntrue\n",
+            cpus_per_task=10_000,  # cluster has 128 cpus total
+        ),
+    )
+    deadline = time.time() + 5
+    reason = ""
+    while time.time() < deadline:
+        pod = bridge.store.try_get(Pod.KIND, sizecar_name("toobig"))
+        if pod is not None and pod.status.reason:
+            reason = pod.status.reason
+            break
+        time.sleep(0.05)
+    assert "Unschedulable" in reason
+    assert bridge.get("toobig").status.state in (JobState.PENDING, JobState.SUBMITTED)
+
+
+def test_e2e_array_job_subjob_statuses(bridge):
+    bridge.submit(
+        "arr",
+        BridgeJobSpec(
+            partition="debug",
+            sbatch_script="#!/bin/sh\necho task\n",
+            array="0-2",
+        ),
+    )
+    job = bridge.wait("arr", timeout=20.0)
+    assert job.status.state == JobState.SUCCEEDED
+    assert len(job.status.subjobs) == 3
+    assert all(s.state == JobStatus.COMPLETED for s in job.status.subjobs.values())
+
+
+def test_e2e_result_fetch_for_failed_job(bridge, tmp_path):
+    """Failed jobs still get their stdout fetched (and wait(fetch_done=True)
+    terminates) — regression for the SUCCEEDED-only fetch gate."""
+    results = tmp_path / "failed-results"
+    bridge.submit(
+        "failfetch",
+        BridgeJobSpec(
+            partition="debug",
+            sbatch_script="#!/bin/sh\necho failing-but-chatty\nexit 3\n",
+            result_to=str(results),
+        ),
+    )
+    job = bridge.wait("failfetch", timeout=20.0, fetch_done=True)
+    assert job.status.state == JobState.FAILED
+    assert job.status.fetch_result == FetchState.SUCCEEDED
+    files = list(results.iterdir())
+    assert files and b"failing-but-chatty" in files[0].read_bytes()
+
+
+def test_sync_status_is_idempotent(bridge):
+    """A no-op reconcile must not write the object (a write feeds the watch
+    → reconcile loop) — regression for the `changed or None` hot loop."""
+    bridge.submit(
+        "quiet", BridgeJobSpec(partition="debug", sbatch_script="#!/bin/sh\ntrue\n")
+    )
+    bridge.wait("quiet", timeout=20.0)
+    time.sleep(0.5)  # let any in-flight syncs drain
+    rv0 = bridge.get("quiet").meta.resource_version
+    for _ in range(5):
+        bridge.operator.reconcile("quiet")
+    assert bridge.get("quiet").meta.resource_version == rv0
+
+
+def test_e2e_invalid_job_fails_fast(bridge):
+    # bypass client-side validation to exercise the operator's server-side path
+    bridge.store.create(_job(name="badjob", partition=""))
+    bridge.operator.enqueue("badjob")
+    job = bridge.wait("badjob", timeout=10.0)
+    assert job.status.state == JobState.FAILED
+    assert "partition" in job.status.reason
