@@ -16,7 +16,9 @@ import threading
 from slurm_bridge_tpu.agent.cli import SlurmClient
 from slurm_bridge_tpu.agent.config import load_partition_config
 from slurm_bridge_tpu.agent.server import WorkloadServicer
+from slurm_bridge_tpu.obs.bootstrap import add_observability_flags, start_observability
 from slurm_bridge_tpu.obs.logging import setup_logging
+from slurm_bridge_tpu.obs.tracing import tracing_interceptor
 from slurm_bridge_tpu.wire import serve
 
 DEFAULT_SOCKET = "/var/run/sbt/agent.sock"
@@ -29,6 +31,7 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--socket", default="", help="unix socket path (optional)")
     parser.add_argument("--config", default="", help="partition overrides YAML")
     parser.add_argument("--ledger", default="", help="submit-dedupe state file")
+    add_observability_flags(parser)
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -42,11 +45,19 @@ def main(argv: list[str] | None = None) -> int:
         ledger_file=args.ledger or None,
     )
 
-    servers = [serve({"WorkloadManager": servicer}, args.listen)]
+    interceptors = (tracing_interceptor(),)
+    servers = [serve({"WorkloadManager": servicer}, args.listen,
+                     interceptors=interceptors)]
     log.info("serving WorkloadManager on %s", args.listen)
     if args.socket:
-        servers.append(serve({"WorkloadManager": servicer}, args.socket))
+        servers.append(serve({"WorkloadManager": servicer}, args.socket,
+                             interceptors=interceptors))
         log.info("serving WorkloadManager on %s", args.socket)
+
+    httpd = start_observability(
+        "sbt-agent", args,
+        ready_checks={"slurm": lambda: servicer.driver.version()},
+    )
 
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -55,6 +66,8 @@ def main(argv: list[str] | None = None) -> int:
     log.info("shutting down")
     for s in servers:
         s.stop(grace=5).wait()
+    if httpd is not None:
+        httpd.shutdown()
     return 0
 
 

@@ -127,12 +127,15 @@ class Controller:
             self._threads.append(t)
 
     def _run(self) -> None:
+        from slurm_bridge_tpu.obs.tracing import TRACER
+
         while True:
             key = self.queue.get()
             if key is None:
                 return
             try:
-                result = self.reconcile(key)
+                with TRACER.span(f"{self.name}.reconcile", key=key):
+                    result = self.reconcile(key)
             except Exception:
                 log.exception("%s: reconcile %s failed", self.name, key)
                 self.queue.add_rate_limited(key)

@@ -1,0 +1,178 @@
+"""Leader election — single active operator via a lease file.
+
+Reference parity: the operator's controller-runtime leader election
+(cmd/bridge-operator/bridge-operator.go:59-61,75-76), which rides a K8s
+Lease object: candidates try to acquire a named lease, the holder renews it
+on an interval, and a candidate may take over once the holder's lease
+expires (crash recovery without fencing the filesystem). Here the lease is
+a JSON file updated by atomic rename, giving the same
+acquire/renew/expire/release state machine for co-located processes —
+the deployment story the reference's election actually protects (two
+operator replicas pointed at the same control plane).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import tempfile
+import threading
+import time
+import uuid
+
+log = logging.getLogger("sbt.leader")
+
+
+def default_identity() -> str:
+    return f"{socket.gethostname()}_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+
+
+class LeaderElector:
+    """Acquire-and-renew loop over a lease file.
+
+    ``run()`` blocks until leadership is acquired, fires ``on_started``,
+    then renews every ``renew_interval`` seconds; if a renewal discovers the
+    lease stolen (or renewal keeps failing past the lease duration),
+    ``on_stopped`` fires — the caller should exit, as the reference's
+    manager does when it loses the lease.
+    """
+
+    def __init__(
+        self,
+        lock_path: str,
+        *,
+        identity: str | None = None,
+        lease_duration: float = 15.0,
+        renew_interval: float = 5.0,
+        retry_interval: float = 2.0,
+        on_started=None,
+        on_stopped=None,
+    ):
+        self.lock_path = lock_path
+        self.identity = identity or default_identity()
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        self.retry_interval = retry_interval
+        self.on_started = on_started
+        self.on_stopped = on_stopped
+        self._stop = threading.Event()
+        self._leading = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lease file primitives -------------------------------------------
+    def _read(self) -> dict | None:
+        try:
+            with open(self.lock_path) as fh:
+                return json.load(fh)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def _write(self, record: dict) -> None:
+        d = os.path.dirname(self.lock_path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".lease-")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump(record, fh)
+            os.replace(tmp, self.lock_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def try_acquire(self) -> bool:
+        """One acquire-or-renew attempt. True if we hold the lease after it.
+
+        The read-check-write runs under an flock on a sidecar ``.flock``
+        file, so two candidates racing on an expired lease serialize and
+        exactly one observes itself as holder (no split-brain window).
+        """
+        import fcntl
+
+        d = os.path.dirname(self.lock_path) or "."
+        os.makedirs(d, exist_ok=True)
+        guard = os.open(self.lock_path + ".flock", os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(guard, fcntl.LOCK_EX)
+            now = time.time()
+            rec = self._read()
+            if rec is not None and rec.get("holder") != self.identity:
+                if now < float(rec.get("expires", 0)):
+                    return False  # someone else holds a live lease
+                log.info("lease %s expired (holder=%s); taking over",
+                         self.lock_path, rec.get("holder"))
+            renewing = rec is not None and rec.get("holder") == self.identity
+            self._write({
+                "holder": self.identity,
+                "acquired": rec.get("acquired", now) if renewing else now,
+                "renewed": now,
+                "expires": now + self.lease_duration,
+            })
+            return True
+        finally:
+            os.close(guard)  # closing drops the flock
+
+    def release(self) -> None:
+        rec = self._read()
+        if rec and rec.get("holder") == self.identity:
+            try:
+                os.unlink(self.lock_path)
+            except OSError:
+                pass
+
+    # -- loop -------------------------------------------------------------
+    @property
+    def is_leader(self) -> bool:
+        return self._leading.is_set()
+
+    def run(self) -> None:
+        """Blocking acquire → renew loop (call in a thread via start())."""
+        while not self._stop.is_set():
+            try:
+                if self.try_acquire():
+                    break
+            except OSError as exc:
+                log.warning("lease acquire error (retrying): %s", exc)
+            if self._stop.wait(self.retry_interval):
+                return
+        if self._stop.is_set():
+            return
+        self._leading.set()
+        log.info("became leader (%s) on %s", self.identity, self.lock_path)
+        if self.on_started:
+            self.on_started()
+        deadline = time.time() + self.lease_duration
+        while not self._stop.wait(self.renew_interval):
+            try:
+                if self.try_acquire():
+                    deadline = time.time() + self.lease_duration
+                    continue
+                log.warning("lease stolen; stepping down")
+                break
+            except OSError as exc:
+                if time.time() > deadline:
+                    log.error("lease renewal failing past deadline: %s", exc)
+                    break
+                log.warning("lease renewal error (retrying): %s", exc)
+        was_leading = self._leading.is_set()
+        self._leading.clear()
+        if was_leading and self.on_stopped:
+            self.on_stopped()
+
+    def start(self) -> "LeaderElector":
+        self._thread = threading.Thread(target=self.run, name="leader-elector", daemon=True)
+        self._thread.start()
+        return self
+
+    def wait_until_leader(self, timeout: float | None = None) -> bool:
+        return self._leading.wait(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+        self.release()

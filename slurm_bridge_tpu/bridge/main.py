@@ -1,0 +1,93 @@
+"""sbt-bridge — the control-plane daemon.
+
+Reference parity: cmd/bridge-operator/bridge-operator.go (manager main:
+leader election :59-61, metrics server :57,73, healthz/readyz probes
+:100-107, reconciler thread flag :62) plus the configurator daemon main
+(cmd/configurator/configurator.go:53-114) — the rebuild runs the operator,
+configurator, scheduler, and fetch worker in one process (SURVEY.md §7),
+so one main serves them all.
+
+    python -m slurm_bridge_tpu.bridge.main --endpoint host:9999 \
+        [--scheduler auction|greedy] [--metrics-port 8080] \
+        [--leader-lock /var/run/sbt/bridge.lease] [--threads N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from slurm_bridge_tpu.bridge.leader import LeaderElector
+from slurm_bridge_tpu.bridge.runtime import Bridge
+from slurm_bridge_tpu.obs.bootstrap import add_observability_flags, start_observability
+from slurm_bridge_tpu.obs.logging import setup_logging
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description="slurm-bridge-tpu control plane")
+    parser.add_argument("--endpoint", required=True, help="agent endpoint (host:port or *.sock)")
+    parser.add_argument("--scheduler", default="auction", choices=["auction", "greedy"])
+    parser.add_argument("--threads", type=int, default=2,
+                        help="operator reconciler workers (--slurm-bridge-operator-threads)")
+    parser.add_argument("--configurator-interval", type=float, default=30.0)
+    parser.add_argument("--leader-lock", default="",
+                        help="lease file enabling leader election; empty = no election")
+    add_observability_flags(parser, metrics_port_default=8080)
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    setup_logging(verbose=args.verbose)
+    log = logging.getLogger("sbt.bridge.main")
+
+    bridge = Bridge(
+        args.endpoint,
+        scheduler_backend=args.scheduler,
+        configurator_interval=args.configurator_interval,
+        operator_workers=args.threads,
+    )
+
+    stop = threading.Event()
+    ready = threading.Event()
+
+    def check_ready() -> None:
+        if not ready.is_set():
+            raise RuntimeError("bridge components not started")
+
+    httpd = start_observability(
+        "sbt-bridge", args, ready_checks={"started": check_ready},
+    )
+
+    def start_components() -> None:
+        bridge.start()
+        ready.set()
+        log.info("bridge running against %s (scheduler=%s)", args.endpoint, args.scheduler)
+
+    elector = None
+    if args.leader_lock:
+        elector = LeaderElector(
+            args.leader_lock,
+            on_started=start_components,
+            on_stopped=stop.set,  # lost the lease ⇒ exit (manager semantics)
+        ).start()
+        log.info("waiting for leadership on %s", args.leader_lock)
+    else:
+        start_components()
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    log.info("shutting down")
+    ready.clear()
+    bridge.stop()
+    if elector is not None:
+        elector.stop()
+    if httpd is not None:
+        httpd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

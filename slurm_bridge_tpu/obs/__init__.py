@@ -1,8 +1,19 @@
-"""Observability: structured logging, metrics registry, event recorder."""
+"""Observability: structured logging, metrics registry, event recorder,
+distributed tracing (spans / samplers / exporters / tracez)."""
 
 from slurm_bridge_tpu.obs.logging import setup_logging
 from slurm_bridge_tpu.obs.metrics import Counter, Gauge, Histogram, MetricsRegistry, REGISTRY
 from slurm_bridge_tpu.obs.events import Event, EventRecorder, Reason
+from slurm_bridge_tpu.obs.tracing import (
+    TRACER,
+    InMemoryExporter,
+    JsonFileExporter,
+    LogExporter,
+    Span,
+    Tracer,
+    setup_tracing,
+    tracing_interceptor,
+)
 
 __all__ = [
     "setup_logging",
@@ -14,4 +25,12 @@ __all__ = [
     "Event",
     "EventRecorder",
     "Reason",
+    "TRACER",
+    "Tracer",
+    "Span",
+    "LogExporter",
+    "JsonFileExporter",
+    "InMemoryExporter",
+    "setup_tracing",
+    "tracing_interceptor",
 ]

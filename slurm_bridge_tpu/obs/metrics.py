@@ -131,23 +131,63 @@ class MetricsRegistry:
             lines.extend(m.collect())
         return "\n".join(lines) + "\n"
 
-    def serve(self, port: int, host: str = "0.0.0.0") -> ThreadingHTTPServer:
-        """Start /metrics + /healthz + /readyz on a background thread."""
+    def serve(
+        self,
+        port: int,
+        host: str = "0.0.0.0",
+        *,
+        extra_routes: dict | None = None,
+        health_checks: dict | None = None,
+        ready_checks: dict | None = None,
+    ) -> ThreadingHTTPServer:
+        """Start /metrics + /healthz + /readyz on a background thread.
+
+        ``extra_routes`` maps a path prefix to ``() -> (content_type, body)``
+        — used for the /debug/tracez zpages view (SURVEY.md §5 tracing).
+        ``health_checks`` / ``ready_checks`` map name → ``() -> None`` checks
+        that raise on failure, reproducing the operator's named healthz/readyz
+        checkers (bridge-operator.go:100-107); a failing check turns the
+        probe into a 500 listing the failures.
+        """
         registry = self
+        extra = dict(extra_routes or {})
+
+        def run_checks(checks: dict) -> tuple[int, bytes]:
+            failures = []
+            for name, check in checks.items():
+                try:
+                    check()
+                except Exception as exc:  # a probe must never crash the server
+                    failures.append(f"{name}: {exc}")
+            if failures:
+                return 500, ("\n".join(failures) + "\n").encode()
+            return 200, b"ok"
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                if self.path.startswith(("/healthz", "/readyz")):
-                    body = b"ok"
+                status = 200
+                if self.path.startswith("/healthz"):
+                    status, body = run_checks(health_checks or {})
+                    ctype = "text/plain"
+                elif self.path.startswith("/readyz"):
+                    status, body = run_checks(ready_checks or {})
                     ctype = "text/plain"
                 elif self.path.startswith("/metrics"):
                     body = registry.render().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif any(self.path.startswith(p) for p in extra):
+                    prefix = next(p for p in extra if self.path.startswith(p))
+                    try:
+                        ctype, text = extra[prefix]()
+                        body = text.encode() if isinstance(text, str) else text
+                    except Exception as exc:  # a debug page must never drop the conn
+                        status, ctype = 500, "text/plain"
+                        body = f"handler for {prefix} failed: {exc}\n".encode()
                 else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

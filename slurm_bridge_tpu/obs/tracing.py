@@ -1,0 +1,383 @@
+"""Distributed tracing — spans, samplers, exporter registry, tracez page.
+
+Reference parity: the VK's OpenCensus wiring (SURVEY.md §5): an exporter
+registry (cmd/slurm-virtual-kubelet/app/options/tracing_register.go:37-58)
+with pluggable backends (Jaeger tracing_register_jaeger.go:29-52, OC-agent
+tracing_register_ocagent.go — here: log / json-file / in-memory), sampling
+policies ``always|never|0-100`` (tracing.go:64-89), reserved service tags
+(operatingSystem/provider/nodeName, tracing.go:33-38), and a zpages-style
+``/debug/tracez`` debug view (tracing.go:94-114). Spans propagate through
+threads explicitly (pass the parent) and within a thread implicitly via a
+context variable, mirroring how the virtual-kubelet library wraps pod-sync
+operations in spans.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import os
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+log = logging.getLogger("sbt.trace")
+
+_current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "sbt_current_span", default=None
+)
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None = None
+    start: float = 0.0
+    end: float = 0.0
+    tags: dict[str, str] = field(default_factory=dict)
+    annotations: list[tuple[float, str]] = field(default_factory=list)
+    status: str = "OK"
+    sampled: bool = True
+
+    @property
+    def duration(self) -> float:
+        return (self.end or time.time()) - self.start
+
+    def annotate(self, message: str) -> None:
+        self.annotations.append((time.time(), message))
+
+    def set_tag(self, key: str, value) -> None:
+        self.tags[key] = str(value)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "start": self.start,
+            "durationMs": round(self.duration * 1e3, 3),
+            "tags": self.tags,
+            "annotations": [
+                {"t": t, "msg": m} for t, m in self.annotations
+            ],
+            "status": self.status,
+        }
+
+
+# --------------------------------------------------------------------------
+# Samplers — policy grammar of tracing.go:64-89: "always", "never", or a
+# percentage 0-100 interpreted as a probability.
+# --------------------------------------------------------------------------
+
+def parse_sampler(policy: str):
+    """policy → () -> bool. Raises ValueError on nonsense (as the VK does)."""
+    p = policy.strip().lower()
+    if p in ("", "always"):
+        return lambda: True
+    if p == "never":
+        return lambda: False
+    try:
+        rate = float(p)
+    except ValueError:
+        raise ValueError(
+            f"unsupported tracing sample policy {policy!r} "
+            "(want always|never|0-100)"
+        ) from None
+    if not 0 <= rate <= 100:
+        raise ValueError(f"tracing sample rate {rate} outside [0,100]")
+    frac = rate / 100.0
+    return lambda: random.random() < frac
+
+
+# --------------------------------------------------------------------------
+# Exporters + registry
+# --------------------------------------------------------------------------
+
+class LogExporter:
+    """Writes one structured log line per finished span."""
+
+    def export(self, span: Span) -> None:
+        log.info(
+            "span %s trace=%s dur=%.1fms status=%s %s",
+            span.name, span.trace_id[:8], span.duration * 1e3, span.status,
+            " ".join(f"{k}={v}" for k, v in span.tags.items()),
+        )
+
+
+class JsonFileExporter:
+    """Appends spans as JSON lines (the collector-friendly backend)."""
+
+    DEFAULT_PATH = "/tmp/sbt-trace.jsonl"
+
+    def __init__(self, path: str = DEFAULT_PATH):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def export(self, span: Span) -> None:
+        line = json.dumps(span.to_dict(), separators=(",", ":"))
+        with self._lock, open(self.path, "a") as fh:
+            fh.write(line + "\n")
+
+
+class InMemoryExporter:
+    """Keeps the last N spans (tests + tracez)."""
+
+    def __init__(self, capacity: int = 512):
+        self.spans: deque[Span] = deque(maxlen=capacity)
+
+    def export(self, span: Span) -> None:
+        self.spans.append(span)
+
+
+#: name → factory, mirroring AvailableTraceExporters
+_EXPORTERS: dict[str, object] = {
+    "log": LogExporter,
+    "jsonfile": JsonFileExporter,
+    "memory": InMemoryExporter,
+}
+
+
+def register_exporter(name: str, factory) -> None:
+    _EXPORTERS[name.lower()] = factory
+
+
+def available_exporters() -> list[str]:
+    return sorted(_EXPORTERS)
+
+
+def make_exporter(name: str, **kwargs):
+    try:
+        factory = _EXPORTERS[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown trace exporter {name!r}; available: {available_exporters()}"
+        ) from None
+    return factory(**kwargs)
+
+
+# --------------------------------------------------------------------------
+# Tracer
+# --------------------------------------------------------------------------
+
+class _SpanContext:
+    """Context manager produced by Tracer.span()."""
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self._tracer = tracer
+        self._span = span
+        self._token = None
+
+    def __enter__(self) -> Span:
+        self._span.start = time.time()
+        self._token = _current_span.set(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._span.end = time.time()
+        if exc is not None:
+            self._span.status = f"ERROR: {exc_type.__name__}: {exc}"
+        _current_span.reset(self._token)
+        self._tracer._finish(self._span)
+        return None  # never swallow
+
+
+class Tracer:
+    """Creates spans; owns the sampling decision and the exporter fan-out.
+
+    Service-level tags are attached to every span (the reserved
+    operatingSystem/provider/nodeName tags of tracing.go:33-38,49-51).
+    The sampling decision is made at the trace root and inherited by
+    children, so a trace is exported whole or not at all.
+    """
+
+    def __init__(
+        self,
+        service: str = "slurm-bridge-tpu",
+        *,
+        sample: str = "always",
+        tags: dict[str, str] | None = None,
+    ):
+        self.service = service
+        self.service_tags = dict(tags or {})
+        self._sampler = parse_sampler(sample)
+        self._exporters: list = []
+        self._recent = deque(maxlen=256)  # tracez ring, sampled spans only
+        self._lock = threading.Lock()
+
+    # -- configuration ----------------------------------------------------
+    def configure(
+        self,
+        *,
+        sample: str | None = None,
+        service: str | None = None,
+        tags: dict[str, str] | None = None,
+    ) -> "Tracer":
+        if sample is not None:
+            self._sampler = parse_sampler(sample)
+        if service is not None:
+            self.service = service
+        if tags:
+            self.service_tags.update(tags)
+        return self
+
+    def add_exporter(self, exporter) -> "Tracer":
+        with self._lock:
+            self._exporters.append(exporter)
+        return self
+
+    def clear_exporters(self) -> None:
+        with self._lock:
+            self._exporters.clear()
+
+    # -- span creation ----------------------------------------------------
+    def span(
+        self, name: str, *, parent: Span | None = None, **tags
+    ) -> _SpanContext:
+        if parent is None:
+            parent = _current_span.get()
+        if parent is not None:
+            trace_id, parent_id, sampled = parent.trace_id, parent.span_id, parent.sampled
+        else:
+            trace_id, parent_id, sampled = _new_id(16), None, self._sampler()
+        span = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=_new_id(8),
+            parent_id=parent_id,
+            tags={**self.service_tags, **{k: str(v) for k, v in tags.items()}},
+            sampled=sampled,
+        )
+        return _SpanContext(self, span)
+
+    def current(self) -> Span | None:
+        return _current_span.get()
+
+    def _finish(self, span: Span) -> None:
+        if not span.sampled:
+            return
+        with self._lock:
+            self._recent.append(span)
+            exporters = list(self._exporters)
+        for e in exporters:
+            try:
+                e.export(span)
+            except Exception:
+                log.exception("trace exporter %r failed", e)
+
+    # -- tracez -----------------------------------------------------------
+    def render_tracez(self) -> str:
+        """Plain-text zpages-style summary: per-span-name latency stats plus
+        the most recent spans (tracing.go:94-114's debug server)."""
+        with self._lock:
+            recent = list(self._recent)
+        by_name: dict[str, list[Span]] = {}
+        for s in recent:
+            by_name.setdefault(s.name, []).append(s)
+        lines = [f"tracez — service={self.service} spans={len(recent)}", ""]
+        lines.append(f"{'span':40s} {'count':>6s} {'avg_ms':>9s} {'max_ms':>9s} {'errors':>6s}")
+        for name in sorted(by_name):
+            spans = by_name[name]
+            durs = [s.duration * 1e3 for s in spans]
+            errs = sum(1 for s in spans if s.status != "OK")
+            lines.append(
+                f"{name:40s} {len(spans):6d} {sum(durs)/len(durs):9.2f} "
+                f"{max(durs):9.2f} {errs:6d}"
+            )
+        lines.append("")
+        lines.append("recent spans:")
+        for s in recent[-25:]:
+            lines.append(
+                f"  {s.name:38s} trace={s.trace_id[:8]} {s.duration*1e3:8.2f}ms "
+                f"{s.status}"
+            )
+        return "\n".join(lines) + "\n"
+
+
+#: process-wide default tracer (never-sampled until configured, so unwired
+#: code paths pay only a contextvar read)
+TRACER = Tracer(sample="never")
+
+
+def setup_tracing(
+    service: str,
+    *,
+    sample: str | None = None,
+    exporter: str | None = None,
+    node_name: str = "",
+    **exporter_kwargs,
+) -> Tracer:
+    """One-call configuration mirroring vk.Run's SetupTracing
+    (virtual-kubelet.go:244): reads ``SBT_TRACE_SAMPLE`` / ``SBT_TRACE_EXPORTER``
+    env defaults the way the Jaeger exporter is env-driven in the reference.
+    """
+    sample = sample if sample is not None else os.environ.get("SBT_TRACE_SAMPLE", "never")
+    exporter = exporter if exporter is not None else os.environ.get("SBT_TRACE_EXPORTER", "")
+    tags = {"service": service, "operatingSystem": "Linux", "provider": "slurm-bridge-tpu"}
+    if node_name:
+        tags["nodeName"] = node_name
+    TRACER.configure(sample=sample, service=service, tags=tags)
+    if exporter:
+        TRACER.add_exporter(make_exporter(exporter, **exporter_kwargs))
+    return TRACER
+
+
+# --------------------------------------------------------------------------
+# gRPC server interceptor — one span per RPC, the process-boundary hook the
+# reference gets from the virtual-kubelet library's span wrappers.
+# --------------------------------------------------------------------------
+
+def tracing_interceptor(tracer: Tracer | None = None):
+    import grpc
+
+    tracer = tracer or TRACER
+
+    class _Interceptor(grpc.ServerInterceptor):
+        def intercept_service(self, continuation, handler_call_details):
+            handler = continuation(handler_call_details)
+            if handler is None:
+                return None
+            method = handler_call_details.method.rsplit("/", 1)[-1]
+
+            def wrap_unary(behavior):
+                def inner(request, context):
+                    with tracer.span(f"rpc.{method}"):
+                        return behavior(request, context)
+                return inner
+
+            def wrap_stream(behavior):
+                def inner(request_or_iter, context):
+                    with tracer.span(f"rpc.{method}") as span:
+                        n = 0
+                        for item in behavior(request_or_iter, context):
+                            n += 1
+                            yield item
+                        span.set_tag("messages", n)
+                return inner
+
+            kind_attrs = (
+                ("unary_unary", grpc.unary_unary_rpc_method_handler, wrap_unary),
+                ("unary_stream", grpc.unary_stream_rpc_method_handler, wrap_stream),
+                ("stream_unary", grpc.stream_unary_rpc_method_handler, wrap_unary),
+                ("stream_stream", grpc.stream_stream_rpc_method_handler, wrap_stream),
+            )
+            for attr, maker, wrapper in kind_attrs:
+                behavior = getattr(handler, attr)
+                if behavior is not None:
+                    return maker(
+                        wrapper(behavior),
+                        request_deserializer=handler.request_deserializer,
+                        response_serializer=handler.response_serializer,
+                    )
+            return handler
+
+    return _Interceptor()

@@ -132,6 +132,7 @@ def serve(
     endpoint: str,
     *,
     max_workers: int = 16,
+    interceptors: tuple = (),
 ) -> grpc.Server:
     """Start a server hosting {service_name: servicer} at endpoint.
 
@@ -140,7 +141,10 @@ def serve(
     """
     from concurrent import futures
 
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        interceptors=interceptors,
+    )
     for name, servicer in servicers.items():
         server.add_generic_rpc_handlers((generic_handler(servicer, name),))
     target = normalize_endpoint(endpoint)

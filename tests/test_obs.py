@@ -1,0 +1,238 @@
+"""Tracing, probes, and leader-election tests (SURVEY.md §5 aux subsystems)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from slurm_bridge_tpu.obs.metrics import MetricsRegistry
+from slurm_bridge_tpu.obs.tracing import (
+    InMemoryExporter,
+    JsonFileExporter,
+    Tracer,
+    make_exporter,
+    parse_sampler,
+    tracing_interceptor,
+)
+
+
+class TestSampler:
+    def test_always_never(self):
+        assert parse_sampler("always")()
+        assert parse_sampler("")()
+        assert not parse_sampler("never")()
+
+    def test_percentage_bounds(self):
+        assert not parse_sampler("0")()
+        assert parse_sampler("100")()
+
+    @pytest.mark.parametrize("bad", ["maybe", "-1", "101", "always 1"])
+    def test_invalid_policy(self, bad):
+        with pytest.raises(ValueError):
+            parse_sampler(bad)
+
+
+class TestTracer:
+    def test_span_nesting_and_export(self):
+        mem = InMemoryExporter()
+        tracer = Tracer("t", sample="always").add_exporter(mem)
+        with tracer.span("outer", kind="test") as outer:
+            with tracer.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+        names = [s.name for s in mem.spans]
+        assert names == ["inner", "outer"]  # children finish first
+        assert mem.spans[1].tags["kind"] == "test"
+
+    def test_error_status_and_no_swallow(self):
+        mem = InMemoryExporter()
+        tracer = Tracer("t").add_exporter(mem)
+        with pytest.raises(RuntimeError):
+            with tracer.span("boom"):
+                raise RuntimeError("nope")
+        assert mem.spans[0].status.startswith("ERROR: RuntimeError")
+
+    def test_never_sampled_spans_not_exported(self):
+        mem = InMemoryExporter()
+        tracer = Tracer("t", sample="never").add_exporter(mem)
+        with tracer.span("quiet"):
+            pass
+        assert not mem.spans
+
+    def test_sampling_decision_inherited_by_children(self):
+        mem = InMemoryExporter()
+        tracer = Tracer("t", sample="never").add_exporter(mem)
+        with tracer.span("root") as root:
+            assert not root.sampled
+            with tracer.span("child") as child:
+                assert not child.sampled
+        assert not mem.spans
+
+    def test_service_tags_applied(self):
+        mem = InMemoryExporter()
+        tracer = Tracer("t", tags={"nodeName": "vk-1"}).add_exporter(mem)
+        with tracer.span("s"):
+            pass
+        assert mem.spans[0].tags["nodeName"] == "vk-1"
+
+    def test_jsonfile_exporter(self, tmp_path):
+        path = tmp_path / "spans.jsonl"
+        tracer = Tracer("t").add_exporter(JsonFileExporter(str(path)))
+        with tracer.span("persisted", job="42"):
+            pass
+        rec = json.loads(path.read_text().strip())
+        assert rec["name"] == "persisted"
+        assert rec["tags"]["job"] == "42"
+
+    def test_exporter_registry(self):
+        assert isinstance(make_exporter("memory"), InMemoryExporter)
+        with pytest.raises(ValueError, match="unknown trace exporter"):
+            make_exporter("jaeger-but-wrong")
+
+    def test_tracez_renders_stats(self):
+        tracer = Tracer("svc")
+        for _ in range(3):
+            with tracer.span("tick"):
+                pass
+        page = tracer.render_tracez()
+        assert "svc" in page and "tick" in page
+
+    def test_cross_thread_explicit_parent(self):
+        mem = InMemoryExporter()
+        tracer = Tracer("t").add_exporter(mem)
+        with tracer.span("root") as root:
+            done = threading.Event()
+
+            def worker():
+                with tracer.span("worker", parent=root):
+                    done.set()
+
+            threading.Thread(target=worker).start()
+            assert done.wait(2)
+        worker_span = next(s for s in mem.spans if s.name == "worker")
+        assert worker_span.trace_id == root.trace_id
+
+
+class TestRpcTracing:
+    def test_interceptor_spans_rpcs(self):
+        from slurm_bridge_tpu.wire import ServiceClient, dial, serve
+        from slurm_bridge_tpu.wire import workload_pb2 as pb
+
+        mem = InMemoryExporter()
+        tracer = Tracer("agent").add_exporter(mem)
+
+        class Servicer:
+            def WorkloadInfo(self, request, context):
+                return pb.WorkloadInfoResponse(name="slurm", version="1.0")
+
+        server = serve({"WorkloadManager": Servicer()}, "127.0.0.1:0",
+                       interceptors=(tracing_interceptor(tracer),))
+        try:
+            with ServiceClient(dial(f"127.0.0.1:{server.bound_port}"),
+                               "WorkloadManager") as client:
+                resp = client.WorkloadInfo(pb.WorkloadInfoRequest())
+                assert resp.name == "slurm"
+        finally:
+            server.stop(grace=None)
+        assert [s.name for s in mem.spans] == ["rpc.WorkloadInfo"]
+
+
+class TestProbes:
+    def _get(self, port, path):
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=3) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_healthz_readyz_and_tracez_routes(self):
+        registry = MetricsRegistry()
+        registry.counter("sbt_test_total", "x").inc()
+        ready = threading.Event()
+
+        def check_ready():
+            if not ready.is_set():
+                raise RuntimeError("not started")
+
+        tracer = Tracer("probe-test")
+        httpd = registry.serve(
+            0, host="127.0.0.1",
+            extra_routes={"/debug/tracez": lambda: ("text/plain", tracer.render_tracez())},
+            health_checks={"ping": lambda: None},
+            ready_checks={"started": check_ready},
+        )
+        port = httpd.server_address[1]
+        try:
+            assert self._get(port, "/healthz") == (200, "ok")
+            code, body = self._get(port, "/readyz")
+            assert code == 500 and "not started" in body
+            ready.set()
+            assert self._get(port, "/readyz") == (200, "ok")
+            code, body = self._get(port, "/metrics")
+            assert code == 200 and "sbt_test_total" in body
+            code, body = self._get(port, "/debug/tracez")
+            assert code == 200 and "probe-test" in body
+        finally:
+            httpd.shutdown()
+
+
+class TestLeaderElection:
+    def test_single_holder_and_takeover(self, tmp_path):
+        from slurm_bridge_tpu.bridge.leader import LeaderElector
+
+        lock = str(tmp_path / "bridge.lease")
+        a_started = threading.Event()
+        b_started = threading.Event()
+        a = LeaderElector(lock, identity="a", lease_duration=0.6,
+                          renew_interval=0.1, retry_interval=0.05,
+                          on_started=a_started.set)
+        b = LeaderElector(lock, identity="b", lease_duration=0.6,
+                          renew_interval=0.1, retry_interval=0.05,
+                          on_started=b_started.set)
+        a.start()
+        assert a_started.wait(3)
+        b.start()
+        time.sleep(0.3)
+        assert not b.is_leader  # live lease blocks the second candidate
+        # Holder dies without releasing: stop renewals only.
+        a._stop.set()
+        a._thread.join(2)
+        assert b_started.wait(3)  # b takes over after expiry
+        assert b.is_leader
+        b.stop()
+
+    def test_release_hands_off_immediately(self, tmp_path):
+        from slurm_bridge_tpu.bridge.leader import LeaderElector
+
+        lock = str(tmp_path / "lease")
+        a = LeaderElector(lock, identity="a", lease_duration=30.0,
+                          renew_interval=0.1, retry_interval=0.05)
+        a.start()
+        assert a.wait_until_leader(3)
+        a.stop()  # releases the file
+        b = LeaderElector(lock, identity="b", lease_duration=30.0,
+                          renew_interval=0.1, retry_interval=0.05)
+        b.start()
+        assert b.wait_until_leader(3)
+        b.stop()
+
+    def test_lost_lease_fires_on_stopped(self, tmp_path):
+        from slurm_bridge_tpu.bridge.leader import LeaderElector
+
+        lock = str(tmp_path / "lease")
+        lost = threading.Event()
+        a = LeaderElector(lock, identity="a", lease_duration=0.5,
+                          renew_interval=0.2, retry_interval=0.05,
+                          on_stopped=lost.set)
+        a.start()
+        assert a.wait_until_leader(3)
+        # A rival steals the lease file outright.
+        a._write({"holder": "rival", "expires": time.time() + 60})
+        assert lost.wait(3)
+        assert not a.is_leader
+        a._stop.set()
+        a._thread.join(2)
