@@ -17,10 +17,11 @@ import json
 import logging
 import os
 import socket
-import tempfile
 import threading
 import time
 import uuid
+
+from slurm_bridge_tpu.utils.files import atomic_write
 
 log = logging.getLogger("sbt.leader")
 
@@ -70,19 +71,7 @@ class LeaderElector:
             return None
 
     def _write(self, record: dict) -> None:
-        d = os.path.dirname(self.lock_path) or "."
-        os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=".lease-")
-        try:
-            with os.fdopen(fd, "w") as fh:
-                json.dump(record, fh)
-            os.replace(tmp, self.lock_path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        atomic_write(self.lock_path, json.dumps(record))
 
     def try_acquire(self) -> bool:
         """One acquire-or-renew attempt. True if we hold the lease after it.
@@ -117,12 +106,24 @@ class LeaderElector:
             os.close(guard)  # closing drops the flock
 
     def release(self) -> None:
-        rec = self._read()
-        if rec and rec.get("holder") == self.identity:
-            try:
-                os.unlink(self.lock_path)
-            except OSError:
-                pass
+        """Delete our lease, under the same flock as try_acquire so a
+        rival's in-flight takeover cannot be unlinked by our stale read."""
+        import fcntl
+
+        try:
+            guard = os.open(self.lock_path + ".flock", os.O_CREAT | os.O_RDWR, 0o644)
+        except OSError:
+            return
+        try:
+            fcntl.flock(guard, fcntl.LOCK_EX)
+            rec = self._read()
+            if rec and rec.get("holder") == self.identity:
+                try:
+                    os.unlink(self.lock_path)
+                except OSError:
+                    pass
+        finally:
+            os.close(guard)
 
     # -- loop -------------------------------------------------------------
     @property

@@ -24,6 +24,7 @@ from slurm_bridge_tpu.bridge.leader import LeaderElector
 from slurm_bridge_tpu.bridge.runtime import Bridge
 from slurm_bridge_tpu.obs.bootstrap import add_observability_flags, start_observability
 from slurm_bridge_tpu.obs.logging import setup_logging
+from slurm_bridge_tpu.utils.codec import explicit_flags
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -35,6 +36,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--configurator-interval", type=float, default=30.0)
     parser.add_argument("--leader-lock", default="",
                         help="lease file enabling leader election; empty = no election")
+    parser.add_argument("--kubelet-port", type=int, default=-1,
+                        help="kubelet-style HTTP logs API port (10250 in the "
+                             "reference); -1 disables, an explicit 0 picks a "
+                             "free port; a config-file port of 0 means disabled")
+    parser.add_argument("--kubelet-config", default="",
+                        help="virtual-node configuration YAML (ports, TLS, sync)")
     add_observability_flags(parser, metrics_port_default=8080)
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
@@ -42,11 +49,28 @@ def main(argv: list[str] | None = None) -> int:
     setup_logging(verbose=args.verbose)
     log = logging.getLogger("sbt.bridge.main")
 
+    vncfg = None
+    if args.kubelet_config:
+        from slurm_bridge_tpu.bridge.vnconfig import load_vnode_config
+
+        vncfg = load_vnode_config(args.kubelet_config)
+    # Flag-over-file precedence (server.go:237-252): the file value applies
+    # only when the flag was not actually passed. In the file, port 0 means
+    # disabled; on the flag, an explicit 0 asks for an ephemeral port.
+    passed = explicit_flags(parser, argv if argv is not None else sys.argv[1:])
+    if "kubelet_port" in passed or vncfg is None:
+        kubelet_port = args.kubelet_port
+    else:
+        kubelet_port = vncfg.port if vncfg.port > 0 else -1
     bridge = Bridge(
         args.endpoint,
         scheduler_backend=args.scheduler,
         configurator_interval=args.configurator_interval,
         operator_workers=args.threads,
+        kubelet_port=None if kubelet_port < 0 else kubelet_port,
+        kubelet_address=(vncfg.address if vncfg else "0.0.0.0"),
+        kubelet_tls_cert=(vncfg.tls_cert_file if vncfg else ""),
+        kubelet_tls_key=(vncfg.tls_key_file if vncfg else ""),
     )
 
     stop = threading.Event()
@@ -60,8 +84,19 @@ def main(argv: list[str] | None = None) -> int:
         "sbt-bridge", args, ready_checks={"started": check_ready},
     )
 
+    fatal: list[BaseException] = []
+
     def start_components() -> None:
-        bridge.start()
+        try:
+            bridge.start()
+        except BaseException as exc:
+            # Failing to start after winning the election must terminate the
+            # daemon (as it would without election), not strand a zombie
+            # that keeps renewing a lease it cannot serve.
+            log.exception("bridge failed to start; exiting")
+            fatal.append(exc)
+            stop.set()
+            return
         ready.set()
         log.info("bridge running against %s (scheduler=%s)", args.endpoint, args.scheduler)
 
@@ -86,7 +121,7 @@ def main(argv: list[str] | None = None) -> int:
         elector.stop()
     if httpd is not None:
         httpd.shutdown()
-    return 0
+    return 1 if fatal else 0
 
 
 if __name__ == "__main__":

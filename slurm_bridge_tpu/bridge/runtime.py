@@ -50,6 +50,10 @@ class Bridge:
         configurator_interval: float = 30.0,
         node_sync_interval: float = 0.25,
         operator_workers: int = 2,
+        kubelet_port: int | None = None,
+        kubelet_address: str = "127.0.0.1",
+        kubelet_tls_cert: str = "",
+        kubelet_tls_key: str = "",
     ):
         self.agent_endpoint = agent_endpoint
         self.store = ObjectStore()
@@ -81,6 +85,17 @@ class Bridge:
             scheduler_interval, self.scheduler.tick, name="scheduler"
         )
         self.fetch_worker = FetchWorker(self.store, self.client)
+        self.kubelet_server = None
+        if kubelet_port is not None:
+            from slurm_bridge_tpu.bridge.vkhttp import VirtualKubeletServer
+
+            self.kubelet_server = VirtualKubeletServer(
+                self.configurator.providers,
+                address=kubelet_address,
+                port=kubelet_port,
+                tls_cert_file=kubelet_tls_cert,
+                tls_key_file=kubelet_tls_key,
+            )
         self._started = False
 
     # ---- lifecycle ----
@@ -90,12 +105,16 @@ class Bridge:
         self.operator.start()
         self._sched_ticker.start()
         self.fetch_worker.start()
+        if self.kubelet_server is not None:
+            self.kubelet_server.start()
         self._started = True
         return self
 
     def stop(self) -> None:
         if not self._started:
             return
+        if self.kubelet_server is not None:
+            self.kubelet_server.stop()
         self._sched_ticker.stop()
         self.configurator.stop()
         self.operator.stop()

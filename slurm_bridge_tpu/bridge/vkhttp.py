@@ -1,0 +1,147 @@
+"""Kubelet-style HTTP API for the virtual nodes — the `kubectl logs` path.
+
+Reference parity: ListenAndServeSlurmVirtualKubeletServer
+(pkg/slurm-virtual-kubelet/virtual-kubelet.go:142-181), which mounts the
+virtual-kubelet library's pod routes (AttachPodRoutes — logs/exec) behind
+TLS with a restricted cipher list. Routes served here:
+
+- ``GET /containerLogs/{namespace}/{pod}/{container}[?follow=true]`` —
+  streams the job's stdout via the provider (TailFile while running with
+  follow, OpenFile otherwise), chunked.
+- ``GET /healthz`` — liveness.
+
+Exec/attach/port-forward return 501 like the reference's no-op provider
+methods (provider.go:316-398). TLS is enabled when the configured
+cert/key files exist (tryPrepareTlsCerts, server.go:351).
+"""
+
+from __future__ import annotations
+
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+log = logging.getLogger("sbt.vkhttp")
+
+
+class VirtualKubeletServer:
+    """Serves the kubelet pod routes over all in-process providers.
+
+    ``providers`` is the configurator's live registry (partition →
+    VirtualNodeProvider); a pod is looked up in each provider's store —
+    the reference runs one server per VK process, this one fronts them all.
+    """
+
+    def __init__(
+        self,
+        providers: dict,
+        *,
+        address: str = "127.0.0.1",
+        port: int = 0,
+        tls_cert_file: str = "",
+        tls_key_file: str = "",
+    ):
+        self.providers = providers
+        self.address = address
+        self.port = port
+        self.tls_cert_file = tls_cert_file
+        self.tls_key_file = tls_key_file
+        self._httpd: ThreadingHTTPServer | None = None
+
+    # -- pod lookup -------------------------------------------------------
+    def _find_provider(self, pod_name: str):
+        from slurm_bridge_tpu.bridge.objects import Pod
+
+        for provider in list(self.providers.values()):
+            try:
+                provider.store.get(Pod.KIND, pod_name)
+                return provider
+            except Exception:
+                continue
+        return None
+
+    # -- server -----------------------------------------------------------
+    def start(self) -> "VirtualKubeletServer":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _plain(self, status: int, text: str) -> None:
+                body = text.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                # exec/attach/portforward: explicit 501s (provider.go:316-398)
+                self._plain(501, "not implemented\n")
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                parts = [p for p in url.path.split("/") if p]
+                if url.path.startswith("/healthz"):
+                    return self._plain(200, "ok")
+                if len(parts) == 4 and parts[0] == "containerLogs":
+                    _, _ns, pod_name, _container = parts
+                    follow = parse_qs(url.query).get("follow", ["false"])[0] == "true"
+                    return self._stream_logs(pod_name, follow)
+                if parts and parts[0] in ("exec", "attach", "portForward", "run"):
+                    return self._plain(501, "not implemented\n")
+                self._plain(404, "not found\n")
+
+            def _stream_logs(self, pod_name: str, follow: bool) -> None:
+                provider = outer._find_provider(pod_name)
+                if provider is None:
+                    return self._plain(404, f"pod {pod_name} not found\n")
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for chunk in provider.pod_logs(pod_name, follow=follow):
+                        if not chunk:
+                            continue
+                        self.wfile.write(f"{len(chunk):x}\r\n".encode())
+                        self.wfile.write(chunk)
+                        self.wfile.write(b"\r\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    return  # client went away mid-follow (kubectl ^C)
+                except Exception as exc:
+                    log.warning("log stream for %s failed: %s", pod_name, exc)
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+
+        httpd = ThreadingHTTPServer((self.address, self.port), Handler)
+        if self.tls_cert_file and self.tls_key_file:
+            import os
+
+            if os.path.exists(self.tls_cert_file) and os.path.exists(self.tls_key_file):
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ctx.minimum_version = ssl.TLSVersion.TLSv1_2  # restricted ciphers
+                ctx.load_cert_chain(self.tls_cert_file, self.tls_key_file)
+                httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
+            else:
+                log.warning("TLS files missing; serving plaintext (reference "
+                            "falls back the same way when cert bootstrap fails)")
+        self._httpd = httpd
+        self.port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="vk-http").start()
+        log.info("kubelet API on %s:%d", self.address, self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None

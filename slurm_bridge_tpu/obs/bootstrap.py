@@ -44,12 +44,13 @@ def start_observability(
 
     Returns the HTTP server (caller shuts it down) or None when disabled.
     Flags left at None fall through to the SBT_TRACE_* env vars inside
-    :func:`setup_tracing`; empty-string values mean "off".
+    :func:`setup_tracing`; an explicitly empty value means "off"
+    (sample "" → never, exporter "" → none), overriding the env.
     """
     setup_tracing(
         service,
-        sample=args.trace_sample,
-        exporter=args.trace_exporter or None,
+        sample="never" if args.trace_sample == "" else args.trace_sample,
+        exporter="" if args.trace_exporter == "" else (args.trace_exporter or None),
         node_name=node_name,
     )
     if not getattr(args, "metrics_port", 0):

@@ -1,0 +1,39 @@
+"""Atomic file helpers.
+
+Reference parity: the VK's util/files atomic-write helpers (SURVEY.md
+§2.5): write to a temp file in the destination directory, fsync, then
+rename over the target so readers never observe a partial file — the same
+pattern the reference uses for kubelet TLS bootstrap artifacts.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def ensure_dir(path: str, mode: int = 0o755) -> str:
+    os.makedirs(path, mode=mode, exist_ok=True)
+    return path
+
+
+def atomic_write(path: str, data: bytes | str, *, mode: int = 0o644) -> None:
+    """Write ``data`` to ``path`` atomically (tempfile + rename)."""
+    if isinstance(data, str):
+        data = data.encode()
+    d = os.path.dirname(os.path.abspath(path))
+    ensure_dir(d)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=f".{os.path.basename(path)}.")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.chmod(tmp, mode)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

@@ -1,0 +1,60 @@
+"""Flag value validators — argparse ``type=`` callables.
+
+Reference parity: pkg/common/flag/flags.go:37-152, the pflag ``IPVar`` /
+``IPPortVar`` / ``PortRangeVar`` validators the kubelet-style flag system
+uses. Each raises ``argparse.ArgumentTypeError`` on bad input so argparse
+renders the usage error, matching pflag's set-time validation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ipaddress
+
+
+def ip_address(value: str) -> str:
+    """A bare IPv4/IPv6 address (IPVar)."""
+    try:
+        ipaddress.ip_address(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"{value!r} is not a valid IP address") from None
+    return value
+
+
+def ip_port(value: str) -> str:
+    """``ip:port`` or bare ``port`` (IPPortVar accepts both forms)."""
+    host, sep, port = value.rpartition(":")
+    if not sep:
+        host, port = "", value
+    elif not host:
+        raise argparse.ArgumentTypeError(f"{value!r}: empty host before ':'")
+    if host:
+        h = host[1:-1] if host.startswith("[") and host.endswith("]") else host
+        try:
+            ipaddress.ip_address(h)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"{value!r}: {h!r} is not a valid IP address"
+            ) from None
+    try:
+        p = int(port)
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"{value!r}: port {port!r} is not a number") from None
+    if not 1 <= p <= 65535:
+        raise argparse.ArgumentTypeError(f"{value!r}: port {p} outside 1-65535")
+    return value
+
+
+def port_range(value: str) -> tuple[int, int]:
+    """``lo-hi`` (inclusive) or a single port (PortRangeVar)."""
+    lo_s, sep, hi_s = value.partition("-")
+    try:
+        lo = int(lo_s)
+        hi = int(hi_s) if sep else lo
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"{value!r} is not a port range") from None
+    if not (1 <= lo <= 65535 and 1 <= hi <= 65535):
+        raise argparse.ArgumentTypeError(f"{value!r}: ports outside 1-65535")
+    if hi < lo:
+        raise argparse.ArgumentTypeError(f"{value!r}: range is inverted")
+    return (lo, hi)

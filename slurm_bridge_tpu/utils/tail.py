@@ -1,0 +1,219 @@
+"""Line-oriented file following with rotation handling and rate limiting.
+
+Reference parity: the vendored hpcloud/tail fork (pkg/tail, SURVEY.md
+§2.8): ``Config`` with Follow/ReOpen/Poll/MaxLineSize/RateLimiter
+(tail.go:56-72), truncation restart, reopen-on-rotation (``tail -F``),
+and the leaky-bucket rate limiter (ratelimiter/leakybucket.go:97). The
+reference watches via inotify with a polling fallback; this implementation
+polls outright (same cadence as its 250ms polling watcher, watch/polling.go)
+— the TPU rebuild has no native-watcher dependency to vendor.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class LeakyBucket:
+    """Token bucket: ``capacity`` tokens, one regenerated every ``interval``
+    seconds (ratelimiter/leakybucket.go's semantics — a *pour* takes a
+    token; an empty bucket means throttle)."""
+
+    def __init__(self, capacity: int, interval: float):
+        self.capacity = capacity
+        self.interval = interval
+        self._level = float(capacity)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def pour(self, n: int = 1) -> bool:
+        """Take n tokens; False (throttled) if not available."""
+        with self._lock:
+            now = time.monotonic()
+            if self.interval > 0:
+                self._level = min(
+                    float(self.capacity), self._level + (now - self._last) / self.interval
+                )
+            self._last = now
+            if self._level >= n:
+                self._level -= n
+                return True
+            return False
+
+    def wait_time(self, n: int = 1) -> float:
+        with self._lock:
+            deficit = n - self._level
+        return max(0.0, deficit * self.interval)
+
+
+@dataclass
+class TailConfig:
+    """tail.Config equivalent (tail.go:56-72)."""
+
+    follow: bool = True          # Follow: keep reading as the file grows
+    reopen: bool = False         # ReOpen: tail -F across rotations
+    poll_interval: float = 0.25  # watch/polling.go's 250ms cadence
+    max_line_size: int = 0       # 0 = unlimited; longer lines are split
+    from_end: bool = False       # start at EOF (Location{0, io.SeekEnd})
+    rate_limiter: LeakyBucket | None = None
+
+
+@dataclass
+class Line:
+    """A tailed line (tail.Line): text without the newline + read time."""
+
+    text: str
+    time: float = field(default_factory=time.time)
+    err: str = ""
+
+
+class Tail:
+    """Iterate lines of a (possibly growing, possibly rotating) file.
+
+    ``for line in Tail(path, TailConfig(...)):`` yields :class:`Line`s;
+    the iterator ends when follow is off and EOF is reached, when the file
+    vanishes with reopen off, or when :meth:`stop` is called. A throttled
+    tail emits a ``Line(err="rate limit exceeded...")`` marker and pauses,
+    like the reference's leaky-bucket handling in tail.go.
+    """
+
+    def __init__(self, path: str, config: TailConfig | None = None):
+        self.path = path
+        self.config = config or TailConfig()
+        self._stop = threading.Event()
+        self._fh: io.BufferedReader | None = None
+        self._ino: int | None = None
+        self._buf = b""
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- file lifecycle ---------------------------------------------------
+    def _open(self, *, initial: bool) -> bool:
+        try:
+            fh = open(self.path, "rb")
+        except OSError:
+            return False
+        self._fh = fh
+        try:
+            self._ino = os.fstat(fh.fileno()).st_ino
+        except OSError:
+            self._ino = None
+        if initial and self.config.from_end:
+            fh.seek(0, os.SEEK_END)
+        return True
+
+    def _rotated(self) -> bool:
+        """True when the path now names a different file (rotation) or the
+        current file shrank (truncation)."""
+        assert self._fh is not None
+        try:
+            st_path = os.stat(self.path)
+        except OSError:
+            return True  # vanished; reopen will retry
+        if self._ino is not None and st_path.st_ino != self._ino:
+            return True
+        return st_path.st_size < self._fh.tell()
+
+    def _close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        self._buf = b""
+
+    # -- iteration --------------------------------------------------------
+    def __iter__(self):
+        cfg = self.config
+        opened_before = False
+        while not self._stop.is_set():
+            if self._fh is None:
+                if not self._open(initial=not opened_before):
+                    if opened_before and not cfg.reopen:
+                        return  # our file was rotated away and reopen is off
+                    if not cfg.follow and not cfg.reopen:
+                        return
+                    # follow: block until the file appears (tail -f semantics)
+                    if self._stop.wait(cfg.poll_interval):
+                        return
+                    continue
+                opened_before = True
+            chunk = self._fh.read(65536)
+            if chunk:
+                self._buf += chunk
+                yield from self._drain_lines()
+                continue
+            # EOF. Truncation/rotation checks, then follow-or-finish.
+            if self._rotated():
+                if cfg.reopen:
+                    self._close()
+                    continue
+                # plain truncation with reopen off: restart from the top,
+                # like the reference's pure-truncate handling; drop any
+                # partial line buffered from the pre-truncation file
+                try:
+                    if os.stat(self.path).st_ino == self._ino:
+                        self._fh.seek(0)
+                        self._buf = b""
+                        continue
+                except OSError:
+                    pass
+                break
+            if not cfg.follow:
+                break
+            if self._stop.wait(cfg.poll_interval):
+                break
+        # emit any unterminated final line
+        if self._buf:
+            yield from self._emit(self._buf)
+            self._buf = b""
+        self._close()
+
+    def _drain_lines(self):
+        while True:
+            nl = self._buf.find(b"\n")
+            if nl < 0:
+                # oversize handling without a newline in sight
+                if self.config.max_line_size and len(self._buf) >= self.config.max_line_size:
+                    piece, self._buf = (
+                        self._buf[: self.config.max_line_size],
+                        self._buf[self.config.max_line_size:],
+                    )
+                    yield from self._emit(piece)
+                    continue
+                return
+            line, self._buf = self._buf[:nl], self._buf[nl + 1:]
+            yield from self._emit(line)
+
+    def _emit(self, raw: bytes):
+        cfg = self.config
+        pieces = [raw]
+        if cfg.max_line_size and len(raw) > cfg.max_line_size:
+            pieces = [
+                raw[i: i + cfg.max_line_size]
+                for i in range(0, len(raw), cfg.max_line_size)
+            ]
+        for piece in pieces:
+            if cfg.rate_limiter is not None and not cfg.rate_limiter.pour():
+                yield Line(text="", err="rate limit exceeded, waiting for more tokens")
+                wait = cfg.rate_limiter.wait_time()
+                deadline = time.monotonic() + wait
+                while not self._stop.is_set() and time.monotonic() < deadline:
+                    if cfg.rate_limiter.pour():
+                        break
+                    self._stop.wait(min(0.05, cfg.poll_interval))
+                else:
+                    if self._stop.is_set():
+                        return
+            yield Line(text=piece.decode("utf-8", "replace"))
+
+
+def tail_lines(path: str, **config_kwargs):
+    """Convenience: iterate Line.text for a finite (non-follow) read."""
+    cfg = TailConfig(follow=False, **config_kwargs)
+    for line in Tail(path, cfg):
+        if not line.err:
+            yield line.text

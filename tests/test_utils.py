@@ -1,0 +1,301 @@
+"""Support-layer tests: flag validators, codec, atomic files, fs watcher,
+tail (SURVEY.md §2.8 pkg/common/flag, pkg/filesystem, pkg/tail, §2.5 codec)."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import threading
+import time
+
+import pytest
+
+from slurm_bridge_tpu.utils.codec import (
+    ConfigError,
+    decode_yaml_config,
+    encode_yaml_config,
+    explicit_flags,
+    merge_flags_over_file,
+    resolve_relative_paths,
+)
+from slurm_bridge_tpu.utils.files import atomic_write
+from slurm_bridge_tpu.utils.flags import ip_address, ip_port, port_range
+from slurm_bridge_tpu.utils.fs import DefaultFs, FsWatcher
+from slurm_bridge_tpu.utils.tail import LeakyBucket, Tail, TailConfig, tail_lines
+
+
+class TestFlagValidators:
+    """Table-driven like pkg/common/flag/flags_test.go."""
+
+    @pytest.mark.parametrize("ok", ["127.0.0.1", "::1", "10.0.0.255"])
+    def test_ip_ok(self, ok):
+        assert ip_address(ok) == ok
+
+    @pytest.mark.parametrize("bad", ["256.0.0.1", "localhost", "", "1.2.3"])
+    def test_ip_bad(self, bad):
+        with pytest.raises(argparse.ArgumentTypeError):
+            ip_address(bad)
+
+    @pytest.mark.parametrize("ok", ["127.0.0.1:8080", "8080", "[::1]:443"])
+    def test_ip_port_ok(self, ok):
+        assert ip_port(ok) == ok
+
+    @pytest.mark.parametrize("bad", ["127.0.0.1:0", "1.2.3.4:99999", "host:80", ":80"])
+    def test_ip_port_bad(self, bad):
+        with pytest.raises(argparse.ArgumentTypeError):
+            ip_port(bad)
+
+    def test_port_range(self):
+        assert port_range("100-200") == (100, 200)
+        assert port_range("8080") == (8080, 8080)
+        for bad in ["200-100", "0-10", "a-b", "1-70000"]:
+            with pytest.raises(argparse.ArgumentTypeError):
+                port_range(bad)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Inner:
+    host: str = "localhost"
+    port: int = 10250
+
+
+@dataclasses.dataclass(frozen=True)
+class _Cfg:
+    name: str = ""
+    replicas: int = 1
+    ratio: float = 0.5
+    inner: _Inner = dataclasses.field(default_factory=_Inner)
+    tags: list[str] = dataclasses.field(default_factory=list)
+    cert_file: str = ""
+
+
+class TestCodec:
+    def test_defaults_applied(self):
+        cfg = decode_yaml_config("name: x\n", _Cfg)
+        assert cfg == _Cfg(name="x")
+        assert cfg.inner.port == 10250
+
+    def test_nested_and_lists(self):
+        cfg = decode_yaml_config(
+            "name: x\ninner: {host: agent, port: 9}\ntags: [a, b]\n", _Cfg
+        )
+        assert cfg.inner == _Inner("agent", 9)
+        assert cfg.tags == ["a", "b"]
+
+    def test_strict_rejects_unknown_but_lenient_accepts(self, caplog):
+        # unknown field → strict fails → lenient pass succeeds with warning
+        cfg = decode_yaml_config("name: x\nfutureField: 3\n", _Cfg)
+        assert cfg.name == "x"
+
+    def test_type_error_not_rescued_when_lenient_also_fails(self):
+        with pytest.raises(ConfigError):
+            decode_yaml_config("replicas: [not, an, int]\n", _Cfg)
+
+    def test_lenient_coerces_strings(self):
+        cfg = decode_yaml_config("name: x\nreplicas: '7'\n", _Cfg)
+        assert cfg.replicas == 7
+
+    def test_int_float_promotion(self):
+        assert decode_yaml_config("ratio: 1\n", _Cfg).ratio == 1.0
+
+    def test_roundtrip(self):
+        cfg = _Cfg(name="rt", replicas=3, tags=["t"])
+        assert decode_yaml_config(encode_yaml_config(cfg), _Cfg) == cfg
+
+    def test_resolve_relative_paths(self):
+        cfg = _Cfg(cert_file="certs/tls.crt")
+        out = resolve_relative_paths(cfg, "/etc/sbt", ("cert_file",))
+        assert out.cert_file == "/etc/sbt/certs/tls.crt"
+        absolute = _Cfg(cert_file="/abs/tls.crt")
+        assert resolve_relative_paths(absolute, "/etc/sbt", ("cert_file",)) is absolute
+
+    def test_flag_over_file_precedence(self):
+        parser = argparse.ArgumentParser()
+        parser.add_argument("--replicas", type=int, default=1)
+        parser.add_argument("--name", default="")
+        argv = ["--replicas", "9"]
+        args = parser.parse_args(argv)
+        passed = explicit_flags(parser, argv)
+        assert passed == {"replicas"}
+        file_cfg = _Cfg(name="from-file", replicas=2)
+        merged = merge_flags_over_file(
+            file_cfg, args, passed, {"replicas": "replicas", "name": "name"}
+        )
+        assert merged.replicas == 9        # flag explicitly passed → wins
+        assert merged.name == "from-file"  # flag defaulted → file wins
+
+
+class TestAtomicFiles:
+    def test_atomic_write_and_no_partial(self, tmp_path):
+        target = tmp_path / "out.txt"
+        atomic_write(str(target), "hello")
+        assert target.read_text() == "hello"
+        atomic_write(str(target), b"world", mode=0o600)
+        assert target.read_text() == "world"
+        assert (os.stat(target).st_mode & 0o777) == 0o600
+        assert [p.name for p in tmp_path.iterdir()] == ["out.txt"]  # no temp debris
+
+
+class TestFsWatcher:
+    def test_create_modify_delete_events(self, tmp_path):
+        events = []
+        w = FsWatcher(lambda ev, p: events.append((ev, os.path.basename(p))))
+        target = tmp_path / "watched.yaml"
+        w.add(str(target))
+        target.write_text("a")
+        w.trigger_now()
+        os.utime(target, (time.time() + 5, time.time() + 5))
+        w.trigger_now()
+        target.unlink()
+        w.trigger_now()
+        assert events == [
+            ("create", "watched.yaml"),
+            ("modify", "watched.yaml"),
+            ("delete", "watched.yaml"),
+        ]
+
+    def test_default_fs_tempdir_prefixing(self, tmp_path):
+        fs = DefaultFs(root=str(tmp_path))
+        d = fs.temp_dir("sbt-")
+        assert d.startswith(str(tmp_path))
+        fs.write_file(os.path.join(d, "f"), b"x")
+        assert fs.read_file(os.path.join(d, "f")) == b"x"
+        fs.remove_all(d)
+        assert not fs.exists(d)
+
+
+class TestTail:
+    def test_finite_read(self, tmp_path):
+        p = tmp_path / "log"
+        p.write_text("one\ntwo\nthree")
+        assert list(tail_lines(str(p))) == ["one", "two", "three"]
+
+    def test_follow_sees_appends(self, tmp_path):
+        p = tmp_path / "log"
+        p.write_text("first\n")
+        tail = Tail(str(p), TailConfig(follow=True, poll_interval=0.02))
+        got = []
+
+        def consume():
+            for line in tail:
+                got.append(line.text)
+                if line.text == "last":
+                    tail.stop()
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.1)
+        with open(p, "a") as f:
+            f.write("second\nlast\n")
+        t.join(5)
+        assert got == ["first", "second", "last"]
+
+    def test_truncation_restarts_from_top(self, tmp_path):
+        p = tmp_path / "log"
+        p.write_text("aaaa\nbbbb\n")
+        tail = Tail(str(p), TailConfig(follow=True, poll_interval=0.02))
+        got = []
+
+        def consume():
+            for line in tail:
+                got.append(line.text)
+                if line.text == "new":
+                    tail.stop()
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.15)
+        p.write_text("new\n")  # truncate + rewrite smaller
+        t.join(5)
+        assert got == ["aaaa", "bbbb", "new"]
+
+    def test_reopen_follows_rotation(self, tmp_path):
+        p = tmp_path / "log"
+        p.write_text("before\n")
+        tail = Tail(str(p), TailConfig(follow=True, reopen=True, poll_interval=0.02))
+        got = []
+
+        def consume():
+            for line in tail:
+                got.append(line.text)
+                if line.text == "after":
+                    tail.stop()
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.15)
+        os.rename(p, tmp_path / "log.1")  # rotate
+        time.sleep(0.1)
+        p.write_text("after\n")  # new file at same path
+        t.join(5)
+        assert got == ["before", "after"]
+
+    def test_max_line_size_splits(self, tmp_path):
+        p = tmp_path / "log"
+        p.write_text("abcdefghij\nshort\n")
+        cfg = TailConfig(follow=False, max_line_size=4)
+        texts = [l.text for l in Tail(str(p), cfg) if not l.err]
+        assert texts == ["abcd", "efgh", "ij", "shor", "t"]
+
+    def test_from_end_skips_existing(self, tmp_path):
+        p = tmp_path / "log"
+        p.write_text("old\n")
+        tail = Tail(str(p), TailConfig(follow=True, from_end=True, poll_interval=0.02))
+        got = []
+
+        def consume():
+            for line in tail:
+                got.append(line.text)
+                tail.stop()
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.1)
+        with open(p, "a") as f:
+            f.write("fresh\n")
+        t.join(5)
+        assert got == ["fresh"]
+
+    def test_rate_limiter_emits_marker(self, tmp_path):
+        p = tmp_path / "log"
+        p.write_text("".join(f"l{i}\n" for i in range(20)))
+        bucket = LeakyBucket(capacity=5, interval=0.01)
+        cfg = TailConfig(follow=False, rate_limiter=bucket)
+        lines = list(Tail(str(p), cfg))
+        errs = [l for l in lines if l.err]
+        texts = [l.text for l in lines if not l.err]
+        assert len(errs) >= 1            # throttle marker surfaced
+        assert texts == [f"l{i}" for i in range(20)]  # no data lost
+
+    def test_leaky_bucket_regenerates(self):
+        b = LeakyBucket(capacity=2, interval=0.02)
+        assert b.pour() and b.pour()
+        assert not b.pour()
+        time.sleep(0.05)
+        assert b.pour()
+
+
+class TestVnodeConfig:
+    def test_load_with_defaults_and_relative_tls(self, tmp_path):
+        from slurm_bridge_tpu.bridge.vnconfig import load_vnode_config
+
+        cfg_file = tmp_path / "vk.yaml"
+        cfg_file.write_text(
+            "node_name: slurm-partition-debug\n"
+            "partition: debug\n"
+            "tls_cert_file: certs/kubelet.crt\n"
+        )
+        cfg = load_vnode_config(str(cfg_file))
+        assert cfg.port == 10250          # default (slurm_virtual_kubelet_defaults.go:44)
+        assert cfg.pods == 10000
+        assert cfg.tls_cert_file == str(tmp_path / "certs/kubelet.crt")
+        assert cfg.tls_key_file == "/var/lib/sbt/kubelet.key"  # absolute default kept
+
+    def test_validation_rejects_bad_ports(self, tmp_path):
+        from slurm_bridge_tpu.bridge.vnconfig import load_vnode_config
+
+        cfg_file = tmp_path / "vk.yaml"
+        cfg_file.write_text("port: 70000\n")
+        with pytest.raises(ConfigError, match="port"):
+            load_vnode_config(str(cfg_file))

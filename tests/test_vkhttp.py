@@ -1,0 +1,74 @@
+"""Kubelet-style HTTP API tests — the `kubectl logs` route
+(ListenAndServeSlurmVirtualKubeletServer, virtual-kubelet.go:142-181)."""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import urllib.error
+import urllib.request
+
+import pytest
+
+from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
+from slurm_bridge_tpu.bridge import Bridge, BridgeJobSpec, JobState
+from slurm_bridge_tpu.bridge.operator import sizecar_name
+from slurm_bridge_tpu.wire import serve
+
+FAKESLURM = str(pathlib.Path(__file__).parent / "fakeslurm")
+
+
+@pytest.fixture
+def fake_slurm(tmp_path, monkeypatch):
+    state = tmp_path / "slurm-state"
+    monkeypatch.setenv("SBT_FAKESLURM_STATE", str(state))
+    monkeypatch.setenv("PATH", FAKESLURM + os.pathsep + os.environ["PATH"])
+    return state
+
+
+@pytest.fixture
+def bridge(fake_slurm, tmp_path):
+    sock = str(tmp_path / "agent.sock")
+    server = serve(
+        {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
+        sock,
+    )
+    b = Bridge(
+        sock,
+        scheduler_backend="greedy",
+        scheduler_interval=0.05,
+        configurator_interval=5.0,
+        node_sync_interval=0.05,
+        kubelet_port=0,  # pick a free port
+    ).start()
+    yield b
+    b.stop()
+    server.stop(None)
+
+
+def _get(port: int, path: str) -> tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_container_logs_route(bridge):
+    bridge.submit(
+        "weblog",
+        BridgeJobSpec(partition="debug", sbatch_script="#!/bin/sh\necho via-kubelet-api\n"),
+    )
+    job = bridge.wait("weblog", timeout=20.0)
+    assert job.status.state == JobState.SUCCEEDED
+    port = bridge.kubelet_server.port
+    code, body = _get(port, f"/containerLogs/default/{sizecar_name('weblog')}/job")
+    assert code == 200
+    assert b"via-kubelet-api" in body
+
+
+def test_unknown_pod_404_and_exec_501(bridge):
+    port = bridge.kubelet_server.port
+    assert _get(port, "/containerLogs/default/nope/job")[0] == 404
+    assert _get(port, "/exec/default/p/c")[0] == 501
+    assert _get(port, "/healthz")[0] == 200
