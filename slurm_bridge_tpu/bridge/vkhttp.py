@@ -144,4 +144,5 @@ class VirtualKubeletServer:
     def stop(self) -> None:
         if self._httpd is not None:
             self._httpd.shutdown()
+            self._httpd.server_close()  # release the bound listening socket
             self._httpd = None

@@ -14,6 +14,8 @@ Solver paths (BASELINE.md scenarios):
                     in-process baseline the ≥10× target is measured against
 - ``auction``       jit/vmap auction-LP sweep, single device
 - ``sharded``       shard_map/psum multi-device sweep
+- ``streaming``     warm-start re-solve with incumbents pinned — stability,
+                    preemption and 1k/s churn (BASELINE config #5)
 """
 
 from slurm_bridge_tpu.solver.snapshot import (
@@ -26,6 +28,13 @@ from slurm_bridge_tpu.solver.snapshot import (
 )
 from slurm_bridge_tpu.solver.greedy import greedy_place
 from slurm_bridge_tpu.solver.auction import auction_place, AuctionConfig
+from slurm_bridge_tpu.solver.streaming import (
+    StreamingSim,
+    TickResult,
+    churn_scenario,
+    churn_step,
+    streaming_place,
+)
 
 __all__ = [
     "ClusterSnapshot",
@@ -37,4 +46,9 @@ __all__ = [
     "greedy_place",
     "auction_place",
     "AuctionConfig",
+    "StreamingSim",
+    "TickResult",
+    "churn_scenario",
+    "churn_step",
+    "streaming_place",
 ]

@@ -192,6 +192,7 @@ def _auction_kernel(
     prio,  # [P] f32
     gang,  # [P] i32 (values < P)
     scale,  # [R] f32 resource normalisers
+    incumbent,  # [P] i32 node currently held (-1 = free agent)
     *,
     rounds: int,
     num_nodes: int,
@@ -210,6 +211,14 @@ def _auction_kernel(
     part_ok = (job_part[:, None] == node_part[None, :]) | (job_part[:, None] < 0)
     feat_ok = (node_feat[None, :] & req_feat[:, None]) == req_feat[:, None]
     static_ok = part_ok & feat_ok  # [P, N] bool
+    # Streaming reschedule (BASELINE config #5): an incumbent shard — one
+    # already running on a node — may only bid on the node it holds (Slurm
+    # jobs cannot migrate). ``free0`` is expected to have ALL modeled usage
+    # released, so incumbents re-admit against everyone else priority-ordered:
+    # keep-vs-preempt falls out of the ordinary admission step.
+    inc = incumbent >= 0
+    own = jax.lax.broadcasted_iota(jnp.int32, (p, n), 1) == incumbent[:, None]
+    static_ok = jnp.where(inc[:, None], own & static_ok, static_ok)
     multi = multi_mask(gang, p)
 
     def round_body(rnd, carry):
@@ -280,8 +289,15 @@ def auction_place(
     snapshot: ClusterSnapshot,
     batch: JobBatch,
     config: AuctionConfig | None = None,
+    *,
+    incumbent: np.ndarray | None = None,
 ) -> Placement:
-    """Solve one tick on the default JAX device."""
+    """Solve one tick on the default JAX device.
+
+    ``incumbent`` ([P] int32, -1 = none) marks shards already holding a node
+    for the streaming-reschedule path; ``snapshot.free`` must then reflect
+    capacity with those incumbents' usage released (see :mod:`streaming`).
+    """
     cfg = config or AuctionConfig()
     if batch.num_shards == 0:
         return Placement(
@@ -289,6 +305,8 @@ def auction_place(
             placed=np.zeros(0, bool),
             free_after=snapshot.free.copy(),
         )
+    if incumbent is None:
+        incumbent = np.full(batch.num_shards, -1, np.int32)
     scale = resource_scale(snapshot)
     assign, free_after = _auction_kernel(
         jnp.asarray(snapshot.free),
@@ -300,6 +318,7 @@ def auction_place(
         jnp.asarray(batch.priority),
         jnp.asarray(normalize_gangs(batch.gang_id)),
         jnp.asarray(scale),
+        jnp.asarray(incumbent, dtype=jnp.int32),
         rounds=cfg.rounds,
         num_nodes=snapshot.num_nodes,
         eta=cfg.eta,

@@ -38,6 +38,7 @@ from slurm_bridge_tpu.solver.auction import (
     gang_revoke,
     hash_jitter,
     multi_mask,
+    normalize_gangs,
     price_step,
     resource_scale,
     used_capacity,
@@ -67,6 +68,7 @@ def _make_sharded_kernel(
             P("dp"),  # prio
             P("dp"),  # gang
             P(),  # scale [R]
+            P("dp"),  # incumbent
         ),
         out_specs=(P(), P()),  # assign [P], free_after [N, R] — replicated
         # the control path (admission/pricing) is computed redundantly on
@@ -77,6 +79,7 @@ def _make_sharded_kernel(
     def kernel(
         free0_blk, node_part_blk, node_feat_blk,
         dem_blk, job_part_blk, req_feat_blk, prio_blk, gang_blk, scale,
+        incumbent_blk,
     ):
         pblk = dem_blk.shape[0]
         nblk = free0_blk.shape[0]
@@ -105,6 +108,11 @@ def _make_sharded_kernel(
             :, None
         ]
         static_ok = part_ok & feat_ok  # [P/dp, N/mp]
+        # streaming incumbents may only bid on the (global) node they hold
+        # — see auction.py; the block compares against its global indices
+        ni = n_off + jax.lax.broadcasted_iota(jnp.int32, (pblk, nblk), 1)
+        own = ni == incumbent_blk[:, None]
+        static_ok = jnp.where((incumbent_blk >= 0)[:, None], own & static_ok, static_ok)
 
         def round_body(rnd, carry):
             assign, price = carry  # replicated [P], [N]
@@ -168,6 +176,7 @@ def sharded_place(
     config: AuctionConfig | None = None,
     *,
     mesh: Mesh | None = None,
+    incumbent: np.ndarray | None = None,
 ) -> Placement:
     """Solve one tick sharded over every available device."""
     cfg = config or AuctionConfig()
@@ -187,10 +196,16 @@ def sharded_place(
     job_part, _ = pad_to_multiple(batch.partition_of, dp, value=_PAD_PART)
     req_feat, _ = pad_to_multiple(batch.req_features, dp)
     prio, _ = pad_to_multiple(batch.priority, dp, value=np.float32(-1e30))
-    # padded shards get fresh singleton gang ids so they never merge
+    # padded shards get fresh singleton gang ids so they never merge; real
+    # ids are remapped onto [0, p_real) — the kernel's segment ops use them
+    # with num_segments=P, so raw persistent ids (streaming churn grows them
+    # without bound) must never reach it
     p_total = dem.shape[0]
     gang = np.arange(p_total, dtype=np.int32)
-    gang[:p_real] = batch.gang_id
+    gang[:p_real] = normalize_gangs(batch.gang_id)
+    inc = np.full(p_total, -1, dtype=np.int32)
+    if incumbent is not None:
+        inc[:p_real] = incumbent
 
     kernel = _make_sharded_kernel(
         mesh, cfg.rounds, n_total, cfg.eta, cfg.jitter, cfg.affinity_weight, dtype
@@ -206,6 +221,7 @@ def sharded_place(
             jnp.asarray(prio),
             jnp.asarray(gang),
             jnp.asarray(resource_scale(snapshot)),
+            jnp.asarray(inc),
         )
     assign_np = np.asarray(assign)[:p_real]
     # padded shards can never place (impossible partition), padded nodes can

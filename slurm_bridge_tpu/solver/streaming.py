@@ -1,0 +1,232 @@
+"""Streaming reschedule — BASELINE config #5 (50k pods + 1k/s churn).
+
+The reference has no analogue: its placement is one kube-scheduler decision
+per pod, and a running job's fate is never revisited. Here every tick is a
+full re-solve of the *entire* modeled workload — running jobs included —
+under one rule: a running ("incumbent") shard may only bid on the node it
+already holds (Slurm jobs cannot migrate), while all capacity is notionally
+released and re-admitted priority-ordered. Three behaviors fall out of that
+single fixed-shape kernel with no extra control flow:
+
+- **stability**: with enough capacity, every incumbent re-wins its own node
+  (deterministic bids, priority-ordered admission) — placements do not flap
+  tick to tick (SURVEY.md §7 "Determinism & idempotency");
+- **preemption**: when a higher-priority job contends for a full node, the
+  admission prefix cuts off the low-priority incumbent — it simply fails to
+  re-admit, which the caller reports as preempted (requeue/kill is the
+  control plane's move, mirroring Slurm partition preemption);
+- **churn**: arrivals are new free-agent rows, departures are dropped rows;
+  there is no incremental bookkeeping to drift, because free capacity is
+  recomputed statelessly from the surviving assignment every tick.
+
+``StreamingSim`` is the tick driver used by the benchmark harness and the
+tests; ``streaming_place`` is the functional core.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from slurm_bridge_tpu.solver.auction import AuctionConfig, auction_place
+from slurm_bridge_tpu.solver.snapshot import (
+    ClusterSnapshot,
+    JobBatch,
+    Placement,
+    random_scenario,
+)
+
+#: Priority boost that makes incumbents un-preemptable when preemption is off.
+_KEEP_BOOST = np.float32(1e6)
+
+
+@dataclass
+class TickResult:
+    """One streaming tick's outcome, shard-aligned with the solved batch."""
+
+    placement: Placement
+    incumbent: np.ndarray  # [P] bool — was running before this tick
+    kept: np.ndarray  # [P] bool — incumbent that re-won its node
+    preempted: np.ndarray  # [P] bool — incumbent that lost admission
+    started: np.ndarray  # [P] bool — free agent newly placed
+
+    @property
+    def stability(self) -> float:
+        """Fraction of incumbent shards that kept their node (1.0 = no flap)."""
+        n_inc = int(self.incumbent.sum())
+        return float(self.kept.sum()) / n_inc if n_inc else 1.0
+
+
+def streaming_place(
+    snapshot: ClusterSnapshot,
+    batch: JobBatch,
+    incumbent: np.ndarray,
+    config: AuctionConfig | None = None,
+    *,
+    preemption: bool = True,
+    sharded: bool = False,
+) -> TickResult:
+    """Re-solve one tick with incumbents pinned to their nodes.
+
+    ``snapshot.free`` must be capacity with ALL modeled usage released
+    (external/unmodeled allocations already subtracted); incumbents re-admit
+    against the pending queue inside the kernel. With ``preemption=False``
+    incumbents get a priority boost that puts them ahead of any newcomer in
+    the admission order, so they can only lose their node to capacity loss
+    (e.g. a drained node), never to contention.
+    """
+    inc_mask = incumbent >= 0
+    solve_batch = batch
+    if not preemption and inc_mask.any():
+        solve_batch = JobBatch(
+            demand=batch.demand,
+            partition_of=batch.partition_of,
+            req_features=batch.req_features,
+            priority=np.where(inc_mask, batch.priority + _KEEP_BOOST, batch.priority),
+            gang_id=batch.gang_id,
+            job_of=batch.job_of,
+        )
+    if sharded:
+        from slurm_bridge_tpu.solver.sharded import sharded_place
+
+        placement = sharded_place(snapshot, solve_batch, config, incumbent=incumbent)
+    else:
+        placement = auction_place(snapshot, solve_batch, config, incumbent=incumbent)
+    kept = inc_mask & placement.placed & (placement.node_of == incumbent)
+    return TickResult(
+        placement=placement,
+        incumbent=inc_mask,
+        kept=kept,
+        preempted=inc_mask & ~kept,
+        started=~inc_mask & placement.placed,
+    )
+
+
+@dataclass
+class StreamingSim:
+    """Persistent-workload tick driver over dense shard rows.
+
+    Rows (one per placement shard) carry persistent job identity in
+    ``job_of``; ``assign`` holds the node each shard currently runs on
+    (-1 = pending). ``snapshot.free`` is treated as the *external* free
+    capacity — usage by jobs outside the model — and is passed to every
+    solve unchanged, since each tick releases and re-admits all modeled
+    work.
+    """
+
+    snapshot: ClusterSnapshot
+    batch: JobBatch
+    config: AuctionConfig | None = None
+    preemption: bool = True
+    sharded: bool = False
+    assign: np.ndarray = field(init=False)
+    _next_job: int = field(init=False)
+
+    def __post_init__(self):
+        self.assign = np.full(self.batch.num_shards, -1, np.int32)
+        self._next_job = int(self.batch.job_of.max()) + 1 if self.batch.num_shards else 0
+
+    # ---- churn ----
+
+    def depart(self, job_ids: np.ndarray) -> int:
+        """Remove all shards of the given jobs (completed/cancelled)."""
+        gone = np.isin(self.batch.job_of, job_ids)
+        keep = ~gone
+        b = self.batch
+        self.batch = JobBatch(
+            demand=b.demand[keep],
+            partition_of=b.partition_of[keep],
+            req_features=b.req_features[keep],
+            priority=b.priority[keep],
+            gang_id=b.gang_id[keep],
+            job_of=b.job_of[keep],
+        )
+        self.assign = self.assign[keep]
+        return int(gone.sum())
+
+    def arrive(self, new: JobBatch) -> np.ndarray:
+        """Append new pending jobs; returns their (re-keyed) job ids."""
+        if new.num_shards == 0:
+            return np.zeros(0, np.int64)
+        # re-key incoming job/gang ids into this sim's persistent id space
+        uniq, inverse = np.unique(new.job_of, return_inverse=True)
+        fresh = self._next_job + np.arange(uniq.size)
+        self._next_job += uniq.size
+        job_of = fresh[inverse].astype(np.int32)
+        b = self.batch
+        self.batch = JobBatch(
+            demand=np.concatenate([b.demand, new.demand]),
+            partition_of=np.concatenate([b.partition_of, new.partition_of]),
+            req_features=np.concatenate([b.req_features, new.req_features]),
+            priority=np.concatenate([b.priority, new.priority]),
+            gang_id=np.concatenate([b.gang_id, job_of]),  # re-keyed per job
+            job_of=np.concatenate([b.job_of, job_of]),
+        )
+        self.assign = np.concatenate(
+            [self.assign, np.full(new.num_shards, -1, np.int32)]
+        )
+        return fresh
+
+    def running_jobs(self) -> np.ndarray:
+        return np.unique(self.batch.job_of[self.assign >= 0])
+
+    # ---- solve ----
+
+    def tick(self) -> TickResult:
+        result = streaming_place(
+            self.snapshot,
+            self.batch,
+            self.assign,
+            self.config,
+            preemption=self.preemption,
+            sharded=self.sharded,
+        )
+        self.assign = np.where(
+            result.placement.placed, result.placement.node_of, -1
+        ).astype(np.int32)
+        return result
+
+
+def churn_scenario(
+    num_nodes: int = 10_000,
+    num_jobs: int = 50_000,
+    *,
+    seed: int = 0,
+    load: float = 0.7,
+    gpu_fraction: float = 0.1,
+    gang_fraction: float = 0.05,
+) -> StreamingSim:
+    """BASELINE config #5 starting state: 50k pods against 10k nodes."""
+    snap, batch = random_scenario(
+        num_nodes,
+        num_jobs,
+        seed=seed,
+        load=load,
+        gpu_fraction=gpu_fraction,
+        gang_fraction=gang_fraction,
+    )
+    return StreamingSim(snapshot=snap, batch=batch)
+
+
+def churn_step(
+    sim: StreamingSim, rng: np.random.Generator, churn_jobs: int
+) -> TickResult:
+    """One churn tick: ``churn_jobs`` random running jobs depart, the same
+    number of fresh jobs arrive, then the assignment is re-solved."""
+    running = sim.running_jobs()
+    if running.size:
+        departing = rng.choice(
+            running, size=min(churn_jobs, running.size), replace=False
+        )
+        sim.depart(departing)
+    _, fresh = random_scenario(
+        sim.snapshot.num_nodes,
+        churn_jobs,
+        seed=int(rng.integers(2**31)),
+        num_partitions=len(sim.snapshot.partition_codes),
+        gpu_fraction=0.1,
+        load=0.02,
+    )
+    sim.arrive(fresh)
+    return sim.tick()

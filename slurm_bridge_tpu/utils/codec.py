@@ -31,8 +31,13 @@ class ConfigError(ValueError):
 
 def _convert(value, ftype, path: str, *, strict: bool):
     origin = typing.get_origin(ftype)
-    if dataclasses.is_dataclass(ftype) and isinstance(value, dict):
-        return _decode_into(value, ftype, path, strict=strict)
+    if dataclasses.is_dataclass(ftype):
+        if isinstance(value, dict):
+            return _decode_into(value, ftype, path, strict=strict)
+        raise ConfigError(
+            f"{path}: expected mapping for {ftype.__name__}, "
+            f"got {type(value).__name__} {value!r}"
+        )
     if origin in (list, tuple) and isinstance(value, (list, tuple)):
         (inner,) = typing.get_args(ftype)[:1] or (typing.Any,)
         seq = [
@@ -70,6 +75,14 @@ def _convert(value, ftype, path: str, *, strict: bool):
                 f"{path}: expected {ftype.__name__}, got {type(value).__name__} {value!r}"
             )
         try:  # lenient: coerce ("10250" → 10250), as sigs.k8s.io/yaml would
+            if ftype is bool:
+                # bool("false") is True — parse the words instead
+                s = str(value).strip().lower()
+                if s in ("true", "yes", "on", "1"):
+                    return True
+                if s in ("false", "no", "off", "0"):
+                    return False
+                raise ValueError(s)
             return ftype(value)
         except (TypeError, ValueError):
             raise ConfigError(f"{path}: cannot coerce {value!r} to {ftype.__name__}") from None
@@ -130,7 +143,12 @@ def resolve_relative_paths(obj, base_dir: str, path_fields: tuple[str, ...]):
 
 def explicit_flags(parser, argv) -> set[str]:
     """Dest names of flags the user actually passed — the precedence set
-    for flag-over-file merging (server.go:237-252 re-parses for this)."""
+    for flag-over-file merging (server.go:237-252 re-parses for this).
+
+    Unambiguous argparse prefix abbreviations (``--kubelet-por``) resolve to
+    the same dest they would parse as, so a file value can never silently
+    override an abbreviated-but-explicit flag.
+    """
     passed: set[str] = set()
     opts = {s: a.dest for a in parser._actions for s in a.option_strings}
     for tok in argv:
@@ -139,6 +157,10 @@ def explicit_flags(parser, argv) -> set[str]:
         name = tok.split("=", 1)[0]
         if name in opts:
             passed.add(opts[name])
+        elif name.startswith("--") and len(name) > 2:
+            matches = {d for s, d in opts.items() if s.startswith(name)}
+            if len(matches) == 1:  # what allow_abbrev would accept
+                passed.add(matches.pop())
     return passed
 
 
