@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the solver's hot ops.
+
+The auction sweep's cost is dominated by the per-round masked bid/argmax
+over the [P, N] pod×node surface (SURVEY.md §7's "auction sweep"). XLA's
+fused form still materialises [P, N] intermediates in HBM (the static
+feasibility mask alone is 500 MB at 50k×10k); :mod:`bid_argmax` streams
+node tiles through VMEM instead, carrying a running (value, index) pair
+per pod, so per-round HBM traffic drops from O(P·N) to O(P + N).
+"""
+
+from slurm_bridge_tpu.ops.bid_argmax import bid_argmax
+
+__all__ = ["bid_argmax"]

@@ -1,0 +1,214 @@
+"""Fused masked bid + running argmax — the auction round's hot op.
+
+One auction round must find, for every pending shard, the best feasible
+node under the current prices:
+
+    bid[p, n]  = jitter·hash(p, n, salt) + w·affinity[p, n] − price[n]
+    ok[p, n]   = partition ∧ features ∧ capacity ∧ incumbent-pin
+    choice[p]  = argmax_n where(ok, bid, −inf)
+
+The jnp form of this (solver/auction.py round_body) materialises several
+[P, N] arrays per round — at 50k pods × 10k nodes that is ~2 GB of HBM
+traffic per round for data that is entirely derivable from O(P·R + N·R)
+operands. This kernel computes the whole thing tile-by-tile in VMEM:
+
+- grid (P/BP, N/BN), node tiles innermost; the [BP, 1] running
+  (best value, best index) output blocks are revisited across the node
+  sweep, so nothing [P, N]-shaped ever exists;
+- pod-side operands are laid out [P, R]/[P, 1] (sublane vectors), node-side
+  operands [R, N]/[1, N] (lane vectors): every mask and bid term is then a
+  natural [BP, 1] × [1, BN] outer-product broadcast on the VPU;
+- the capacity check unrolls the R=3 static resource dims
+  (snapshot.RESOURCE_DIMS) — no 3-D intermediates;
+- the jitter is the same integer index-hash the jnp path uses
+  (auction.hash_jitter), computed from global (p, n) indices — all-int32
+  mixing is bit-exact on every backend, so this kernel and the jnp path
+  produce IDENTICAL choices (asserted by tests/test_ops.py);
+- ties break toward the lowest node index, matching ``jnp.argmax``: a later
+  tile only wins with a strictly greater value.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from slurm_bridge_tpu.solver.snapshot import NUM_RES
+
+#: Pod rows per tile (sublanes) and nodes per tile (lanes).
+BP = 256
+BN = 512
+
+_NEG_INF = float("-inf")  # python literal: jnp scalars become captured consts
+
+
+def _kernel(
+    salt_ref,  # SMEM (1, 1) i32 — round salt for the jitter hash
+    dem_ref,  # VMEM (BP, R) f32 — raw per-shard demand
+    job_part_ref,  # VMEM (BP, 1) i32
+    req_feat_ref,  # VMEM (BP, 1) u32
+    inc_ref,  # VMEM (BP, 1) i32 — incumbent node or -1
+    free_t_ref,  # VMEM (R, BN) f32 — raw free capacity, transposed
+    node_part_ref,  # VMEM (1, BN) i32
+    node_feat_ref,  # VMEM (1, BN) u32
+    price_ref,  # VMEM (1, BN) f32
+    affn_t_ref,  # VMEM (R, BN) f32 — normalised free (affinity operand)
+    demn_ref,  # VMEM (BP, R) f32 — normalised demand (affinity operand)
+    best_val_ref,  # VMEM (BP, 1) f32 out — running max
+    best_idx_ref,  # VMEM (BP, 1) i32 out — running argmax (global node idx)
+    *,
+    jitter: float,
+    affinity_weight: float,
+    num_nodes: int,
+):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        best_val_ref[:] = jnp.full_like(best_val_ref, _NEG_INF)
+        best_idx_ref[:] = jnp.full_like(best_idx_ref, num_nodes)  # sentinel
+
+    i = pl.program_id(0)
+    p_off = i * BP
+    n_off = j * BN
+
+    # ---- feasibility, all as [BP,1] × [1,BN] broadcasts ----
+    jp = job_part_ref[:]  # [BP, 1]
+    np_row = node_part_ref[:]  # [1, BN]
+    ok = (jp == np_row) | (jp < 0)
+    rf = req_feat_ref[:]
+    nf = node_feat_ref[:]
+    ok &= (nf & rf) == rf
+    for r in range(NUM_RES):  # static unroll, R = 3
+        ok &= dem_ref[:, r : r + 1] <= free_t_ref[r : r + 1, :] + 1e-6
+    inc = inc_ref[:]
+    ni = n_off + jax.lax.broadcasted_iota(jnp.int32, (BP, BN), 1)
+    ok &= (inc < 0) | (ni == inc)
+
+    # ---- bid = jitter·hash + w·affinity − price ----
+    # identical murmur-style mix as auction.hash_jitter (bit-exact parity)
+    pi = (p_off + jax.lax.broadcasted_iota(jnp.int32, (BP, BN), 0)).astype(jnp.uint32)
+    h = (
+        pi * jnp.uint32(0x9E3779B1)
+        ^ ni.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+        ^ salt_ref[0, 0].astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+    )
+    h ^= h >> 16
+    h *= jnp.uint32(0x85EBCA6B)
+    h ^= h >> 13
+    h *= jnp.uint32(0xC2B2AE35)
+    h ^= h >> 16
+    # Mosaic has no u32→f32 cast; the 24-bit value fits int32 losslessly
+    jit = (h >> 8).astype(jnp.int32).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+    bid = jit * jnp.float32(jitter) - price_ref[:]
+    if affinity_weight != 0.0:
+        aff = jnp.zeros((BP, BN), jnp.float32)
+        for r in range(NUM_RES):
+            aff += demn_ref[:, r : r + 1] * affn_t_ref[r : r + 1, :]
+        bid += jnp.float32(affinity_weight) * -aff  # best-fit: least free wins
+    val = jnp.where(ok, bid, _NEG_INF)
+
+    # ---- running (max, argmax); strict > keeps first-index tie-break ----
+    tile_max = jnp.max(val, axis=1, keepdims=True)  # [BP, 1]
+    tile_arg = n_off + jnp.argmax(val, axis=1, keepdims=True).astype(jnp.int32)
+    better = tile_max > best_val_ref[:]
+    best_idx_ref[:] = jnp.where(better, tile_arg, best_idx_ref[:])
+    best_val_ref[:] = jnp.where(better, tile_max, best_val_ref[:])
+
+
+@partial(
+    jax.jit,
+    static_argnames=("jitter", "affinity_weight", "num_nodes", "interpret"),
+)
+def bid_argmax(
+    free,  # [N, R] f32 current free capacity
+    node_part,  # [N] i32
+    node_feat,  # [N] u32
+    price,  # [N] f32
+    dem,  # [P, R] f32
+    job_part,  # [P] i32
+    req_feat,  # [P] u32
+    incumbent,  # [P] i32
+    dem_n,  # [P, R] f32 normalised demand (affinity)
+    free_n,  # [N, R] normalised free (affinity; any float dtype)
+    salt,  # scalar i32 round salt
+    *,
+    jitter: float,
+    affinity_weight: float,
+    num_nodes: int,
+    interpret: bool = False,
+):
+    """Best feasible (value, node) per shard. Returns (best [P] f32,
+    choice [P] i32) with ``choice == num_nodes`` where nothing is feasible.
+
+    Shapes may be arbitrary; inputs are padded to (BP, BN) multiples here.
+    Padded nodes advertise −1 free capacity (infeasible to everything, the
+    same convention as the sharded path), padded pods are stripped.
+    """
+    p_real, n_real = dem.shape[0], free.shape[0]
+    p_pad = (-p_real) % BP
+    n_pad = (-n_real) % BN
+
+    free = jnp.pad(free, ((0, n_pad), (0, 0)), constant_values=-1.0)
+    node_part = jnp.pad(node_part, (0, n_pad), constant_values=-2)
+    node_feat = jnp.pad(node_feat, (0, n_pad))
+    price = jnp.pad(price, (0, n_pad))
+    free_n = jnp.pad(free_n.astype(jnp.float32), ((0, n_pad), (0, 0)))
+    dem = jnp.pad(dem, ((0, p_pad), (0, 0)))
+    job_part = jnp.pad(job_part, (0, p_pad), constant_values=-1)
+    req_feat = jnp.pad(req_feat, (0, p_pad))
+    incumbent = jnp.pad(incumbent, (0, p_pad), constant_values=-1)
+    dem_n = jnp.pad(dem_n.astype(jnp.float32), ((0, p_pad), (0, 0)))
+
+    p_tot, n_tot = dem.shape[0], free.shape[0]
+    grid = (p_tot // BP, n_tot // BN)
+
+    best_val, best_idx = pl.pallas_call(
+        partial(
+            _kernel,
+            jitter=jitter,
+            affinity_weight=affinity_weight,
+            num_nodes=num_nodes,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((BP, NUM_RES), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((BP, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((BP, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((BP, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((NUM_RES, BN), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BN), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BN), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BN), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((NUM_RES, BN), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((BP, NUM_RES), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((BP, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((BP, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((p_tot, 1), jnp.float32),
+            jax.ShapeDtypeStruct((p_tot, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        jnp.asarray(salt, jnp.int32).reshape(1, 1),
+        dem,
+        job_part.reshape(-1, 1),
+        req_feat.reshape(-1, 1),
+        incumbent.reshape(-1, 1),
+        jnp.swapaxes(free, 0, 1),
+        node_part.reshape(1, -1),
+        node_feat.reshape(1, -1),
+        price.reshape(1, -1),
+        jnp.swapaxes(free_n, 0, 1),
+        dem_n,
+    )
+    return best_val[:p_real, 0], best_idx[:p_real, 0]

@@ -61,6 +61,11 @@ class AuctionConfig:
     #: placement count); >0 buys tighter packing at ~1% fewer placements.
     affinity_weight: float = 0.0
     dtype: str = "float32"  # score matrix dtype ("bfloat16" halves HBM traffic)
+    #: score/choose via the fused pallas kernel (ops/bid_argmax.py) instead
+    #: of the jnp [P,N] form. None = auto: on for the TPU backend. The
+    #: kernel's integer jitter hash is bit-exact with the jnp path, so
+    #: flipping this does not change placements (at affinity_weight=0).
+    use_pallas: bool | None = None
 
 
 def hash_jitter(p: int, n: int, salt, dtype, *, p_off=0, n_off=0) -> jnp.ndarray:
@@ -72,12 +77,33 @@ def hash_jitter(p: int, n: int, salt, dtype, *, p_off=0, n_off=0) -> jnp.ndarray
     gang members that picked the same node) spread on retry instead of
     livelocking. ``p_off``/``n_off`` let a sharded caller address the same
     global jitter field from a local block.
+
+    Integer murmur-style mixing, not the classic ``sin``-hash: all-int32
+    ops are bit-exact on every backend (CPU test mesh ≡ TPU ≡ the pallas
+    kernel, which re-implements this formula) and keep 24 bits of
+    resolution — the sin form's ×43758 scale left ~8 mantissa bits, which
+    quantised the field to 1/256 steps and made thousands of nodes tie at
+    the argmax.
     """
-    pi = jax.lax.broadcasted_iota(jnp.float32, (p, n), 0) + p_off
-    ni = jax.lax.broadcasted_iota(jnp.float32, (p, n), 1) + n_off
-    s = jnp.asarray(salt, jnp.float32)
-    x = jnp.sin(pi * 12.9898 + ni * 78.233 + s * 37.719) * 43758.5453
-    return (x - jnp.floor(x)).astype(dtype)
+    pi = jax.lax.broadcasted_iota(jnp.uint32, (p, n), 0) + jnp.asarray(
+        p_off, jnp.int32
+    ).astype(jnp.uint32)
+    ni = jax.lax.broadcasted_iota(jnp.uint32, (p, n), 1) + jnp.asarray(
+        n_off, jnp.int32
+    ).astype(jnp.uint32)
+    s = jnp.asarray(salt, jnp.int32).astype(jnp.uint32)
+    h = (
+        pi * jnp.uint32(0x9E3779B1)
+        ^ ni * jnp.uint32(0x85EBCA77)
+        ^ s * jnp.uint32(0xC2B2AE3D)
+    )
+    h ^= h >> 16
+    h *= jnp.uint32(0x85EBCA6B)
+    h ^= h >> 13
+    h *= jnp.uint32(0xC2B2AE35)
+    h ^= h >> 16
+    # top 24 bits → [0, 1): every value exactly representable in float32
+    return ((h >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))).astype(dtype)
 
 
 def segmented_cumsum(values: jnp.ndarray, segment_change: jnp.ndarray) -> jnp.ndarray:
@@ -180,7 +206,10 @@ def multi_mask(gang: jnp.ndarray, p: int) -> jnp.ndarray:
 
 @partial(
     jax.jit,
-    static_argnames=("rounds", "num_nodes", "eta", "jitter", "affinity_weight", "dtype"),
+    static_argnames=(
+        "rounds", "num_nodes", "eta", "jitter", "affinity_weight", "dtype",
+        "use_pallas", "interpret",
+    ),
 )
 def _auction_kernel(
     free0,  # [N, R] f32
@@ -201,6 +230,8 @@ def _auction_kernel(
     jitter: float = AuctionConfig.jitter,
     affinity_weight: float = AuctionConfig.affinity_weight,
     dtype=jnp.float32,
+    use_pallas: bool = False,
+    interpret: bool = False,
 ):
     p = dem.shape[0]
     n = num_nodes
@@ -224,28 +255,41 @@ def _auction_kernel(
     def round_body(rnd, carry):
         assign, price = carry
         free = free0 - used_capacity(dem, assign, n)
-        free_n = (free * scale).astype(dtype)  # [N, R]
 
-        # capacity feasibility vs current free, fused elementwise
-        cap_ok = jnp.all(dem[:, None, :] <= free[None, :, :] + 1e-6, axis=-1)
-        feasible = static_ok & cap_ok  # [P, N]
+        if use_pallas:
+            # fused tile-streaming kernel: no [P, N] intermediates in HBM
+            from slurm_bridge_tpu.ops.bid_argmax import bid_argmax
 
-        # demand-weighted best-fit: prefer nodes with least free capacity in
-        # the dimensions this shard actually consumes (matmul → MXU)
-        affinity = -(dem_n @ free_n.T)  # [P, N]
-        jit_mat = hash_jitter(p, n, rnd, dtype) * jnp.asarray(jitter, dtype)
-        bid = (
-            jnp.asarray(affinity_weight, dtype) * affinity
-            + jit_mat
-            - price[None, :].astype(dtype)
-        )
-        bid = jnp.where(feasible, bid, neg_inf)
+            best, choice = bid_argmax(
+                free, node_part, node_feat, price,
+                dem, job_part, req_feat, incumbent,
+                dem * scale, free * scale, rnd,
+                jitter=jitter, affinity_weight=affinity_weight,
+                num_nodes=n, interpret=interpret,
+            )
+        else:
+            free_n = (free * scale).astype(dtype)  # [N, R]
 
-        choice = jnp.argmax(bid, axis=1).astype(jnp.int32)  # [P]
-        best = jnp.take_along_axis(bid, choice[:, None], axis=1)[:, 0]
+            # capacity feasibility vs current free, fused elementwise
+            cap_ok = jnp.all(dem[:, None, :] <= free[None, :, :] + 1e-6, axis=-1)
+            feasible = static_ok & cap_ok  # [P, N]
+
+            # demand-weighted best-fit: prefer nodes with least free capacity
+            # in the dimensions this shard actually consumes (matmul → MXU)
+            affinity = -(dem_n @ free_n.T)  # [P, N]
+            jit_mat = hash_jitter(p, n, rnd, dtype) * jnp.asarray(jitter, dtype)
+            bid = (
+                jnp.asarray(affinity_weight, dtype) * affinity
+                + jit_mat
+                - price[None, :].astype(dtype)
+            )
+            bid = jnp.where(feasible, bid, neg_inf)
+
+            choice = jnp.argmax(bid, axis=1).astype(jnp.int32)  # [P]
+            best = jnp.take_along_axis(bid, choice[:, None], axis=1)[:, 0]
         unplaced = assign < 0
         valid = unplaced & jnp.isfinite(best.astype(jnp.float32))
-        choice = jnp.where(valid, choice, n)  # sentinel segment n
+        choice = jnp.where(valid & (choice < n), choice, n)  # sentinel segment n
 
         choice, valid = gang_dedup(choice, valid, assign, gang, multi, n)
         admitted = admit(choice, valid, dem, prio, free, n)
@@ -307,6 +351,9 @@ def auction_place(
         )
     if incumbent is None:
         incumbent = np.full(batch.num_shards, -1, np.int32)
+    use_pallas = cfg.use_pallas
+    if use_pallas is None:  # auto: the fused kernel targets the TPU backend
+        use_pallas = jax.default_backend() == "tpu"
     scale = resource_scale(snapshot)
     assign, free_after = _auction_kernel(
         jnp.asarray(snapshot.free),
@@ -325,6 +372,8 @@ def auction_place(
         jitter=cfg.jitter,
         affinity_weight=cfg.affinity_weight,
         dtype=jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32,
+        use_pallas=use_pallas,
+        interpret=use_pallas and jax.default_backend() != "tpu",
     )
     assign_np = np.asarray(assign)
     return Placement(

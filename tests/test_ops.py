@@ -1,0 +1,122 @@
+"""Pallas kernel parity: the fused bid/argmax must match the jnp path.
+
+The kernel runs in interpret mode on the CPU test mesh; on TPU the same
+program compiles via Mosaic. The integer jitter hash makes the comparison
+bit-exact, not approximate — identical placements from both paths.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slurm_bridge_tpu.ops.bid_argmax import bid_argmax
+from slurm_bridge_tpu.solver import AuctionConfig, auction_place
+from slurm_bridge_tpu.solver.auction import hash_jitter, resource_scale
+from slurm_bridge_tpu.solver.snapshot import random_scenario
+from tests.test_solver import _check_feasible
+
+
+def _random_op_inputs(seed, n, p):
+    rng = np.random.default_rng(seed)
+    free = rng.uniform(0, 64, (n, 3)).astype(np.float32)
+    inputs = dict(
+        free=free,
+        node_part=rng.integers(0, 4, n).astype(np.int32),
+        node_feat=rng.integers(0, 4, n).astype(np.uint32),
+        price=rng.uniform(0, 1, n).astype(np.float32),
+        dem=rng.uniform(0, 32, (p, 3)).astype(np.float32),
+        job_part=rng.integers(-1, 4, p).astype(np.int32),
+        req_feat=rng.integers(0, 4, p).astype(np.uint32),
+        incumbent=np.where(
+            rng.random(p) < 0.3, rng.integers(0, n, p), -1
+        ).astype(np.int32),
+    )
+    scale = np.float32(1.0) / np.maximum(free.mean(0), 1)
+    inputs["dem_n"] = inputs["dem"] * scale
+    inputs["free_n"] = free * scale
+    return inputs
+
+
+def _reference(inp, n, salt, jitter, aw):
+    """The jnp round_body score/choose, reproduced in numpy."""
+    part_ok = (inp["job_part"][:, None] == inp["node_part"][None, :]) | (
+        inp["job_part"][:, None] < 0
+    )
+    feat_ok = (inp["node_feat"][None, :] & inp["req_feat"][:, None]) == inp[
+        "req_feat"
+    ][:, None]
+    cap_ok = np.all(inp["dem"][:, None, :] <= inp["free"][None, :, :] + 1e-6, -1)
+    own = np.arange(n)[None, :] == inp["incumbent"][:, None]
+    ok = part_ok & feat_ok & cap_ok
+    ok &= np.where((inp["incumbent"] >= 0)[:, None], own, True)
+    p = inp["dem"].shape[0]
+    jit_mat = np.asarray(hash_jitter(p, n, salt, jnp.float32))
+    bid = aw * -(inp["dem_n"] @ inp["free_n"].T) + jitter * jit_mat
+    bid = bid - inp["price"][None, :]
+    val = np.where(ok, bid, -np.inf)
+    best = val.max(axis=1)
+    idx = np.where(np.isfinite(best), val.argmax(axis=1), n)
+    return best, idx
+
+
+@pytest.mark.parametrize("n,p", [(700, 300), (512, 256), (33, 1000), (1, 1)])
+def test_bid_argmax_matches_reference(n, p):
+    inp = _random_op_inputs(seed=n * 1000 + p, n=n, p=p)
+    bv, bi = bid_argmax(
+        inp["free"], inp["node_part"], inp["node_feat"], inp["price"],
+        inp["dem"], inp["job_part"], inp["req_feat"], inp["incumbent"],
+        inp["dem_n"], inp["free_n"], 7,
+        jitter=1.0, affinity_weight=0.0, num_nodes=n, interpret=True,
+    )
+    ref_v, ref_i = _reference(inp, n, 7, jitter=1.0, aw=0.0)
+    np.testing.assert_array_equal(np.asarray(bi), ref_i)
+    feas = np.isfinite(ref_v)
+    # affinity off ⇒ same arithmetic ⇒ bit-exact values too
+    np.testing.assert_array_equal(np.asarray(bv)[feas], ref_v[feas])
+
+
+def test_bid_argmax_with_affinity():
+    """With best-fit affinity on, values may differ by an ulp (outer-product
+    accumulation vs matmul) but choices must still agree except at
+    float-tie boundaries — with 24-bit jitter ties are absent in practice."""
+    inp = _random_op_inputs(seed=42, n=600, p=400)
+    bv, bi = bid_argmax(
+        inp["free"], inp["node_part"], inp["node_feat"], inp["price"],
+        inp["dem"], inp["job_part"], inp["req_feat"], inp["incumbent"],
+        inp["dem_n"], inp["free_n"], 3,
+        jitter=1.0, affinity_weight=0.3, num_nodes=600, interpret=True,
+    )
+    ref_v, ref_i = _reference(inp, 600, 3, jitter=1.0, aw=0.3)
+    assert (np.asarray(bi) == ref_i).mean() > 0.999
+    feas = np.isfinite(ref_v)
+    np.testing.assert_allclose(np.asarray(bv)[feas], ref_v[feas], atol=1e-5)
+
+
+def test_auction_pallas_path_matches_jnp_path():
+    """Full solve, both paths: identical assignments end to end."""
+    snap, batch = random_scenario(200, 800, seed=17, load=0.7,
+                                  gpu_fraction=0.2, gang_fraction=0.1)
+    a = auction_place(snap, batch, AuctionConfig(rounds=6, use_pallas=False))
+    b = auction_place(snap, batch, AuctionConfig(rounds=6, use_pallas=True))
+    np.testing.assert_array_equal(a.node_of, b.node_of)
+    _check_feasible(snap, batch, b)
+
+
+def test_auction_pallas_respects_incumbents():
+    snap, batch = random_scenario(64, 200, seed=23, load=0.6)
+    base = auction_place(snap, batch, AuctionConfig(rounds=6, use_pallas=True))
+    inc = np.where(base.placed, base.node_of, -1).astype(np.int32)
+    again = auction_place(
+        snap, batch, AuctionConfig(rounds=6, use_pallas=True), incumbent=inc
+    )
+    moved = (inc >= 0) & again.placed & (again.node_of != inc)
+    assert not moved.any(), "pallas path migrated an incumbent"
+
+
+def test_uses_pallas_on_tpu_backend_only():
+    """Auto mode resolves by backend; on the CPU test mesh it must be off
+    (interpret-mode pallas inside an 8-round fori_loop is test-only)."""
+    assert jax.default_backend() == "cpu"
+    cfg = AuctionConfig()
+    assert cfg.use_pallas is None  # default = auto
