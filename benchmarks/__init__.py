@@ -1,0 +1,1 @@
+"""Benchmark harness for the five BASELINE.md scenario configs."""

@@ -1,0 +1,151 @@
+"""The five BASELINE.md scenario configs, runnable as a module.
+
+    python -m benchmarks.scenarios            # all five
+    python -m benchmarks.scenarios 3 5        # a subset
+
+Each scenario prints one summary line; ``--json`` emits a JSON object per
+scenario instead. The headline driver contract (one JSON line, scenario #3
+shaped) lives in ``bench.py`` at the repo root — this module is the wide
+version the judge's BASELINE table is filled from.
+
+| # | scenario                                   | solver path        |
+|---|--------------------------------------------|--------------------|
+| 1 | 100 pods → 4-node debug partition          | greedy (parity)    |
+| 2 | 5k mixed cpu/mem pods → 512 nodes          | single-host JAX    |
+| 3 | 50k pods w/ gres → 10k nodes               | auction (+pallas)  |
+| 4 | gang MPI jobsets → fragmented 10k nodes    | masked auction     |
+| 5 | 50k pods + 1k/s churn streaming reschedule | warm-start auction |
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+from slurm_bridge_tpu.solver import AuctionConfig, greedy_place
+from slurm_bridge_tpu.solver.greedy_native import greedy_place_native
+from slurm_bridge_tpu.solver.session import DeviceSolver
+from slurm_bridge_tpu.solver.snapshot import random_scenario
+from slurm_bridge_tpu.solver.streaming import churn_scenario, churn_step
+
+
+def _median_ms(fn, *, warmup: int = 1, iters: int = 5) -> float:
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        ts.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(ts))
+
+
+def _solve_metrics(snap, batch, cfg, *, iters=5) -> dict:
+    solver = DeviceSolver(snap, cfg)
+    t_native = _median_ms(lambda: greedy_place_native(snap, batch), warmup=0, iters=3)
+    g = greedy_place_native(snap, batch)
+    t = _median_ms(lambda: solver.solve(batch), iters=iters)
+    p = solver.solve(batch)
+    return {
+        "ms_p50": round(t, 1),
+        "placed_jobs": len(p.by_job(batch)),
+        "placed_shards": int(p.placed.sum()),
+        "greedy_ms": round(t_native, 1),
+        "greedy_placed_jobs": len(g.by_job(batch)),
+        "speedup_vs_greedy": round(t_native / t, 2),
+        "jobs_per_sec": round(len(p.by_job(batch)) / (t / 1e3), 1),
+    }
+
+
+def scenario_1() -> dict:
+    """100 single-CPU pods onto a 4-node debug partition — greedy parity."""
+    snap, batch = random_scenario(4, 100, seed=1, num_partitions=1, load=0.5)
+    t_py = _median_ms(lambda: greedy_place(snap, batch), warmup=0, iters=5)
+    t_native = _median_ms(
+        lambda: greedy_place_native(snap, batch), warmup=0, iters=5
+    )
+    gp = greedy_place(snap, batch)
+    gn = greedy_place_native(snap, batch)
+    return {
+        "scenario": 1,
+        "python_greedy_ms": round(t_py, 2),
+        "native_greedy_ms": round(t_native, 2),
+        "placed_python": int(gp.placed.sum()),
+        "placed_native": int(gn.placed.sum()),
+    }
+
+
+def scenario_2() -> dict:
+    """5k mixed cpu/mem pods onto 512 synthetic nodes — single-host JAX."""
+    snap, batch = random_scenario(512, 5_000, seed=2, load=0.7)
+    out = _solve_metrics(snap, batch, AuctionConfig(rounds=8))
+    out["scenario"] = 2
+    return out
+
+
+def scenario_3() -> dict:
+    """50k pods with GPU gres onto 10k nodes — the headline config."""
+    snap, batch = random_scenario(
+        10_000, 50_000, seed=42, load=0.7, gpu_fraction=0.15, gang_fraction=0.05
+    )
+    out = _solve_metrics(snap, batch, AuctionConfig(rounds=12), iters=5)
+    out["scenario"] = 3
+    return out
+
+
+def scenario_4() -> dict:
+    """Gang-scheduled MPI jobsets (all-or-nothing) on a fragmented cluster."""
+    snap, batch = random_scenario(
+        10_000, 12_000, seed=4, load=0.8, gang_fraction=0.5, gang_size=8
+    )
+    out = _solve_metrics(snap, batch, AuctionConfig(rounds=12))
+    gangs = np.unique(batch.gang_id).size
+    out.update(scenario=4, gangs=int(gangs))
+    return out
+
+
+def scenario_5(ticks: int = 5, churn_jobs: int = 1_000) -> dict:
+    """Streaming reschedule: 50k pods, 1k jobs/tick churn, warm-start."""
+    sim = churn_scenario(num_nodes=10_000, num_jobs=50_000, seed=5, load=0.7)
+    sim.config = AuctionConfig(rounds=8)
+    sim.tick()  # converge the initial placement
+    rng = np.random.default_rng(0)
+    times, stabilities, preempted = [], [], 0
+    for _ in range(ticks):
+        t0 = time.perf_counter()
+        res = churn_step(sim, rng, churn_jobs)
+        times.append((time.perf_counter() - t0) * 1e3)
+        stabilities.append(res.stability)
+        preempted += int(res.preempted.sum())
+    return {
+        "scenario": 5,
+        "tick_ms_p50": round(float(np.median(times)), 1),
+        "stability_min": round(min(stabilities), 4),
+        "preempted_total": preempted,
+        "churn_jobs_per_tick": churn_jobs,
+    }
+
+
+SCENARIOS = {1: scenario_1, 2: scenario_2, 3: scenario_3, 4: scenario_4, 5: scenario_5}
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    as_json = "--json" in argv
+    picks = [int(a) for a in argv if a.isdigit()] or sorted(SCENARIOS)
+    import jax
+
+    print(
+        f"# backend={jax.default_backend()} devices={len(jax.devices())}",
+        file=sys.stderr,
+    )
+    for k in picks:
+        out = SCENARIOS[k]()
+        print(json.dumps(out) if as_json else f"scenario {k}: {out}")
+
+
+if __name__ == "__main__":
+    main()

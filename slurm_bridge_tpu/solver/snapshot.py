@@ -218,6 +218,41 @@ def encode_jobs(
     )
 
 
+#: Partition code that matches no node — used for padding rows.
+PAD_PARTITION = np.int32(2**30)
+
+
+def pad_batch(batch: JobBatch, multiple: int) -> JobBatch:
+    """Pad a batch to the next multiple of ``multiple`` shards.
+
+    Padded rows can never place (impossible partition code) and never merge
+    with real gangs (fresh singleton ids). Under ``jit`` a changing queue
+    length means a fresh XLA compile every tick; bucketing the shard axis
+    makes the streaming reschedule loop hit a handful of compiled shapes
+    (the same trick the sharded path uses for the device grid).
+    """
+    p = batch.num_shards
+    target = max(multiple, ((p + multiple - 1) // multiple) * multiple)
+    if target == p:
+        return batch
+    pad = target - p
+    gang_base = int(batch.gang_id.max()) + 1 if p else 0
+    return JobBatch(
+        demand=np.concatenate([batch.demand, np.zeros((pad, NUM_RES), np.float32)]),
+        partition_of=np.concatenate(
+            [batch.partition_of, np.full(pad, PAD_PARTITION, np.int32)]
+        ),
+        req_features=np.concatenate([batch.req_features, np.zeros(pad, np.uint32)]),
+        priority=np.concatenate(
+            [batch.priority, np.full(pad, -1e30, np.float32)]
+        ),
+        gang_id=np.concatenate(
+            [batch.gang_id, gang_base + np.arange(pad, dtype=np.int32)]
+        ),
+        job_of=np.concatenate([batch.job_of, np.full(pad, -1, np.int32)]),
+    )
+
+
 def random_scenario(
     num_nodes: int,
     num_jobs: int,

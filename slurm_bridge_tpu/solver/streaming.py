@@ -34,6 +34,7 @@ from slurm_bridge_tpu.solver.snapshot import (
     ClusterSnapshot,
     JobBatch,
     Placement,
+    pad_batch,
     random_scenario,
 )
 
@@ -66,6 +67,7 @@ def streaming_place(
     *,
     preemption: bool = True,
     sharded: bool = False,
+    bucket: int = 4096,
 ) -> TickResult:
     """Re-solve one tick with incumbents pinned to their nodes.
 
@@ -75,6 +77,10 @@ def streaming_place(
     incumbents get a priority boost that puts them ahead of any newcomer in
     the admission order, so they can only lose their node to capacity loss
     (e.g. a drained node), never to contention.
+
+    ``bucket`` pads the shard axis to a fixed-size grid so the churn loop
+    reuses a handful of compiled kernels instead of recompiling every tick
+    (a 1k/s churn rate means a new queue length every tick).
     """
     inc_mask = incumbent >= 0
     solve_batch = batch
@@ -87,12 +93,25 @@ def streaming_place(
             gang_id=batch.gang_id,
             job_of=batch.job_of,
         )
+    p_real = solve_batch.num_shards
+    solve_inc = incumbent
+    if bucket:
+        solve_batch = pad_batch(solve_batch, bucket)
+        pad = solve_batch.num_shards - p_real
+        if pad:
+            solve_inc = np.concatenate([incumbent, np.full(pad, -1, np.int32)])
     if sharded:
         from slurm_bridge_tpu.solver.sharded import sharded_place
 
-        placement = sharded_place(snapshot, solve_batch, config, incumbent=incumbent)
+        placement = sharded_place(snapshot, solve_batch, config, incumbent=solve_inc)
     else:
-        placement = auction_place(snapshot, solve_batch, config, incumbent=incumbent)
+        placement = auction_place(snapshot, solve_batch, config, incumbent=solve_inc)
+    if solve_batch.num_shards != p_real:
+        placement = Placement(
+            node_of=placement.node_of[:p_real],
+            placed=placement.placed[:p_real],
+            free_after=placement.free_after,
+        )
     kept = inc_mask & placement.placed & (placement.node_of == incumbent)
     return TickResult(
         placement=placement,

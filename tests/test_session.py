@@ -1,0 +1,60 @@
+"""DeviceSolver must be a drop-in for auction_place, minus the transfers."""
+
+import numpy as np
+
+from slurm_bridge_tpu.solver import AuctionConfig, auction_place
+from slurm_bridge_tpu.solver.session import DeviceSolver
+from slurm_bridge_tpu.solver.snapshot import random_scenario
+from tests.test_solver import _check_feasible
+
+CFG = AuctionConfig(rounds=6)
+
+
+def test_matches_auction_place():
+    snap, batch = random_scenario(64, 300, seed=1, load=0.7, gang_fraction=0.1)
+    a = auction_place(snap, batch, CFG)
+    s = DeviceSolver(snap, CFG).solve(batch)
+    np.testing.assert_array_equal(a.node_of, s.node_of)
+    np.testing.assert_allclose(a.free_after, s.free_after, atol=1e-3)
+
+
+def test_async_overlap():
+    snap, b1 = random_scenario(64, 200, seed=2, load=0.5)
+    _, b2 = random_scenario(64, 200, seed=3, load=0.5)
+    solver = DeviceSolver(snap, CFG)
+    h1 = solver.solve_async(b1)
+    h2 = solver.solve_async(b2)  # dispatched before h1 is fetched
+    p1, p2 = h1.result(), h2.result()
+    _check_feasible(snap, b1, p1)
+    _check_feasible(snap, b2, p2)
+
+
+def test_incumbent_and_snapshot_update():
+    snap, batch = random_scenario(32, 100, seed=4, load=0.6)
+    solver = DeviceSolver(snap, CFG)
+    base = solver.solve(batch)
+    inc = np.where(base.placed, base.node_of, -1).astype(np.int32)
+    again = solver.solve(batch, incumbent=inc)
+    moved = (inc >= 0) & again.placed & (again.node_of != inc)
+    assert not moved.any()
+    # a fresh snapshot re-stages cleanly
+    snap2, batch2 = random_scenario(16, 50, seed=5, load=0.5)
+    solver.update_snapshot(snap2)
+    _check_feasible(snap2, batch2, solver.solve(batch2))
+
+
+def test_empty_batch():
+    snap, _ = random_scenario(8, 10, seed=6)
+    from slurm_bridge_tpu.solver.snapshot import JobBatch
+
+    empty = JobBatch(
+        demand=np.zeros((0, 3), np.float32),
+        partition_of=np.zeros(0, np.int32),
+        req_features=np.zeros(0, np.uint32),
+        priority=np.zeros(0, np.float32),
+        gang_id=np.zeros(0, np.int32),
+        job_of=np.zeros(0, np.int32),
+    )
+    p = DeviceSolver(snap, CFG).solve(empty)
+    assert p.node_of.size == 0
+    np.testing.assert_array_equal(p.free_after, snap.free)

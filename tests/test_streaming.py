@@ -132,6 +132,16 @@ def test_incumbent_on_drained_node_is_preempted():
     assert bool(res.preempted[0])  # cannot migrate to node 1
 
 
+def test_bucket_padding_changes_nothing():
+    """Padding the shard axis to the compile bucket must not change any
+    real shard's outcome (padded rows target an impossible partition)."""
+    snap, batch = random_scenario(64, 500, seed=13, load=0.7, gang_fraction=0.1)
+    inc = np.full(batch.num_shards, -1, np.int32)
+    a = streaming_place(snap, batch, inc, CFG, bucket=0)
+    b = streaming_place(snap, batch, inc, CFG, bucket=4096)
+    np.testing.assert_array_equal(a.placement.node_of, b.placement.node_of)
+
+
 # ------------------------------------------------------------------ churn
 
 
