@@ -1,0 +1,14 @@
+#!/bin/sh
+# Regenerate the workload_pb2 module from wire/workload.proto.
+#
+# Reference parity: pkg/workload/generate.go:20 (go:generate protoc). The
+# image has protoc but not the grpc python plugin, so only the message
+# module is generated; service stubs are derived from the descriptor at
+# runtime (wire/rpc.py — exactly what generated stubs do, minus codegen).
+set -eu
+cd "$(dirname "$0")/.."
+protoc \
+  --proto_path=slurm_bridge_tpu/wire \
+  --python_out=slurm_bridge_tpu/wire \
+  slurm_bridge_tpu/wire/workload.proto
+echo "regenerated slurm_bridge_tpu/wire/workload_pb2.py"

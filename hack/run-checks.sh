@@ -1,0 +1,18 @@
+#!/bin/sh
+# CI entry point: tests + the driver's compile contracts.
+#
+# Reference parity: .github/workflows/unittest.yaml (make test) and
+# test-go.yml (hygiene). The CPU mesh env mirrors tests/conftest.py.
+set -eu
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q
+python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import __graft_entry__ as g
+fn, args = g.entry()
+jax.jit(fn)(*args)
+g.dryrun_multichip(8)
+print("graft contracts OK")
+EOF

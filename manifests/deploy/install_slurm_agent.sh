@@ -1,0 +1,43 @@
+#!/bin/sh
+# Install sbt-agent on a Slurm login node as a systemd service.
+#
+# Reference parity: manifests/deploy/install_slurm_agent.sh (systemd unit
+# with Restart=always; SURVEY.md §5 failure-detection inventory). The agent
+# needs the Slurm CLI (sbatch/scancel/scontrol/sacct/sinfo) on PATH and a
+# writable state directory for the submit-dedupe ledger — the ledger is
+# what keeps SubmitJob idempotent across agent restarts (the reference's
+# in-memory map loses that, api/slurm.go:91-112).
+set -eu
+
+PREFIX=${PREFIX:-/usr/local}
+STATE_DIR=${STATE_DIR:-/var/lib/sbt-agent}
+SOCK_DIR=${SOCK_DIR:-/var/run/slurm-bridge}
+LISTEN=${LISTEN:-0.0.0.0:9999}
+
+command -v sbatch >/dev/null || { echo "sbatch not on PATH" >&2; exit 1; }
+command -v sbt-agent >/dev/null || pip install "$(dirname "$0")/../.."
+
+mkdir -p "$STATE_DIR" "$SOCK_DIR"
+
+cat > /etc/systemd/system/sbt-agent.service <<UNIT
+[Unit]
+Description=slurm-bridge-tpu agent (WorkloadManager gRPC server)
+After=network.target
+
+[Service]
+ExecStart=$(command -v sbt-agent) \\
+    --listen ${LISTEN} \\
+    --socket ${SOCK_DIR}/sbt-agent.sock \\
+    --ledger ${STATE_DIR}/submit-ledger.json
+Restart=always
+RestartSec=2
+User=slurm
+Group=slurm
+
+[Install]
+WantedBy=multi-user.target
+UNIT
+
+systemctl daemon-reload
+systemctl enable --now sbt-agent
+echo "sbt-agent listening on ${LISTEN} and ${SOCK_DIR}/sbt-agent.sock"

@@ -1,0 +1,110 @@
+"""Deployment-surface hygiene: manifests stay in sync with the code.
+
+The reference generates its CRD with controller-gen and checks drift in CI
+(.github/workflows/test-go.yml "make manifests produces no diff"); the
+rebuild's dataclasses are the source of truth, so this suite IS the drift
+check.
+"""
+
+import dataclasses
+import pathlib
+import re
+
+import yaml
+
+from slurm_bridge_tpu.bridge.objects import (
+    BridgeJob,
+    BridgeJobSpec,
+    Meta,
+    SubjobStatus,
+    validate_bridge_job,
+)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+MANIFESTS = ROOT / "manifests"
+
+
+def _camel(s: str) -> str:
+    head, *rest = s.split("_")
+    return head + "".join(w.capitalize() for w in rest)
+
+
+def _load_all(path):
+    return list(yaml.safe_load_all(path.read_text()))
+
+
+def test_all_manifests_parse():
+    files = list(MANIFESTS.rglob("*.yaml"))
+    assert len(files) >= 14
+    for f in files:
+        for doc in _load_all(f):
+            assert doc is None or isinstance(doc, dict), f
+
+
+def _crd_schema():
+    (crd,) = _load_all(
+        MANIFESTS / "crd" / "bases" / "kubecluster.org_slurmbridgejobs.yaml"
+    )
+    (version,) = crd["spec"]["versions"]
+    return crd, version["schema"]["openAPIV3Schema"]
+
+
+def test_crd_spec_matches_dataclass():
+    _, schema = _crd_schema()
+    crd_fields = set(schema["properties"]["spec"]["properties"])
+    code_fields = {_camel(f.name) for f in dataclasses.fields(BridgeJobSpec)}
+    # mem_per_cpu_mb serialises as memPerCpuMb etc. — pure camel mapping
+    assert crd_fields == code_fields, crd_fields ^ code_fields
+
+
+def test_crd_required_matches_validation():
+    _, schema = _crd_schema()
+    assert set(schema["properties"]["spec"]["required"]) == {
+        "partition",
+        "sbatchScript",
+    }
+
+
+def test_crd_subjob_fields_match():
+    _, schema = _crd_schema()
+    sub = schema["properties"]["status"]["properties"]["subjobs"]
+    crd_fields = set(sub["additionalProperties"]["properties"])
+    code_fields = {_camel(f.name) for f in dataclasses.fields(SubjobStatus)}
+    assert crd_fields == code_fields, crd_fields ^ code_fields
+
+
+def test_samples_validate():
+    docs = _load_all(
+        MANIFESTS / "samples" / "kubecluster.org_v1alpha1_slurmbridgejob.yaml"
+    )
+    snake = {_camel(f.name): f.name for f in dataclasses.fields(BridgeJobSpec)}
+    for doc in docs:
+        assert doc["kind"] == "SlurmBridgeJob"
+        spec_kwargs = {snake[k]: v for k, v in doc["spec"].items()}
+        job = BridgeJob(meta=Meta(name=doc["metadata"]["name"]),
+                        spec=BridgeJobSpec(**spec_kwargs))
+        validate_bridge_job(job)  # must not raise
+
+
+def test_kustomizations_reference_existing_files():
+    for kf in MANIFESTS.rglob("kustomization.yaml"):
+        (doc,) = _load_all(kf)
+        for res in doc.get("resources", []):
+            assert (kf.parent / res).exists(), f"{kf}: missing {res}"
+
+
+def test_install_script_flags_match_agent():
+    """The systemd installer must only pass flags sbt-agent declares."""
+    text = (MANIFESTS / "deploy" / "install_slurm_agent.sh").read_text()
+    import inspect
+
+    from slurm_bridge_tpu.agent import main as agent_main
+    from slurm_bridge_tpu.obs import bootstrap
+
+    declared = set(
+        re.findall(r"add_argument\(\s*\"(--[a-z-]+)\"",
+                   inspect.getsource(agent_main) + inspect.getsource(bootstrap))
+    )
+    execstart = text.split("ExecStart=")[1].split("Restart=")[0]
+    for flag in re.findall(r"(--[a-z-]+)", execstart):
+        assert flag in declared, f"installer passes unknown flag {flag}"
