@@ -1,0 +1,108 @@
+"""Typed client / informer / lister machinery (pkg/client parity)."""
+
+import threading
+import time
+
+import pytest
+
+from slurm_bridge_tpu.bridge.client import Informer, InformerFactory, TypedClient
+from slurm_bridge_tpu.bridge.objects import BridgeJob, BridgeJobSpec, Meta
+from slurm_bridge_tpu.bridge.store import AlreadyExists, NotFound, ObjectStore
+
+
+def _job(name: str, **spec) -> BridgeJob:
+    return BridgeJob(
+        meta=Meta(name=name),
+        spec=BridgeJobSpec(partition="debug", sbatch_script="#!/bin/sh\n", **spec),
+    )
+
+
+def test_typed_client_crud():
+    store = ObjectStore()
+    jobs = TypedClient(store, BridgeJob)
+    jobs.create(_job("a"))
+    with pytest.raises(AlreadyExists):
+        jobs.create(_job("a"))
+    got = jobs.get("a")
+    assert got.spec.partition == "debug"
+    got.spec.priority = 7
+    jobs.update(got)
+    assert jobs.get("a").spec.priority == 7
+    jobs.mutate("a", lambda j: setattr(j.spec, "priority", 9))
+    assert jobs.get("a").spec.priority == 9
+    assert [j.meta.name for j in jobs.list()] == ["a"]
+    jobs.delete("a")
+    with pytest.raises(NotFound):
+        jobs.get("a")
+    assert jobs.try_get("a") is None
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_informer_cache_and_handlers():
+    store = ObjectStore()
+    store.create(_job("pre"))  # exists before the informer starts
+    inf = Informer(store, BridgeJob.KIND).start()
+    try:
+        assert inf.synced.wait(5.0)
+        assert _wait(lambda: inf.cached("pre") is not None)
+
+        events = []
+        inf.add_handlers(
+            on_add=lambda o: events.append(("add", o.meta.name)),
+            on_update=lambda o: events.append(("upd", o.meta.name)),
+            on_delete=lambda o: events.append(("del", o.meta.name)),
+        )
+        # late-joining handler sees current state as adds
+        assert ("add", "pre") in events
+
+        store.create(_job("new"))
+        assert _wait(lambda: ("add", "new") in events)
+        store.mutate(BridgeJob.KIND, "new", lambda j: setattr(j.spec, "priority", 1))
+        assert _wait(lambda: ("upd", "new") in events)
+        store.delete("BridgeJob", "new")
+        assert _wait(lambda: ("del", "new") in events)
+
+        # lister reads the cache, label-filtered
+        store.create(_job("labeled"))
+        store.mutate(BridgeJob.KIND, "labeled",
+                     lambda j: j.meta.labels.update({"k": "v"}))
+        assert _wait(lambda: inf.cached("labeled") is not None
+                     and inf.cached("labeled").meta.labels.get("k") == "v")
+        assert [o.meta.name for o in inf.lister(labels={"k": "v"})] == ["labeled"]
+    finally:
+        inf.stop()
+
+
+def test_informer_resync_refires_updates():
+    store = ObjectStore()
+    store.create(_job("r"))
+    inf = Informer(store, BridgeJob.KIND, resync_interval=0.1).start()
+    try:
+        updates = []
+        inf.add_handlers(on_update=lambda o: updates.append(o.meta.name))
+        assert _wait(lambda: updates.count("r") >= 2, timeout=5.0), updates
+    finally:
+        inf.stop()
+
+
+def test_factory_shares_informers():
+    store = ObjectStore()
+    fac = InformerFactory(store)
+    a = fac.informer_for(BridgeJob)
+    b = fac.informer_for(BridgeJob.KIND)
+    assert a is b
+    fac.start()
+    try:
+        assert fac.wait_for_cache_sync()
+        store.create(_job("x"))
+        assert _wait(lambda: a.cached("x") is not None)
+    finally:
+        fac.stop()
