@@ -8,11 +8,15 @@ TLS with a restricted cipher list. Routes served here:
 - ``GET /containerLogs/{namespace}/{pod}/{container}[?follow=true]`` —
   streams the job's stdout via the provider (TailFile while running with
   follow, OpenFile otherwise), chunked.
+- ``GET /stats/summary`` — kubelet stats Summary (node capacity/usage plus
+  one entry per pod). The reference declares this surface but ships it
+  commented out returning nil (provider.go:324-396); here it is real.
 - ``GET /healthz`` — liveness.
 
 Exec/attach/port-forward return 501 like the reference's no-op provider
-methods (provider.go:316-398). TLS is enabled when the configured
-cert/key files exist (tryPrepareTlsCerts, server.go:351).
+methods (provider.go:316-398). TLS comes up either from the configured
+cert/key files or from a self-signed pair generated in place when they
+are missing (tryPrepareTlsCerts, server.go:351 — utils/certs.py).
 """
 
 from __future__ import annotations
@@ -93,9 +97,60 @@ class VirtualKubeletServer:
                     _, _ns, pod_name, _container = parts
                     follow = parse_qs(url.query).get("follow", ["false"])[0] == "true"
                     return self._stream_logs(pod_name, follow)
+                if parts == ["stats", "summary"]:
+                    return self._stats_summary()
                 if parts and parts[0] in ("exec", "attach", "portForward", "run"):
                     return self._plain(501, "not implemented\n")
                 self._plain(404, "not found\n")
+
+            def _stats_summary(self) -> None:
+                import json
+                import time as _time
+
+                now = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+                nodes = []
+                pods = []
+                for part, provider in list(outer.providers.items()):
+                    try:
+                        cap, free = provider.capacity()
+                    except Exception:
+                        continue
+                    nodes.append(
+                        {
+                            "nodeName": provider.node_name,
+                            "startTime": now,
+                            "cpu": {
+                                "capacityCores": cap.get("cpu", 0.0),
+                                "usageCores": cap.get("cpu", 0.0)
+                                - free.get("cpu", 0.0),
+                            },
+                            "memory": {
+                                "capacityBytes": int(
+                                    cap.get("memory_mb", 0.0) * 1024 * 1024
+                                ),
+                                "usageBytes": int(
+                                    (cap.get("memory_mb", 0.0)
+                                     - free.get("memory_mb", 0.0)) * 1024 * 1024
+                                ),
+                            },
+                        }
+                    )
+                    for pod, info in provider.pod_stats():
+                        pods.append(
+                            {
+                                "podRef": {"name": pod.meta.name, "uid": pod.meta.uid},
+                                "startTime": info.get("start_time", ""),
+                                "cpu": {"requestedCores": info.get("cpus", 0.0)},
+                                "state": info.get("state", ""),
+                                "slurmJobIds": info.get("job_ids", []),
+                            }
+                        )
+                body = json.dumps({"nodes": nodes, "pods": pods}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _stream_logs(self, pod_name: str, follow: bool) -> None:
                 provider = outer._find_provider(pod_name)
@@ -124,15 +179,17 @@ class VirtualKubeletServer:
 
         httpd = ThreadingHTTPServer((self.address, self.port), Handler)
         if self.tls_cert_file and self.tls_key_file:
-            import os
+            from slurm_bridge_tpu.utils.certs import ensure_self_signed
 
-            if os.path.exists(self.tls_cert_file) and os.path.exists(self.tls_key_file):
+            # missing files are generated in place (tryPrepareTlsCerts,
+            # server.go:344-347: "generate default tls cert files")
+            if ensure_self_signed(self.tls_cert_file, self.tls_key_file):
                 ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
                 ctx.minimum_version = ssl.TLSVersion.TLSv1_2  # restricted ciphers
                 ctx.load_cert_chain(self.tls_cert_file, self.tls_key_file)
                 httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
             else:
-                log.warning("TLS files missing; serving plaintext (reference "
+                log.warning("TLS bootstrap failed; serving plaintext (reference "
                             "falls back the same way when cert bootstrap fails)")
         self._httpd = httpd
         self.port = httpd.server_address[1]

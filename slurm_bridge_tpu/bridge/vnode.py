@@ -36,6 +36,7 @@ from slurm_bridge_tpu.bridge.objects import (
 )
 from slurm_bridge_tpu.bridge.statusmap import pod_phase_for
 from slurm_bridge_tpu.bridge.store import NotFound, ObjectStore
+from slurm_bridge_tpu.core.arrays import array_len
 from slurm_bridge_tpu.core.types import JobInfo, JobStatus, NodeInfo, PartitionInfo
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
 from slurm_bridge_tpu.wire import ServiceClient, pb
@@ -108,6 +109,28 @@ class VirtualNodeProvider:
         cap["pods"] = cap["cpu"]
         free["pods"] = free["cpu"]
         return cap, free
+
+    def pod_stats(self) -> list[tuple[Pod, dict]]:
+        """Per-pod stats rows for the kubelet /stats/summary endpoint —
+        the surface the reference declares but ships commented out
+        (provider.go:324-392)."""
+        out = []
+        for pod in self.store.list(Pod.KIND):
+            if pod.spec.node_name != self.node_name:
+                continue
+            dem = pod.spec.demand
+            arr = array_len(dem.array) if dem else 1
+            info = {
+                "state": pod.status.phase,
+                "job_ids": list(pod.status.job_ids),
+                "cpus": float(dem.total_cpus(arr)) if dem else 0.0,
+                "start_time": next(
+                    (str(i.start_time) for i in pod.status.job_infos if i.start_time),
+                    "",
+                ),
+            }
+            out.append((pod, info))
+        return out
 
     def register(self) -> VirtualNode:
         """Create or refresh the VirtualNode object (the NodeController's

@@ -72,3 +72,53 @@ def test_unknown_pod_404_and_exec_501(bridge):
     assert _get(port, "/containerLogs/default/nope/job")[0] == 404
     assert _get(port, "/exec/default/p/c")[0] == 501
     assert _get(port, "/healthz")[0] == 200
+
+
+def test_stats_summary(bridge):
+    """/stats/summary is real here (commented out in the reference,
+    provider.go:324-392): node capacity plus one row per bound pod."""
+    import json
+
+    bridge.submit(
+        "statjob",
+        BridgeJobSpec(partition="debug", sbatch_script="#!/bin/sh\nsleep 0\n",
+                      cpus_per_task=2),
+    )
+    bridge.wait("statjob", timeout=20.0)
+    code, body = _get(bridge.kubelet_server.port, "/stats/summary")
+    assert code == 200
+    summary = json.loads(body)
+    assert summary["nodes"] and summary["nodes"][0]["cpu"]["capacityCores"] > 0
+    names = [p["podRef"]["name"] for p in summary["pods"]]
+    assert sizecar_name("statjob") in names
+    row = summary["pods"][names.index(sizecar_name("statjob"))]
+    assert row["cpu"]["requestedCores"] == 2.0
+    assert row["slurmJobIds"]
+
+
+def test_tls_bootstrap(tmp_path):
+    """Missing cert/key files are generated in place and the server comes
+    up HTTPS (tryPrepareTlsCerts parity, server.go:344-382)."""
+    import json
+    import ssl
+
+    from slurm_bridge_tpu.bridge.vkhttp import VirtualKubeletServer
+
+    cert = tmp_path / "certs" / "kubelet.crt"
+    key = tmp_path / "certs" / "kubelet.key"
+    srv = VirtualKubeletServer(
+        {}, port=0, tls_cert_file=str(cert), tls_key_file=str(key)
+    ).start()
+    try:
+        assert cert.exists() and key.exists()
+        assert (key.stat().st_mode & 0o777) == 0o600
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        with urllib.request.urlopen(
+            f"https://127.0.0.1:{srv.port}/stats/summary", timeout=10, context=ctx
+        ) as r:
+            assert r.status == 200
+            assert json.loads(r.read()) == {"nodes": [], "pods": []}
+    finally:
+        srv.stop()
