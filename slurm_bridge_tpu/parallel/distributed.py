@@ -1,0 +1,125 @@
+"""Multi-host bootstrap and DCN/ICI-aware meshes for the solver.
+
+The reference's distributed backend is gRPC between processes
+(SURVEY.md §2.9); the rebuild keeps gRPC for control traffic and carries
+the solver's data plane over XLA collectives — ICI within a TPU slice, DCN
+across slices/hosts via ``jax.distributed``. This module is the bootstrap
+seam:
+
+- :func:`init_distributed` initialises ``jax.distributed`` from explicit
+  arguments, the JAX coordinator env, or — fitting, for a framework whose
+  job is Slurm — the Slurm step environment itself (SLURM_PROCID /
+  SLURM_NTASKS / SLURM_STEP_NODELIST), the same variables ``srun`` exports
+  for every task of a job the bridge submitted.
+- :func:`hybrid_solver_mesh` builds a ("dp", "mp") mesh whose "mp" (nodes)
+  axis stays inside a slice and whose "dp" (pods) axis spans slices: the
+  per-round cross-"mp" gather moves O(P/dp × mp) elements every round and
+  must ride ICI, while the cross-"dp" gather is one O(P) vector that DCN
+  absorbs easily (the scaling-book rule: put the chatty axis on the fast
+  interconnect).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from slurm_bridge_tpu.parallel.mesh import solver_mesh
+
+log = logging.getLogger("sbt.distributed")
+
+_initialized = False
+
+
+def slurm_process_env() -> dict | None:
+    """Coordinator spec derived from a Slurm step's environment, or None.
+
+    Uses the first host of SLURM_STEP_NODELIST as the coordinator — every
+    task of the step sees the same value, which is all ``jax.distributed``
+    needs. Hostlist expressions are expanded with the same parser the agent
+    uses for scontrol output.
+    """
+    if "SLURM_PROCID" not in os.environ or "SLURM_NTASKS" not in os.environ:
+        return None
+    nodelist = os.environ.get("SLURM_STEP_NODELIST") or os.environ.get(
+        "SLURM_JOB_NODELIST", ""
+    )
+    if not nodelist:
+        return None
+    from slurm_bridge_tpu.core.hostlist import expand_hostlist
+
+    hosts = expand_hostlist(nodelist)
+    port = int(os.environ.get("SBT_COORDINATOR_PORT", "8476"))
+    return {
+        "coordinator_address": f"{hosts[0]}:{port}",
+        "num_processes": int(os.environ["SLURM_NTASKS"]),
+        "process_id": int(os.environ["SLURM_PROCID"]),
+    }
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialise jax.distributed once; returns True when multi-process.
+
+    Resolution order: explicit args → JAX's own auto-detection env
+    (JAX_COORDINATOR_ADDRESS et al.) → the Slurm step environment →
+    single-process no-op. Safe to call repeatedly.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    spec = None
+    if coordinator_address is not None:
+        spec = {
+            "coordinator_address": coordinator_address,
+            "num_processes": num_processes,
+            "process_id": process_id,
+        }
+    elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        spec = {}  # jax reads its own env
+    else:
+        spec = slurm_process_env()
+    if spec is None or (spec.get("num_processes") or 1) <= 1:
+        _initialized = True
+        return False
+    jax.distributed.initialize(**{k: v for k, v in spec.items() if v is not None})
+    _initialized = True
+    log.info(
+        "jax.distributed up: process %d/%d",
+        jax.process_index(),
+        jax.process_count(),
+    )
+    return True
+
+
+def hybrid_solver_mesh(
+    *,
+    mp_per_slice: int | None = None,
+) -> Mesh:
+    """("dp", "mp") mesh with "mp" confined to one slice/host.
+
+    Device order from ``jax.devices()`` groups by process; keeping "mp"
+    within a process's devices keeps the per-round node-block gather on
+    ICI. With one process this degrades to :func:`solver_mesh`.
+    """
+    devs = jax.devices()
+    n_local = len([d for d in devs if d.process_index == jax.process_index()])
+    if jax.process_count() <= 1:
+        return solver_mesh(devs, mp=mp_per_slice)
+    mp = mp_per_slice or n_local
+    if mp > n_local:
+        raise ValueError(
+            f"mp_per_slice={mp} exceeds {n_local} local devices — the mp axis "
+            "must not cross the slice boundary (its gather is per-round bulk)"
+        )
+    if len(devs) % mp:
+        raise ValueError(f"mp={mp} does not divide {len(devs)} global devices")
+    arr = np.array(devs).reshape(len(devs) // mp, mp)
+    return Mesh(arr, axis_names=("dp", "mp"))

@@ -1,0 +1,59 @@
+"""Multi-host bootstrap seam: Slurm-env coordinator spec + hybrid meshes."""
+
+import jax
+import numpy as np
+import pytest
+
+from slurm_bridge_tpu.parallel import distributed as dist
+from slurm_bridge_tpu.parallel.mesh import solver_mesh
+
+
+def test_slurm_process_env(monkeypatch):
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    monkeypatch.setenv("SLURM_NTASKS", "8")
+    monkeypatch.setenv("SLURM_STEP_NODELIST", "tpu[001-004]")
+    spec = dist.slurm_process_env()
+    assert spec == {
+        "coordinator_address": "tpu001:8476",
+        "num_processes": 8,
+        "process_id": 3,
+    }
+    monkeypatch.setenv("SBT_COORDINATOR_PORT", "9000")
+    assert dist.slurm_process_env()["coordinator_address"] == "tpu001:9000"
+
+
+def test_slurm_process_env_absent(monkeypatch):
+    monkeypatch.delenv("SLURM_PROCID", raising=False)
+    assert dist.slurm_process_env() is None
+
+
+def test_init_single_process_noop(monkeypatch):
+    monkeypatch.delenv("SLURM_PROCID", raising=False)
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.setattr(dist, "_initialized", False)
+    assert dist.init_distributed() is False
+    assert dist.init_distributed() is False  # idempotent
+
+
+def test_hybrid_mesh_single_process():
+    mesh = dist.hybrid_solver_mesh()
+    assert mesh.axis_names == ("dp", "mp")
+    assert mesh.devices.size == len(jax.devices())
+    # single process degrades to solver_mesh's shape
+    ref = solver_mesh()
+    assert mesh.shape == ref.shape
+
+
+def test_hybrid_mesh_runs_sharded_solve():
+    from slurm_bridge_tpu.solver import AuctionConfig
+    from slurm_bridge_tpu.solver.sharded import sharded_place
+    from slurm_bridge_tpu.solver.snapshot import random_scenario
+    from tests.test_solver import _check_feasible
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-device CPU mesh")
+    snap, batch = random_scenario(64, 200, seed=7, load=0.6)
+    placement = sharded_place(
+        snap, batch, AuctionConfig(rounds=4), mesh=dist.hybrid_solver_mesh()
+    )
+    _check_feasible(snap, batch, placement)
