@@ -119,6 +119,8 @@ class SlurmClient:
             args += ["--time", str(mins)]
         if demand.priority > 0:
             args += ["--priority", str(demand.priority)]
+        if demand.nodelist:
+            args += ["--nodelist", ",".join(demand.nodelist)]
         return args
 
     def submit(self, demand: JobDemand) -> int:

@@ -31,6 +31,9 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description="slurm-bridge-tpu control plane")
     parser.add_argument("--endpoint", required=True, help="agent endpoint (host:port or *.sock)")
     parser.add_argument("--scheduler", default="auction", choices=["auction", "greedy"])
+    parser.add_argument("--preemption", action="store_true",
+                        help="let higher-priority pending jobs displace "
+                             "lower-priority submitted ones (auction only)")
     parser.add_argument("--threads", type=int, default=2,
                         help="operator reconciler workers (--slurm-bridge-operator-threads)")
     parser.add_argument("--configurator-interval", type=float, default=30.0)
@@ -65,6 +68,7 @@ def main(argv: list[str] | None = None) -> int:
     bridge = Bridge(
         args.endpoint,
         scheduler_backend=args.scheduler,
+        preemption=args.preemption,
         configurator_interval=args.configurator_interval,
         operator_workers=args.threads,
         kubelet_port=None if kubelet_port < 0 else kubelet_port,

@@ -46,6 +46,7 @@ class Bridge:
         *,
         scheduler_backend: str = "auction",
         auction_config: AuctionConfig | None = None,
+        preemption: bool = False,
         scheduler_interval: float = 0.2,
         configurator_interval: float = 30.0,
         node_sync_interval: float = 0.25,
@@ -80,6 +81,7 @@ class Bridge:
             backend=scheduler_backend,
             auction_config=auction_config,
             events=self.events,
+            preemption=preemption,
         )
         self._sched_ticker = Ticker(
             scheduler_interval, self.scheduler.tick, name="scheduler"

@@ -14,12 +14,22 @@ Slurm nodes the solver chose ride along as ``spec.placement_hint`` (the
 agent may pass them to ``sbatch --nodelist``; Slurm remains the final
 arbiter). Unplaceable pods stay Pending with reason ``Unschedulable`` and
 are retried next tick.
+
+With ``preemption=True`` the tick is a streaming re-solve (BASELINE
+config #5 in the product path): already-submitted pods join the batch as
+incumbents pinned to their hinted nodes, and one that loses priority-
+ordered admission is preempted — its Slurm jobs cancelled, its binding
+cleared, its submit generation bumped so the agent's dedupe ledger treats
+the requeue as a fresh submission.
 """
 
 from __future__ import annotations
 
 import logging
 import time
+
+import grpc
+import numpy as np
 
 from slurm_bridge_tpu.bridge.objects import (
     Pod,
@@ -32,8 +42,15 @@ from slurm_bridge_tpu.bridge.store import NotFound, ObjectStore
 from slurm_bridge_tpu.core.types import JobDemand, NodeInfo, PartitionInfo
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
 from slurm_bridge_tpu.obs.metrics import REGISTRY
-from slurm_bridge_tpu.solver import AuctionConfig, auction_place, greedy_place
-from slurm_bridge_tpu.solver.snapshot import encode_cluster, encode_jobs
+from slurm_bridge_tpu.solver import AuctionConfig, greedy_place
+from slurm_bridge_tpu.solver.session import DeviceSolver
+from slurm_bridge_tpu.solver.snapshot import (
+    PAD_PARTITION,
+    Placement,
+    encode_cluster,
+    encode_jobs,
+    pad_batch,
+)
 from slurm_bridge_tpu.wire import ServiceClient, pb
 from slurm_bridge_tpu.wire.convert import node_from_proto, partition_from_proto
 
@@ -46,6 +63,9 @@ _pods_placed = REGISTRY.counter("sbt_scheduler_pods_placed_total", "pods bound")
 _pods_unplaced = REGISTRY.gauge(
     "sbt_scheduler_pods_unschedulable", "pods left pending after last tick"
 )
+_pods_preempted = REGISTRY.counter(
+    "sbt_scheduler_pods_preempted_total", "pods preempted for higher priority work"
+)
 
 
 class PlacementScheduler:
@@ -57,6 +77,8 @@ class PlacementScheduler:
         backend: str = "auction",
         auction_config: AuctionConfig | None = None,
         events: EventRecorder | None = None,
+        preemption: bool = False,
+        bucket: int = 1024,
     ):
         if backend not in ("auction", "greedy"):
             raise ValueError(f"unknown scheduler backend {backend!r}")
@@ -65,6 +87,9 @@ class PlacementScheduler:
         self.backend = backend
         self.auction_config = auction_config or AuctionConfig()
         self.events = events or EventRecorder()
+        self.preemption = preemption
+        self.bucket = bucket
+        self._solver: DeviceSolver | None = None
 
     # ---- inventory ----
 
@@ -101,24 +126,80 @@ class PlacementScheduler:
             and p.status.phase == PodPhase.PENDING
         ]
 
+    def incumbent_pods(self) -> list[Pod]:
+        """Bound sizecar pods with live Slurm jobs — the preemption pool."""
+        return [
+            p
+            for p in self.store.list(Pod.KIND)
+            if p.spec.role == PodRole.SIZECAR
+            and p.spec.node_name
+            and p.spec.placement_hint
+            and p.status.job_ids
+            and not p.meta.deleted
+            and p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+        ]
+
     def tick(self) -> int:
         """Solve one placement round; returns the number of pods bound."""
         pods = self.pending_pods()
         if not pods:
+            # nothing pending ⇒ nothing can displace anyone; keep the idle
+            # tick free (no inventory RPCs, no solve)
             _pods_unplaced.set(0)
             return 0
+        # preemption needs incumbent pinning, which only the auction kernel
+        # honours — the greedy oracle would spuriously displace everyone
+        use_preemption = self.preemption and self.backend == "auction"
+        incumbents = self.incumbent_pods() if use_preemption else []
         t0 = time.perf_counter()
         partitions, nodes = self.cluster_state()
         snapshot = encode_cluster(nodes, partitions)
+        all_pods = pods + incumbents
         demands: list[JobDemand] = []
-        for pod in pods:
+        for pod in all_pods:
             d = pod.spec.demand or JobDemand(partition=pod.spec.partition)
             demands.append(d)
         batch = encode_jobs(demands, snapshot)
-        if self.backend == "greedy":
-            placement = greedy_place(snapshot, batch)
-        else:
-            placement = auction_place(snapshot, batch, self.auction_config)
+
+        # Streaming incumbents: pin each already-submitted shard to its
+        # hinted node and release its RUNNING usage so everyone re-admits
+        # against total capacity (solver/streaming.py semantics).
+        name_idx = {n: i for i, n in enumerate(snapshot.node_names)}
+        incumbent_arr = np.full(batch.num_shards, -1, np.int32)
+        shard_rows: dict[int, list[int]] = {}
+        for row in range(batch.num_shards):
+            shard_rows.setdefault(int(batch.job_of[row]), []).append(row)
+        n_pending = len(pods)
+        for j in range(n_pending, len(all_pods)):
+            pod = all_pods[j]
+            hints = pod.spec.placement_hint
+            rows = shard_rows.get(j, [])
+            for k, row in enumerate(rows):
+                node = name_idx.get(hints[k]) if k < len(hints) else None
+                if node is not None:
+                    incumbent_arr[row] = node
+                    # release EVERY incumbent's usage, not just visibly
+                    # RUNNING ones: the pod phase lags Slurm's allocation,
+                    # and an unreleased-but-allocated incumbent would pin
+                    # to a node with zero modeled free capacity and be
+                    # spuriously preempted. Transient over-release (job
+                    # still queued in Slurm) only delays a preemption by a
+                    # tick; the level-triggered loop self-corrects.
+                    snapshot.free[node] += batch.demand[row]
+                else:
+                    # hint node vanished from the inventory (drained mid-
+                    # run): take the shard out of the solve entirely —
+                    # unpinned it would shadow healthy nodes' capacity
+                    # without being bindable or preemptible
+                    batch.partition_of[row] = PAD_PARTITION
+                    batch.demand[row] = 0.0
+        if incumbents:
+            # half-step boost: CR priorities are integers, so this flips
+            # only exact ties — an equal-priority newcomer must NOT displace
+            # running work (admission sorts pending rows first otherwise)
+            batch.priority[batch.job_of >= n_pending] += 0.5
+
+        placement = self._solve(snapshot, batch, incumbent_arr)
         by_job = placement.by_job(batch)
 
         ready_nodes = {
@@ -141,10 +222,86 @@ class PlacementScheduler:
                     else f"Unschedulable: no ready virtual node for partition {partition!r}"
                 )
                 self._mark_unschedulable(pod, reason)
+        preempted = 0
+        for j in range(n_pending, len(all_pods)):
+            rows = shard_rows.get(j, [])
+            lost = any(
+                incumbent_arr[r] >= 0 and placement.node_of[r] != incumbent_arr[r]
+                for r in rows
+            )
+            if lost and self._preempt(all_pods[j]):
+                preempted += 1
         _tick_seconds.observe(time.perf_counter() - t0)
         _pods_placed.inc(placed)
+        _pods_preempted.inc(preempted)
         _pods_unplaced.set(len(pods) - placed)
         return placed
+
+    def _solve(self, snapshot, batch, incumbent):
+        if self.backend == "greedy":
+            return greedy_place(snapshot, batch)
+        p_real = batch.num_shards
+        if self.bucket:
+            batch = pad_batch(batch, self.bucket)
+            if batch.num_shards != p_real:
+                incumbent = np.concatenate(
+                    [incumbent, np.full(batch.num_shards - p_real, -1, np.int32)]
+                )
+        if self._solver is None:
+            self._solver = DeviceSolver(snapshot, self.auction_config)
+        else:
+            self._solver.update_snapshot(snapshot)
+        placement = self._solver.solve(batch, incumbent=incumbent)
+        if placement.node_of.shape[0] != p_real:
+            placement = Placement(
+                node_of=placement.node_of[:p_real],
+                placed=placement.placed[:p_real],
+                free_after=placement.free_after,
+            )
+        return placement
+
+    def _preempt(self, pod: Pod) -> bool:
+        """Requeue a preempted pod, then cancel its jobs: binding cleared,
+        submit generation bumped so the agent's dedupe ledger accepts the
+        resubmission as new work.
+
+        Reset-before-cancel ordering matters: once job_ids are cleared the
+        virtual node stops syncing Slurm state into the pod, so the
+        CANCELLED terminal state can never race the requeue into a Failed
+        CR (vnode._refresh_status also guards on the ids it queried).
+        """
+        job_ids: list[int] = []
+
+        def record(p: Pod):
+            job_ids.clear()  # fresh per mutate attempt (Conflict retries)
+            if not p.status.job_ids:
+                return False  # already reset by someone else
+            job_ids.extend(p.status.job_ids)
+            gen = int(p.meta.annotations.get("submit-generation", "0")) + 1
+            p.meta.annotations["submit-generation"] = str(gen)
+            p.spec.node_name = ""
+            p.spec.placement_hint = ()
+            p.status.job_ids = ()
+            p.status.job_infos = []
+            p.status.phase = PodPhase.PENDING
+            p.status.reason = "Preempted: displaced by higher-priority work"
+
+        try:
+            self.store.mutate(Pod.KIND, pod.name, record)
+        except NotFound:
+            return False
+        if not job_ids:
+            return False
+        for job_id in job_ids:
+            try:
+                self.client.CancelJob(pb.CancelJobRequest(job_id=job_id))
+            except grpc.RpcError as e:
+                log.warning("preempt: cancel job %d failed: %s", job_id, e.details())
+        self.events.event(
+            pod, Reason.PLACEMENT_FAILED,
+            "preempted: displaced by higher-priority work", warning=True,
+        )
+        return True
 
     def _bind(self, pod: Pod, node_name: str, hint: tuple[str, ...]) -> bool:
         bound = [False]

@@ -18,6 +18,7 @@ Reference parity: pkg/slurm-virtual-kubelet/. One provider per partition
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -197,13 +198,22 @@ class VirtualNodeProvider:
 
     def _submit_pod(self, pod: Pod) -> None:
         """CreatePod equivalent (provider.go:35-60): submit with the pod
-        UID as submitter id so retries dedupe agent-side."""
+        UID as submitter id so retries dedupe agent-side. A preempted pod
+        carries a bumped submit-generation so its requeue is NOT deduped
+        against the cancelled job (scheduler._preempt)."""
         demand = pod.spec.demand
         if demand is None or not demand.script.strip():
             self._fail_pod(pod, "sizecar pod has no script")
             return
+        submitter = pod.meta.uid
+        gen = pod.meta.annotations.get("submit-generation", "")
+        if gen:
+            submitter = f"{submitter}#g{gen}"
+        if pod.spec.placement_hint and not demand.nodelist:
+            # the solver's choice rides to `sbatch --nodelist`
+            demand = dataclasses.replace(demand, nodelist=pod.spec.placement_hint)
         try:
-            resp = self.client.SubmitJob(demand_to_submit(demand, submitter_id=pod.meta.uid))
+            resp = self.client.SubmitJob(demand_to_submit(demand, submitter_id=submitter))
         except grpc.RpcError as e:
             self.events.event(
                 pod, Reason.POD_FAILED, f"submit failed: {e.details()}", warning=True
@@ -224,8 +234,9 @@ class VirtualNodeProvider:
 
     def _refresh_status(self, pod: Pod) -> None:
         """GetPodStatus equivalent (provider.go:195-219)."""
+        queried = pod.status.job_ids
         infos: list[JobInfo] = []
-        for job_id in pod.status.job_ids:
+        for job_id in queried:
             try:
                 resp = self.client.JobInfo(pb.JobInfoRequest(job_id=job_id))
             except grpc.RpcError:
@@ -235,6 +246,8 @@ class VirtualNodeProvider:
         phase = pod_phase_for([i.state for i in infos])
 
         def record(p: Pod):
+            if p.status.job_ids != queried:
+                return False  # preempted/requeued mid-query — stale state
             if p.status.job_infos == infos and p.status.phase == phase:
                 return False
             p.status.job_infos = infos

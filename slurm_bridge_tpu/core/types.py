@@ -91,6 +91,9 @@ class JobDemand:
     licenses: str = ""
     time_limit_s: int = 0
     priority: int = 0
+    #: solver-chosen hosts, forwarded as ``sbatch --nodelist`` (Slurm stays
+    #: the final arbiter; an infeasible hint falls back to Slurm's choice)
+    nodelist: tuple[str, ...] = ()
 
     def total_cpus(self, array_count: int = 1) -> int:
         """cpu = cpus_per_task × ntasks × array-len — the sizecar sizing rule

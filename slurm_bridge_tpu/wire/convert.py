@@ -38,6 +38,7 @@ def _dt(ts: int) -> datetime | None:
 
 def demand_to_submit(demand: JobDemand, submitter_id: str = "") -> pb.SubmitJobRequest:
     return pb.SubmitJobRequest(
+        nodelist=list(demand.nodelist),
         script=demand.script,
         partition=demand.partition,
         submitter_id=submitter_id,
@@ -76,6 +77,7 @@ def submit_to_demand(req: pb.SubmitJobRequest) -> JobDemand:
         licenses=req.licenses,
         time_limit_s=int(req.time_limit_s),
         priority=int(req.priority),
+        nodelist=tuple(req.nodelist),
     )
 
 

@@ -185,7 +185,14 @@ def sbatch(argv: list[str]) -> int:
     if partition not in parts:
         print(f"sbatch: error: invalid partition specified: {partition}", file=sys.stderr)
         return 1
-    node = cluster(root)["partitions"][partition]["nodes"][0]
+    part_nodes = cluster(root)["partitions"][partition]["nodes"]
+    nodelist = [n for n in opts.get("nodelist", "").split(",") if n]
+    # like real slurm, an explicit --nodelist pins the allocation; tasks
+    # spread round-robin over it (or over the partition without one)
+    placement = nodelist or part_nodes
+    node = placement[0]
+    cpus_per_task = int(opts.get("cpus-per-task", 1) or 1)
+    ntasks = int(opts.get("ntasks", 1) or 1)
 
     array_spec = opts.get("array", "")
     task_ids = _parse_array_spec(array_spec) if array_spec else [None]
@@ -213,7 +220,14 @@ def sbatch(argv: list[str]) -> int:
             env=env,
         )
         tasks.append(
-            {"jid": jid, "task_id": task_id, "pid": proc.pid, "stdout": str(out)}
+            {
+                "jid": jid,
+                "task_id": task_id,
+                "pid": proc.pid,
+                "stdout": str(out),
+                "node": placement[len(tasks) % len(placement)],
+                "cpus": cpus_per_task * ntasks,
+            }
         )
     rec = {
         "id": job_id,
@@ -322,13 +336,36 @@ def _print_partition(name: str, part: dict, nodes_cfg: dict) -> None:
     )
 
 
+def _alloc_cpus(root: pathlib.Path, node: str) -> int:
+    """CPUs allocated to currently-RUNNING fake jobs on one node — real
+    slurm reports live CPUAlloc, and the bridge's preemption release step
+    depends on it."""
+    total = 0
+    for p in sorted(root.glob("job_*.json")):
+        try:
+            rec = json.loads(p.read_text())
+        except (OSError, ValueError):
+            continue
+        if "alias_of" in rec or rec.get("cancelled"):
+            continue
+        for task in rec.get("tasks", []):
+            if task.get("node") != node:
+                continue
+            state, _ = _task_state(root, rec, task)
+            if state == "RUNNING":
+                total += int(task.get("cpus", 0))
+    return total
+
+
 def _print_node(name: str, cfg: dict) -> None:
+    root = state_dir()
     gpus = cfg.get("gpus", 0)
     gres = f"gpu:{cfg.get('gpu_type','gpu')}:{gpus}" if gpus else "(null)"
     feats = ",".join(cfg.get("features", [])) or "(null)"
+    alloc = min(cfg["cpus"], cfg.get("alloc_cpus", 0) + _alloc_cpus(root, name))
     print(
         f"NodeName={name} Arch=x86_64 CoresPerSocket=16\n"
-        f"   CPUAlloc={cfg.get('alloc_cpus', 0)} CPUTot={cfg['cpus']} CPULoad=0.00\n"
+        f"   CPUAlloc={alloc} CPUTot={cfg['cpus']} CPULoad=0.00\n"
         f"   AvailableFeatures={feats}\n"
         f"   ActiveFeatures={feats}\n"
         f"   Gres={gres}\n"

@@ -1,0 +1,135 @@
+"""Preemption in the product path: placement hints reach sbatch, and a
+higher-priority pending job displaces a lower-priority submitted one
+(streaming re-solve semantics wired into the PlacementScheduler).
+
+The reference has no preemption at all — its placement is one
+kube-scheduler decision, never revisited.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+
+import pytest
+
+from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
+from slurm_bridge_tpu.bridge import Bridge, BridgeJobSpec, JobState
+from slurm_bridge_tpu.bridge.objects import Pod, PodPhase
+from slurm_bridge_tpu.bridge.operator import sizecar_name
+from slurm_bridge_tpu.solver import AuctionConfig
+from slurm_bridge_tpu.wire import serve
+
+FAKESLURM = str(pathlib.Path(__file__).parent / "fakeslurm")
+
+TINY_CLUSTER = {
+    "partitions": {"tiny": {"nodes": ["t1"], "default": True}},
+    "nodes": {"t1": {"cpus": 4, "memory_mb": 16000, "partition": "tiny"}},
+}
+
+
+@pytest.fixture
+def fake_slurm(tmp_path, monkeypatch):
+    state = tmp_path / "slurm-state"
+    state.mkdir(parents=True)
+    (state / "cluster.json").write_text(json.dumps(TINY_CLUSTER))
+    monkeypatch.setenv("SBT_FAKESLURM_STATE", str(state))
+    monkeypatch.setenv("PATH", FAKESLURM + os.pathsep + os.environ["PATH"])
+    return state
+
+
+@pytest.fixture
+def bridge(fake_slurm, tmp_path):
+    sock = str(tmp_path / "agent.sock")
+    server = serve(
+        {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
+        sock,
+    )
+    b = Bridge(
+        sock,
+        scheduler_backend="auction",
+        auction_config=AuctionConfig(rounds=4),
+        preemption=True,
+        scheduler_interval=0.05,
+        configurator_interval=5.0,
+        node_sync_interval=0.05,
+    ).start()
+    yield b
+    b.stop()
+    server.stop(None)
+
+
+def _wait(pred, timeout=25.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_placement_hint_reaches_sbatch(bridge, fake_slurm):
+    bridge.submit(
+        "hinted",
+        BridgeJobSpec(partition="tiny", cpus_per_task=1,
+                      sbatch_script="#!/bin/sh\necho hi\n"),
+    )
+    job = bridge.wait("hinted", timeout=20.0)
+    assert job.status.state == JobState.SUCCEEDED
+    recs = [
+        json.loads(p.read_text())
+        for p in fake_slurm.glob("job_*.json")
+    ]
+    tasks = [t for r in recs if "alias_of" not in r for t in r["tasks"]]
+    assert tasks and all(t["node"] == "t1" for t in tasks)
+
+
+def test_high_priority_preempts_low(bridge, fake_slurm):
+    bridge.submit(
+        "low",
+        BridgeJobSpec(partition="tiny", cpus_per_task=4, priority=1,
+                      sbatch_script="#!/bin/sh\nsleep 30\n"),
+    )
+    # the low-priority job must be running and filling the node
+    assert _wait(
+        lambda: (p := bridge.store.try_get(Pod.KIND, sizecar_name("low")))
+        is not None and p.status.phase == PodPhase.RUNNING
+    ), "low job never started"
+
+    bridge.submit(
+        "high",
+        BridgeJobSpec(partition="tiny", cpus_per_task=4, priority=90,
+                      sbatch_script="#!/bin/sh\necho важно\n"),
+    )
+    job = bridge.wait("high", timeout=25.0)
+    assert job.status.state == JobState.SUCCEEDED
+
+    low_pod = bridge.store.get(Pod.KIND, sizecar_name("low"))
+    assert low_pod.meta.annotations.get("submit-generation") == "1"
+    # the preempted job is requeued, not failed — any live state is fine
+    low = bridge.store.get("BridgeJob", "low")
+    assert low.status.state != JobState.FAILED
+
+
+def test_no_preemption_among_equal_priority(bridge):
+    bridge.submit(
+        "first",
+        BridgeJobSpec(partition="tiny", cpus_per_task=4, priority=5,
+                      sbatch_script="#!/bin/sh\nsleep 2\n"),
+    )
+    assert _wait(
+        lambda: (p := bridge.store.try_get(Pod.KIND, sizecar_name("first")))
+        is not None and p.status.phase == PodPhase.RUNNING
+    )
+    bridge.submit(
+        "second",
+        BridgeJobSpec(partition="tiny", cpus_per_task=4, priority=5,
+                      sbatch_script="#!/bin/sh\necho done\n"),
+    )
+    # equal priority must NOT preempt: first finishes untouched, then second
+    assert bridge.wait("first", timeout=25.0).status.state == JobState.SUCCEEDED
+    first_pod = bridge.store.try_get(Pod.KIND, sizecar_name("first"))
+    assert (first_pod.meta.annotations.get("submit-generation") or "0") == "0"
+    assert bridge.wait("second", timeout=25.0).status.state == JobState.SUCCEEDED
