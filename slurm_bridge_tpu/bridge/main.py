@@ -39,6 +39,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--configurator-interval", type=float, default=30.0)
     parser.add_argument("--leader-lock", default="",
                         help="lease file enabling leader election; empty = no election")
+    parser.add_argument("--state-file", default="",
+                        help="durable store snapshot enabling restart resume "
+                             "(the in-process stand-in for the K8s API's etcd)")
     parser.add_argument("--kubelet-port", type=int, default=-1,
                         help="kubelet-style HTTP logs API port (10250 in the "
                              "reference); -1 disables, an explicit 0 picks a "
@@ -69,6 +72,7 @@ def main(argv: list[str] | None = None) -> int:
         args.endpoint,
         scheduler_backend=args.scheduler,
         preemption=args.preemption,
+        state_file=args.state_file,
         configurator_interval=args.configurator_interval,
         operator_workers=args.threads,
         kubelet_port=None if kubelet_port < 0 else kubelet_port,

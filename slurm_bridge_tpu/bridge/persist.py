@@ -1,0 +1,182 @@
+"""Durable store state — the etcd the standalone bridge doesn't have.
+
+Reference parity (SURVEY.md §5 "Checkpoint/resume"): the reference keeps
+its durable state in the K8s API server — CR status, and the jobid label
+written at submit time, which is the resume token letting any restarted
+component re-associate pods with running Slurm jobs. The standalone
+bridge's ObjectStore is in-process, so without persistence a bridge
+restart would orphan every running job. This module snapshots the store
+to a JSON file (debounced write-behind, atomic rename) and reloads it on
+start: a restarted bridge finds its pods, reads their ``job_ids``, and
+the ordinary level-triggered sync re-converges against live Slurm state —
+the same resume-by-label mechanism, one file instead of etcd.
+
+Serialization is type-driven both ways: ``asdict`` + datetime/enum
+encoding out, the config codec's dataclass decoder (tuples, nested
+dataclasses, Optionals) back in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import logging
+import os
+import threading
+from datetime import datetime
+
+from slurm_bridge_tpu.bridge.store import ObjectStore
+
+log = logging.getLogger("sbt.persist")
+
+_DT_KEY = "__dt__"
+
+
+def _kind_registry() -> dict[str, type]:
+    from slurm_bridge_tpu.bridge.objects import BridgeJob, FetchJob, Pod, VirtualNode
+
+    return {cls.KIND: cls for cls in (BridgeJob, Pod, VirtualNode, FetchJob)}
+
+
+def _encode(value):
+    if isinstance(value, datetime):
+        return {_DT_KEY: value.isoformat()}
+    if isinstance(value, enum.Enum):
+        return value.value
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return {f.name: _encode(getattr(value, f.name)) for f in dataclasses.fields(value)}
+    if isinstance(value, (list, tuple)):
+        return [_encode(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _encode(v) for k, v in value.items()}
+    return value
+
+
+def _decode(value, ftype):
+    import types
+    import typing
+
+    origin = typing.get_origin(ftype)
+    if isinstance(value, dict) and _DT_KEY in value:
+        return datetime.fromisoformat(value[_DT_KEY])
+    if isinstance(ftype, type) and issubclass(ftype, enum.Enum):
+        return ftype(value)
+    if dataclasses.is_dataclass(ftype):
+        return _decode_dataclass(value, ftype)
+    if origin in (list, tuple) and isinstance(value, list):
+        args = typing.get_args(ftype)
+        inner = args[0] if args else typing.Any
+        seq = [_decode(v, inner) for v in value]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict and isinstance(value, dict):
+        args = typing.get_args(ftype)
+        vt = args[1] if len(args) == 2 else typing.Any
+        return {k: _decode(v, vt) for k, v in value.items()}
+    if origin in (typing.Union, types.UnionType):
+        for arg in typing.get_args(ftype):
+            if arg is type(None):
+                if value is None:
+                    return None
+                continue
+            try:
+                return _decode(value, arg)
+            except (TypeError, ValueError):
+                continue
+        return value
+    return value
+
+
+def _decode_dataclass(raw: dict, cls):
+    import typing
+
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in raw:
+            kwargs[f.name] = _decode(raw[f.name], hints.get(f.name, typing.Any))
+    return cls(**kwargs)
+
+
+class StorePersistence:
+    """Debounced write-behind snapshotting for an ObjectStore.
+
+    Every store event schedules a flush ``debounce`` seconds out (coalescing
+    bursts); ``close()`` flushes synchronously. Writes are atomic
+    (tmp + rename), so a crash mid-write leaves the previous snapshot.
+    """
+
+    def __init__(self, store: ObjectStore, path: str, *, debounce: float = 0.2):
+        self.store = store
+        self.path = path
+        self.debounce = debounce
+        self._lock = threading.Lock()
+        self._timer: threading.Timer | None = None
+        self._queue = store.watch(None)
+        self._pump = threading.Thread(target=self._run, name="persist", daemon=True)
+        self._stop = threading.Event()
+        self._pump.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._queue.get(timeout=0.2)
+            except Exception:
+                continue
+            with self._lock:
+                if self._timer is None:
+                    self._timer = threading.Timer(self.debounce, self.flush)
+                    self._timer.daemon = True
+                    self._timer.start()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._timer = None
+        registry = _kind_registry()
+        docs = []
+        for kind in registry:
+            for obj in self.store.list(kind):
+                docs.append({"kind": kind, "object": _encode(obj)})
+        tmp = f"{self.path}.tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump({"version": 1, "objects": docs}, f)
+        os.replace(tmp, self.path)
+        log.debug("persisted %d objects to %s", len(docs), self.path)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._pump.join(5.0)
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+        self.flush()
+        self.store.unwatch(self._queue)
+
+
+def load_into(store: ObjectStore, path: str) -> int:
+    """Restore a snapshot into an (empty) store; returns objects loaded.
+
+    ``meta.resource_version`` restarts from the store's own counter — the
+    optimistic-concurrency tokens only need to be consistent within one
+    process lifetime (same as informer caches resyncing from scratch).
+    """
+    if not os.path.exists(path):
+        return 0
+    registry = _kind_registry()
+    with open(path) as f:
+        data = json.load(f)
+    n = 0
+    for doc in data.get("objects", []):
+        cls = registry.get(doc.get("kind"))
+        if cls is None:
+            log.warning("snapshot has unknown kind %r; skipped", doc.get("kind"))
+            continue
+        try:
+            obj = _decode_dataclass(doc["object"], cls)
+            store.create(obj)
+            n += 1
+        except Exception:
+            log.exception("failed to restore a %s object", doc.get("kind"))
+    return n

@@ -55,9 +55,21 @@ class Bridge:
         kubelet_address: str = "127.0.0.1",
         kubelet_tls_cert: str = "",
         kubelet_tls_key: str = "",
+        state_file: str = "",
     ):
         self.agent_endpoint = agent_endpoint
         self.store = ObjectStore()
+        self.state_file = state_file
+        self._persistence = None
+        if state_file:
+            from slurm_bridge_tpu.bridge.persist import load_into
+
+            restored = load_into(self.store, state_file)
+            if restored:
+                # resume tokens: the restored pods carry job_ids, so the
+                # first provider sync re-associates them with live Slurm
+                # state (SURVEY.md §5 checkpoint/resume)
+                log.info("restored %d objects from %s", restored, state_file)
         self.events = EventRecorder()
         self.channel = dial(agent_endpoint)
         self.client = ServiceClient(self.channel, "WorkloadManager")
@@ -103,6 +115,10 @@ class Bridge:
     # ---- lifecycle ----
 
     def start(self) -> "Bridge":
+        if self.state_file:
+            from slurm_bridge_tpu.bridge.persist import StorePersistence
+
+            self._persistence = StorePersistence(self.store, self.state_file)
         self.configurator.start()
         self.operator.start()
         self._sched_ticker.start()
@@ -121,6 +137,9 @@ class Bridge:
         self.configurator.stop()
         self.operator.stop()
         self.fetch_worker.stop()
+        if self._persistence is not None:
+            self._persistence.close()  # final synchronous snapshot
+            self._persistence = None
         self.client.close()
         self._started = False
 

@@ -1,0 +1,170 @@
+"""Bridge restart resume — the §5 checkpoint/resume story, end to end.
+
+The reference survives operator/VK restarts because its durable state
+(CR status + the jobid label resume token) lives in the K8s API server.
+The standalone bridge's stand-in is the store snapshot file: a restarted
+bridge must find its pods, read their job_ids, and re-converge against
+live Slurm — jobs submitted by the previous process finish under the new
+one, without resubmission.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+
+import pytest
+
+from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
+from slurm_bridge_tpu.bridge import Bridge, BridgeJobSpec, JobState
+from slurm_bridge_tpu.bridge.objects import BridgeJob, Pod, PodPhase
+from slurm_bridge_tpu.bridge.operator import sizecar_name
+from slurm_bridge_tpu.bridge.persist import StorePersistence, load_into
+from slurm_bridge_tpu.bridge.store import ObjectStore
+from slurm_bridge_tpu.core.types import JobInfo, JobStatus
+from slurm_bridge_tpu.wire import serve
+
+FAKESLURM = str(pathlib.Path(__file__).parent / "fakeslurm")
+
+
+# ------------------------------------------------------------ round trip
+
+
+def test_snapshot_round_trip(tmp_path):
+    from datetime import datetime
+
+    from slurm_bridge_tpu.bridge.objects import Meta, PodSpec, PodStatus
+    from slurm_bridge_tpu.core.types import JobDemand
+
+    store = ObjectStore()
+    job = BridgeJob(
+        meta=Meta(name="rt", labels={"a": "b"}),
+        spec=BridgeJobSpec(partition="debug", sbatch_script="#!/bin/sh\n", nodes=2),
+    )
+    store.create(job)
+    pod = Pod(
+        meta=Meta(name="rt-sizecar", owner="rt", annotations={"submit-generation": "2"}),
+        spec=PodSpec(
+            partition="debug",
+            demand=JobDemand(partition="debug", script="x", nodelist=("n1", "n2")),
+            node_name="slurm-partition-debug",
+            placement_hint=("n1", "n2"),
+        ),
+        status=PodStatus(
+            phase=PodPhase.RUNNING,
+            job_ids=(101,),
+            job_infos=[
+                JobInfo(id=101, state=JobStatus.RUNNING,
+                        start_time=datetime(2026, 7, 29, 12, 0, 0))
+            ],
+        ),
+    )
+    store.create(pod)
+
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, debounce=0.01)
+    p.close()  # flushes synchronously
+
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 2
+    j2 = fresh.get(BridgeJob.KIND, "rt")
+    assert j2.spec.nodes == 2 and j2.meta.labels == {"a": "b"}
+    p2 = fresh.get(Pod.KIND, "rt-sizecar")
+    assert p2.status.job_ids == (101,)
+    assert p2.spec.placement_hint == ("n1", "n2")
+    assert p2.spec.demand.nodelist == ("n1", "n2")
+    info = p2.status.job_infos[0]
+    assert info.state is JobStatus.RUNNING
+    assert info.start_time.year == 2026
+    assert p2.meta.annotations["submit-generation"] == "2"
+
+
+def test_load_missing_file(tmp_path):
+    assert load_into(ObjectStore(), str(tmp_path / "absent.json")) == 0
+
+
+def test_corrupt_snapshot_keeps_previous_on_crash(tmp_path):
+    """Atomic replace: a snapshot is either the old or the new state."""
+    from slurm_bridge_tpu.bridge.objects import Meta
+
+    store = ObjectStore()
+    store.create(BridgeJob(
+        meta=Meta(name="x"),
+        spec=BridgeJobSpec(partition="p", sbatch_script="s"),
+    ))
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, debounce=0.01)
+    p.close()
+    # leftover tmp from a hypothetical crash must not break loading
+    (tmp_path / "state.json.tmp").write_text("garbage{")
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 1
+
+
+# ----------------------------------------------------------------- e2e
+
+
+@pytest.fixture
+def fake_slurm(tmp_path, monkeypatch):
+    state = tmp_path / "slurm-state"
+    monkeypatch.setenv("SBT_FAKESLURM_STATE", str(state))
+    monkeypatch.setenv("PATH", FAKESLURM + os.pathsep + os.environ["PATH"])
+    return state
+
+
+def _bridge(sock: str, state_file: str) -> Bridge:
+    return Bridge(
+        sock,
+        scheduler_backend="greedy",
+        scheduler_interval=0.05,
+        configurator_interval=5.0,
+        node_sync_interval=0.05,
+        state_file=state_file,
+    ).start()
+
+
+def test_restart_resume_running_job(fake_slurm, tmp_path):
+    sock = str(tmp_path / "agent.sock")
+    server = serve(
+        {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
+        sock,
+    )
+    state_file = str(tmp_path / "bridge-state.json")
+    try:
+        a = _bridge(sock, state_file)
+        a.submit(
+            "survivor",
+            BridgeJobSpec(partition="debug",
+                          sbatch_script="#!/bin/sh\nsleep 1\necho resumed-ok\n"),
+        )
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            pod = a.store.try_get(Pod.KIND, sizecar_name("survivor"))
+            if pod is not None and pod.status.job_ids:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("job never submitted")
+        job_ids = pod.status.job_ids
+        a.stop()  # final snapshot written; the slurm job keeps running
+
+        b = _bridge(sock, state_file)
+        try:
+            p2 = b.store.get(Pod.KIND, sizecar_name("survivor"))
+            assert p2.status.job_ids == job_ids, "resume token lost"
+            job = b.wait("survivor", timeout=20.0)
+            assert job.status.state == JobState.SUCCEEDED
+            # resume, not resubmission: still exactly one slurm job record
+            recs = [
+                json.loads(p.read_text())
+                for p in fake_slurm.glob("job_*.json")
+            ]
+            real = [r for r in recs if "alias_of" not in r]
+            assert len(real) == 1
+            assert b"resumed-ok" in b"".join(b.logs("survivor"))
+        finally:
+            b.stop()
+    finally:
+        server.stop(None)
