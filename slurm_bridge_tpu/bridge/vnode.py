@@ -50,6 +50,16 @@ from slurm_bridge_tpu.wire.convert import (
 
 log = logging.getLogger("sbt.vnode")
 
+#: gRPC codes meaning "the agent is unreachable / busy", not "the request
+#: is bad" — submissions stay Pending and retry on the next sync instead
+#: of failing the pod (the reference fails it either way, provider.go:54).
+_TRANSIENT_RPC = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+    grpc.StatusCode.CANCELLED,
+)
+
 
 class VirtualNodeProvider:
     def __init__(
@@ -215,6 +225,16 @@ class VirtualNodeProvider:
         try:
             resp = self.client.SubmitJob(demand_to_submit(demand, submitter_id=submitter))
         except grpc.RpcError as e:
+            if e.code() in _TRANSIENT_RPC:
+                # agent unreachable ≠ bad job: stay Pending and let the
+                # next sync retry (the agent's submit ledger makes the
+                # retry idempotent even if the first attempt landed)
+                self.events.event(
+                    pod, Reason.POD_PENDING,
+                    f"agent unavailable, will retry: {e.code().name}",
+                    warning=True,
+                )
+                return
             self.events.event(
                 pod, Reason.POD_FAILED, f"submit failed: {e.details()}", warning=True
             )

@@ -33,6 +33,7 @@ class Reason:
     # pods / virtual nodes
     POD_CREATED = "PodCreated"
     POD_FAILED = "PodFailed"
+    POD_PENDING = "PodPendingRetry"
     NODE_READY = "VirtualNodeReady"
     NODE_GONE = "VirtualNodeGone"
     # results

@@ -1,0 +1,113 @@
+"""Fault injection: the bridge must ride out an agent outage.
+
+SURVEY.md §5 notes the reference has no fault injection at all and its
+CreatePod fails the pod on ANY submit error. Here an unreachable agent
+leaves the pod Pending for retry, and the agent's submit ledger makes the
+retry idempotent — so an agent restart mid-flight loses nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+
+import pytest
+
+from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
+from slurm_bridge_tpu.bridge import Bridge, BridgeJobSpec, JobState
+from slurm_bridge_tpu.bridge.objects import Pod, PodPhase
+from slurm_bridge_tpu.bridge.operator import sizecar_name
+from slurm_bridge_tpu.wire import serve
+
+FAKESLURM = str(pathlib.Path(__file__).parent / "fakeslurm")
+
+
+@pytest.fixture
+def fake_slurm(tmp_path, monkeypatch):
+    state = tmp_path / "slurm-state"
+    monkeypatch.setenv("SBT_FAKESLURM_STATE", str(state))
+    monkeypatch.setenv("PATH", FAKESLURM + os.pathsep + os.environ["PATH"])
+    return state
+
+
+def _serve_agent(sock: str, ledger: str):
+    return serve(
+        {
+            "WorkloadManager": WorkloadServicer(
+                SlurmClient(), tail_poll_interval=0.02, ledger_file=ledger
+            )
+        },
+        sock,
+    )
+
+
+def test_agent_restart_mid_submission(fake_slurm, tmp_path):
+    sock = str(tmp_path / "agent.sock")
+    ledger = str(tmp_path / "ledger.json")
+    server = _serve_agent(sock, ledger)
+    bridge = Bridge(
+        sock,
+        scheduler_backend="greedy",
+        scheduler_interval=0.05,
+        configurator_interval=0.2,
+        node_sync_interval=0.05,
+    ).start()
+    try:
+        # let the partition/vnode discovery settle, then kill the agent
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not bridge.configurator.providers:
+            time.sleep(0.05)
+        assert bridge.configurator.providers, "vnodes never came up"
+        server.stop(None)
+
+        bridge.submit(
+            "outage",
+            BridgeJobSpec(partition="debug",
+                          sbatch_script="#!/bin/sh\necho through-the-outage\n"),
+        )
+        # the pod must survive several failed sync rounds without Failing
+        time.sleep(1.0)
+        pod = bridge.store.try_get(Pod.KIND, sizecar_name("outage"))
+        if pod is not None:
+            assert pod.status.phase != PodPhase.FAILED, pod.status.reason
+            assert not pod.status.job_ids
+
+        # agent comes back (same ledger) — everything converges
+        server = _serve_agent(sock, ledger)
+        job = bridge.wait("outage", timeout=25.0)
+        assert job.status.state == JobState.SUCCEEDED
+        assert b"through-the-outage" in b"".join(bridge.logs("outage"))
+
+        # exactly one submission despite the retries
+        recs = [json.loads(p.read_text()) for p in fake_slurm.glob("job_*.json")]
+        assert len([r for r in recs if "alias_of" not in r]) == 1
+    finally:
+        bridge.stop()
+        server.stop(None)
+
+
+def test_bad_job_still_fails_fast(fake_slurm, tmp_path):
+    """Permanent errors (bad partition → InvalidArgument) must still fail
+    the pod immediately, not retry forever."""
+    sock = str(tmp_path / "agent.sock")
+    server = _serve_agent(sock, str(tmp_path / "ledger.json"))
+    bridge = Bridge(
+        sock,
+        scheduler_backend="greedy",
+        scheduler_interval=0.05,
+        configurator_interval=0.2,
+        node_sync_interval=0.05,
+    ).start()
+    try:
+        bridge.submit(
+            "doomed",
+            BridgeJobSpec(partition="debug",
+                          sbatch_script="#!/bin/sh\n# fail-submit\n"),
+        )
+        job = bridge.wait("doomed", timeout=20.0)
+        assert job.status.state == JobState.FAILED
+    finally:
+        bridge.stop()
+        server.stop(None)
