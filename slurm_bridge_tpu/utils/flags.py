@@ -45,6 +45,33 @@ def ip_port(value: str) -> str:
     return value
 
 
+def add_deprecated_flag(parser, name: str, *, dest: str, replacement: str, **kw):
+    """Register ``name`` as a deprecated alias of ``replacement``.
+
+    Reference parity: the VK's deprecated-flag machinery
+    (cmd/slurm-virtual-kubelet/app/options/options.go:274-302) — the old
+    spelling still parses into the same dest, but using it logs a warning
+    naming the replacement.
+    """
+    import argparse
+    import logging
+
+    log = logging.getLogger("sbt.flags")
+
+    class _Deprecated(argparse.Action):
+        def __call__(self, _parser, namespace, values, option_string=None):
+            log.warning(
+                "flag %s is deprecated, use %s", option_string, replacement
+            )
+            setattr(namespace, dest, values if values is not None else True)
+
+    nargs = kw.pop("nargs", None)
+    parser.add_argument(
+        name, dest=dest, action=_Deprecated, help=argparse.SUPPRESS,
+        nargs=nargs, **kw,
+    )
+
+
 def port_range(value: str) -> tuple[int, int]:
     """``lo-hi`` (inclusive) or a single port (PortRangeVar)."""
     lo_s, sep, hi_s = value.partition("-")

@@ -53,6 +53,23 @@ class TestFlagValidators:
             with pytest.raises(argparse.ArgumentTypeError):
                 port_range(bad)
 
+    def test_deprecated_flag_warns_and_maps(self, caplog):
+        from slurm_bridge_tpu.utils.flags import add_deprecated_flag
+
+        parser = argparse.ArgumentParser()
+        parser.add_argument("--agent-endpoint", dest="endpoint")
+        add_deprecated_flag(parser, "--endpoint-addr", dest="endpoint",
+                            replacement="--agent-endpoint")
+        with caplog.at_level("WARNING", logger="sbt.flags"):
+            args = parser.parse_args(["--endpoint-addr", "host:9999"])
+        assert args.endpoint == "host:9999"
+        assert any("deprecated" in r.message for r in caplog.records)
+        # the new spelling stays silent
+        caplog.clear()
+        with caplog.at_level("WARNING", logger="sbt.flags"):
+            args = parser.parse_args(["--agent-endpoint", "a:1"])
+        assert args.endpoint == "a:1" and not caplog.records
+
 
 @dataclasses.dataclass(frozen=True)
 class _Inner:
