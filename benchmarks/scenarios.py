@@ -101,7 +101,11 @@ def scenario_4() -> dict:
     snap, batch = random_scenario(
         10_000, 12_000, seed=4, load=0.8, gang_fraction=0.5, gang_size=8
     )
-    out = _solve_metrics(snap, batch, AuctionConfig(rounds=12))
+    out = _solve_metrics(
+        snap,
+        batch,
+        AuctionConfig(rounds=16, gang_salvage_rounds=8, gang_first=True),
+    )
     gangs = np.unique(batch.gang_id).size
     out.update(scenario=4, gangs=int(gangs))
     return out

@@ -56,6 +56,19 @@ class AuctionConfig:
     rounds: int = 8
     eta: float = 0.5  # price step (bids are O(1))
     jitter: float = 1.0  # spread amplitude (the dominant bid term)
+    #: the final K rounds begin by revoking incomplete gangs, so capacity a
+    #: doomed gang was sitting on gets re-bid while rounds remain (without
+    #: this, heavy-gang scenarios placed ~6% fewer jobs than greedy; the
+    #: earlier rounds stay revoke-free so gangs can assemble under
+    #: contention across several rounds)
+    gang_salvage_rounds: int = 2
+    #: admit multi-shard gangs ahead of singles regardless of priority —
+    #: hardest-to-place-first, the parallel analogue of best-fit-decreasing.
+    #: Recovers nearly all of greedy's edge on gang-heavy fragmented
+    #: clusters (BASELINE config #4) at the cost of strict priority order
+    #: between a gang and a higher-priority single, so it is opt-in; the
+    #: product scheduler keeps strict ordering (preemption depends on it).
+    gang_first: bool = False
     #: best-fit bias relative to jitter. Empirically 0.0 places the most
     #: shards at every load we measured (spread beats packing for raw
     #: placement count); >0 buys tighter packing at ~1% fewer placements.
@@ -208,7 +221,7 @@ def multi_mask(gang: jnp.ndarray, p: int) -> jnp.ndarray:
     jax.jit,
     static_argnames=(
         "rounds", "num_nodes", "eta", "jitter", "affinity_weight", "dtype",
-        "use_pallas", "interpret",
+        "use_pallas", "interpret", "gang_salvage_rounds", "gang_first",
     ),
 )
 def _auction_kernel(
@@ -232,6 +245,8 @@ def _auction_kernel(
     dtype=jnp.float32,
     use_pallas: bool = False,
     interpret: bool = False,
+    gang_salvage_rounds: int = AuctionConfig.gang_salvage_rounds,
+    gang_first: bool = AuctionConfig.gang_first,
 ):
     p = dem.shape[0]
     n = num_nodes
@@ -251,9 +266,16 @@ def _auction_kernel(
     own = jax.lax.broadcasted_iota(jnp.int32, (p, n), 1) == incumbent[:, None]
     static_ok = jnp.where(inc[:, None], own & static_ok, static_ok)
     multi = multi_mask(gang, p)
+    # admission-ordering priority; only the kernel sees the gang-first boost
+    prio_eff = prio + multi.astype(jnp.float32) * (1e4 if gang_first else 0.0)
+
+    salvage_start = rounds - min(gang_salvage_rounds, max(0, rounds - 1))
 
     def round_body(rnd, carry):
         assign, price = carry
+        # salvage phase: incomplete gangs release their capacity up front
+        # so the remaining rounds can re-bid it (see AuctionConfig)
+        assign = jnp.where(rnd >= salvage_start, gang_revoke(assign, gang, p), assign)
         free = free0 - used_capacity(dem, assign, n)
 
         if use_pallas:
@@ -292,7 +314,7 @@ def _auction_kernel(
         choice = jnp.where(valid & (choice < n), choice, n)  # sentinel segment n
 
         choice, valid = gang_dedup(choice, valid, assign, gang, multi, n)
-        admitted = admit(choice, valid, dem, prio, free, n)
+        admitted = admit(choice, valid, dem, prio_eff, free, n)
         assign = jnp.where(
             admitted & unplaced, jnp.where(choice < n, choice, -1), assign
         )
@@ -374,6 +396,8 @@ def auction_place(
         dtype=jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32,
         use_pallas=use_pallas,
         interpret=use_pallas and jax.default_backend() != "tpu",
+        gang_salvage_rounds=cfg.gang_salvage_rounds,
+        gang_first=cfg.gang_first,
     )
     assign_np = np.asarray(assign)
     return Placement(

@@ -50,7 +50,8 @@ _PAD_PART = np.int32(2**30)
 
 @lru_cache(maxsize=32)
 def _make_sharded_kernel(
-    mesh: Mesh, rounds: int, n_total: int, eta, jitter, affinity_weight, dtype
+    mesh: Mesh, rounds: int, n_total: int, eta, jitter, affinity_weight, dtype,
+    gang_salvage_rounds: int, gang_first: bool,
 ):
     """Build + jit the sharded kernel once per (mesh, shape, config) — a
     fresh closure per call would force full XLA recompilation every tick."""
@@ -97,8 +98,10 @@ def _make_sharded_kernel(
         free0 = jax.lax.all_gather(free0_blk, "mp", tiled=True)  # [N, R]
         p = dem.shape[0]
         multi = multi_mask(gang, p)
+        prio_eff = prio + multi.astype(jnp.float32) * (1e4 if gang_first else 0.0)
         dem_n_blk = (dem_blk * scale).astype(dtype)
         dem_n = (dem * scale).astype(dtype)
+        salvage_start = rounds - min(gang_salvage_rounds, max(0, rounds - 1))
 
         # static local feasibility block
         part_ok = (job_part_blk[:, None] == node_part_blk[None, :]) | (
@@ -116,6 +119,10 @@ def _make_sharded_kernel(
 
         def round_body(rnd, carry):
             assign, price = carry  # replicated [P], [N]
+            # salvage phase mirrors the single-device kernel (auction.py)
+            assign = jnp.where(
+                rnd >= salvage_start, gang_revoke(assign, gang, p), assign
+            )
             free = free0 - used_capacity(dem, assign, n)  # replicated, no comms
             free_blk = jax.lax.dynamic_slice_in_dim(free, n_off, nblk, axis=0)
             price_blk = jax.lax.dynamic_slice_in_dim(price, n_off, nblk, axis=0)
@@ -153,7 +160,7 @@ def _make_sharded_kernel(
             choice = jnp.where(valid, choice, n)
 
             choice, valid = gang_dedup(choice, valid, assign, gang, multi, n)
-            admitted = admit(choice, valid, dem, prio, free, n)
+            admitted = admit(choice, valid, dem, prio_eff, free, n)
             assign = jnp.where(
                 admitted & unplaced, jnp.where(choice < n, choice, -1), assign
             )
@@ -208,7 +215,8 @@ def sharded_place(
         inc[:p_real] = incumbent
 
     kernel = _make_sharded_kernel(
-        mesh, cfg.rounds, n_total, cfg.eta, cfg.jitter, cfg.affinity_weight, dtype
+        mesh, cfg.rounds, n_total, cfg.eta, cfg.jitter, cfg.affinity_weight, dtype,
+        cfg.gang_salvage_rounds, cfg.gang_first,
     )
     with jax.set_mesh(mesh):
         assign, free_after = kernel(
