@@ -3,7 +3,7 @@
 
 Prints ONE JSON line:
   {"metric": "pods_placed_per_sec_50kx10k", "value": N, "unit": "pods/s",
-   "vs_baseline": X}
+   "vs_baseline": X, "backend": "tpu|cpu"}
 
 where ``vs_baseline`` is the speedup of the JAX auction solver (on the
 available accelerator) over the native C++ greedy packer — the stand-in for
@@ -11,11 +11,17 @@ the reference's in-process Go-side placement path (BASELINE.md: the
 reference publishes no numbers, so the greedy packer we built at parity IS
 the measured baseline).
 
+Robustness contract (round-1 failure: the TPU backend init wedged and the
+bench recorded *nothing*): backend acquisition runs in a worker thread
+under a bounded timeout with one retry; on failure or hang the bench falls
+back to CPU (config-update first, process re-exec if the init lock is
+wedged) and STILL emits the one JSON line, with an honest "backend" field.
+A global watchdog emits whatever partial numbers exist rather than dying
+silently.
+
 The solve runs through :class:`DeviceSolver`: the node snapshot stays
 device-resident across ticks (as the production reconcile loop holds it)
-and only the assignment vector is fetched back — on a tunneled accelerator
-the result fetch costs ~140 ms flat, an order of magnitude over the actual
-kernel time, so what is measured is the tick loop's real steady state.
+and only the assignment vector is fetched back.
 
 Extra per-scenario detail goes to stderr; stdout carries only the one line.
 The full five-scenario table lives in ``benchmarks/scenarios.py``.
@@ -24,10 +30,111 @@ The full five-scenario table lives in ``benchmarks/scenarios.py``.
 from __future__ import annotations
 
 import json
+import os
 import sys
+import threading
 import time
 
 import numpy as np
+
+_FORCED_CPU_ENV = "SBT_BENCH_CPU"
+_METRIC = "pods_placed_per_sec_50kx10k"
+
+# Filled in as the run progresses so the watchdog can emit a partial line.
+_PARTIAL: dict = {"metric": _METRIC, "value": 0.0, "unit": "pods/s",
+                  "vs_baseline": 0.0, "backend": "none"}
+_EMITTED = threading.Event()
+
+
+def _emit(payload: dict) -> None:
+    if _EMITTED.is_set():
+        return
+    _EMITTED.set()
+    print(json.dumps(payload), flush=True)
+
+
+def _start_watchdog(timeout_s: float) -> threading.Timer:
+    """If the bench wedges, emit the partial JSON line instead of nothing."""
+
+    def _fire() -> None:
+        print(f"# WATCHDOG: bench exceeded {timeout_s:.0f}s — emitting partial",
+              file=sys.stderr, flush=True)
+        _emit(dict(_PARTIAL, note="watchdog-partial"))
+        sys.stdout.flush()
+        os._exit(0)
+
+    timer = threading.Timer(timeout_s, _fire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
+def _reexec_forced_cpu() -> None:
+    """Escape a wedged backend-init lock: replace the whole process."""
+    print("# backend init wedged — re-exec with forced CPU", file=sys.stderr,
+          flush=True)
+    env = dict(os.environ, **{_FORCED_CPU_ENV: "1"})
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
+def _force_cpu() -> str:
+    import jax
+
+    # Config beats both the env and the image's sitecustomize platform pin.
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        import jax.extend.backend
+
+        jax.extend.backend.clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+    jax.devices()
+    return "cpu"
+
+
+def _acquire_backend(probe_timeouts=(150.0, 60.0)) -> str:
+    """Initialize a JAX backend, preferring the accelerator, never hanging.
+
+    Returns the backend name actually live. On probe timeout the init lock
+    may be held by the dead probe thread, so recovery is by re-exec with a
+    marker env var; on probe *error* the lock is free and an in-process
+    CPU fallback suffices.
+    """
+    if os.environ.get(_FORCED_CPU_ENV) == "1":
+        return _force_cpu()
+
+    import jax
+
+    for attempt, timeout_s in enumerate(probe_timeouts, 1):
+        result: dict = {}
+
+        def _probe() -> None:
+            try:
+                result["backend"] = jax.default_backend()
+            except Exception as exc:  # noqa: BLE001 — report and fall back
+                result["error"] = exc
+
+        t = threading.Thread(target=_probe, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        if result.get("backend"):
+            return result["backend"]
+        if "error" in result:
+            print(f"# backend probe {attempt} failed: {result['error']!r}",
+                  file=sys.stderr, flush=True)
+            continue
+        # Probe thread is wedged inside backend init; the init lock is
+        # poisoned for this process. Re-exec (does not return).
+        _reexec_forced_cpu()
+
+    # All probes errored cleanly — fall back in-process.
+    try:
+        return _force_cpu()
+    except Exception as exc:  # noqa: BLE001
+        print(f"# in-process CPU fallback failed: {exc!r}", file=sys.stderr,
+              flush=True)
+        _reexec_forced_cpu()
+        raise AssertionError("unreachable")
 
 
 def _steady_state_ms(fn, *, warmup: int = 1, iters: int = 5) -> float:
@@ -42,32 +149,36 @@ def _steady_state_ms(fn, *, warmup: int = 1, iters: int = 5) -> float:
 
 
 def main() -> None:
+    _start_watchdog(1500.0)
+    backend = _acquire_backend()
+
+    import jax
+
     from slurm_bridge_tpu.solver import AuctionConfig
     from slurm_bridge_tpu.solver.greedy_native import greedy_place_native
     from slurm_bridge_tpu.solver.session import DeviceSolver
     from slurm_bridge_tpu.solver.snapshot import random_scenario
 
-    import jax
-
-    backend = jax.default_backend()
     n_dev = len(jax.devices())
-    print(f"# backend={backend} devices={n_dev}", file=sys.stderr)
+    _PARTIAL["backend"] = backend
+    print(f"# backend={backend} devices={n_dev}", file=sys.stderr, flush=True)
 
     # BASELINE.md scenario #3-shaped: 50k pods, 10k nodes, gres + gangs
     snap, batch = random_scenario(
         10_000, 50_000, seed=42, load=0.7, gpu_fraction=0.15, gang_fraction=0.05
     )
     p = batch.num_shards
-    print(f"# scenario: {p} shards x {snap.num_nodes} nodes", file=sys.stderr)
+    print(f"# scenario: {p} shards x {snap.num_nodes} nodes", file=sys.stderr,
+          flush=True)
 
-    # --- baseline: native greedy (CPU) ---
+    # --- baseline: native greedy (CPU); warmup absorbs any g++ rebuild ---
     t_greedy = _steady_state_ms(
-        lambda: greedy_place_native(snap, batch), warmup=0, iters=3
+        lambda: greedy_place_native(snap, batch), warmup=1, iters=3
     )
     g = greedy_place_native(snap, batch)
     print(
         f"# greedy_native: {t_greedy:.1f} ms, placed {int(g.placed.sum())}",
-        file=sys.stderr,
+        file=sys.stderr, flush=True,
     )
 
     # --- JAX auction (sharded across every device when more than one) ---
@@ -89,21 +200,29 @@ def main() -> None:
     print(
         f"# auction[{backend}x{n_dev}]: {t_auction:.1f} ms, placed {placed} jobs "
         f"/ {int(a.placed.sum())} shards (greedy placed {len(g.by_job(batch))} jobs)",
-        file=sys.stderr,
+        file=sys.stderr, flush=True,
     )
 
     pods_per_sec = placed / (t_auction / 1e3)
-    print(
-        json.dumps(
-            {
-                "metric": "pods_placed_per_sec_50kx10k",
-                "value": round(pods_per_sec, 1),
-                "unit": "pods/s",
-                "vs_baseline": round(t_greedy / t_auction, 2),
-            }
-        )
+    _PARTIAL.update(value=round(pods_per_sec, 1),
+                    vs_baseline=round(t_greedy / t_auction, 2))
+    _emit(
+        {
+            "metric": _METRIC,
+            "value": round(pods_per_sec, 1),
+            "unit": "pods/s",
+            "vs_baseline": round(t_greedy / t_auction, 2),
+            "backend": backend,
+        }
     )
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # noqa: BLE001 — the one line must still appear
+        import traceback
+
+        traceback.print_exc()
+        _emit(dict(_PARTIAL, note=f"error: {type(exc).__name__}: {exc}"))
+        sys.exit(0)

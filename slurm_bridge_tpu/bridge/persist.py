@@ -111,6 +111,11 @@ class StorePersistence:
         self.path = path
         self.debounce = debounce
         self._lock = threading.Lock()
+        # Serializes whole snapshot writes: a timer-fired flush can race
+        # close()'s synchronous flush (or the next timer when a flush
+        # outlasts the debounce), and two writers interleaving on the same
+        # ``.tmp`` could atomically install a corrupt snapshot.
+        self._flush_lock = threading.Lock()
         self._timer: threading.Timer | None = None
         self._queue = store.watch(None)
         self._pump = threading.Thread(target=self._run, name="persist", daemon=True)
@@ -132,17 +137,20 @@ class StorePersistence:
     def flush(self) -> None:
         with self._lock:
             self._timer = None
-        registry = _kind_registry()
-        docs = []
-        for kind in registry:
-            for obj in self.store.list(kind):
-                docs.append({"kind": kind, "object": _encode(obj)})
-        tmp = f"{self.path}.tmp"
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump({"version": 1, "objects": docs}, f)
-        os.replace(tmp, self.path)
-        log.debug("persisted %d objects to %s", len(docs), self.path)
+        with self._flush_lock:
+            registry = _kind_registry()
+            docs = []
+            for kind in registry:
+                for obj in self.store.list(kind):
+                    docs.append({"kind": kind, "object": _encode(obj)})
+            tmp = f"{self.path}.tmp"
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump({"version": 1, "objects": docs}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            log.debug("persisted %d objects to %s", len(docs), self.path)
 
     def close(self) -> None:
         self._stop.set()

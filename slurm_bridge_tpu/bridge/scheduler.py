@@ -67,6 +67,11 @@ _pods_preempted = REGISTRY.counter(
     "sbt_scheduler_pods_preempted_total", "pods preempted for higher priority work"
 )
 
+#: Job ids whose preemption-cancel failed (agent unreachable); retried every
+#: tick until they land — a dropped cancel would orphan the Slurm job while
+#: the requeued pod resubmits, double-executing the workload.
+PENDING_CANCEL_ANNOTATION = "sbt.kubecluster.org/pending-cancel"
+
 
 class PlacementScheduler:
     def __init__(
@@ -90,6 +95,9 @@ class PlacementScheduler:
         self.preemption = preemption
         self.bucket = bucket
         self._solver: DeviceSolver | None = None
+        # cancels whose pod vanished before the failure could be annotated;
+        # retried alongside the annotated ones
+        self._orphan_cancels: set[int] = set()
 
     # ---- inventory ----
 
@@ -141,6 +149,7 @@ class PlacementScheduler:
 
     def tick(self) -> int:
         """Solve one placement round; returns the number of pods bound."""
+        self._retry_pending_cancels()
         pods = self.pending_pods()
         if not pods:
             # nothing pending ⇒ nothing can displace anyone; keep the idle
@@ -292,16 +301,72 @@ class PlacementScheduler:
             return False
         if not job_ids:
             return False
-        for job_id in job_ids:
-            try:
-                self.client.CancelJob(pb.CancelJobRequest(job_id=job_id))
-            except grpc.RpcError as e:
-                log.warning("preempt: cancel job %d failed: %s", job_id, e.details())
+        failed = self._cancel_jobs(job_ids, context="preempt")
+        if failed:
+            self._record_pending_cancels(pod.name, failed)
         self.events.event(
             pod, Reason.PLACEMENT_FAILED,
             "preempted: displaced by higher-priority work", warning=True,
         )
         return True
+
+    def _cancel_jobs(self, job_ids: list[int], *, context: str) -> list[int]:
+        """CancelJob each id; returns the ids whose cancel failed."""
+        failed: list[int] = []
+        for job_id in job_ids:
+            try:
+                self.client.CancelJob(pb.CancelJobRequest(job_id=job_id))
+            except grpc.RpcError as e:
+                log.warning(
+                    "%s: cancel job %d failed (will retry next tick): %s",
+                    context, job_id, e.details(),
+                )
+                failed.append(job_id)
+        return failed
+
+    def _record_pending_cancels(self, pod_name: str, job_ids: list[int]) -> None:
+        """Persist failed cancels on the pod so they survive restarts and
+        are retried every tick (ADVICE r1: never drop a cancel after one
+        attempt — an orphaned Slurm job double-executes the workload)."""
+
+        def record(p: Pod):
+            existing = p.meta.annotations.get(PENDING_CANCEL_ANNOTATION, "")
+            ids = {int(t) for t in existing.split(",") if t}
+            ids.update(job_ids)
+            p.meta.annotations[PENDING_CANCEL_ANNOTATION] = ",".join(
+                str(i) for i in sorted(ids)
+            )
+
+        try:
+            self.store.mutate(Pod.KIND, pod_name, record)
+        except NotFound:
+            self._orphan_cancels.update(job_ids)
+
+    def _retry_pending_cancels(self) -> None:
+        """Drain the pending-cancel backlog at the top of every tick."""
+        if self._orphan_cancels:
+            still = self._cancel_jobs(sorted(self._orphan_cancels), context="retry")
+            self._orphan_cancels = set(still)
+        for pod in self.store.list(Pod.KIND):
+            pending = pod.meta.annotations.get(PENDING_CANCEL_ANNOTATION)
+            if not pending:
+                continue
+            ids = [int(t) for t in pending.split(",") if t]
+            still = set(self._cancel_jobs(ids, context="retry"))
+            if len(still) == len(ids):
+                continue  # nothing landed; annotation already correct
+            new_val = ",".join(str(i) for i in ids if i in still)
+
+            def record(p: Pod):
+                if new_val:
+                    p.meta.annotations[PENDING_CANCEL_ANNOTATION] = new_val
+                else:
+                    p.meta.annotations.pop(PENDING_CANCEL_ANNOTATION, None)
+
+            try:
+                self.store.mutate(Pod.KIND, pod.name, record)
+            except NotFound:
+                self._orphan_cancels.update(still)
 
     def _bind(self, pod: Pod, node_name: str, hint: tuple[str, ...]) -> bool:
         bound = [False]

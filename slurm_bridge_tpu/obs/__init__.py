@@ -14,6 +14,7 @@ from slurm_bridge_tpu.obs.tracing import (
     setup_tracing,
     tracing_interceptor,
 )
+from slurm_bridge_tpu.obs.otlp import OtlpHttpExporter
 
 __all__ = [
     "setup_logging",
@@ -31,6 +32,7 @@ __all__ = [
     "LogExporter",
     "JsonFileExporter",
     "InMemoryExporter",
+    "OtlpHttpExporter",
     "setup_tracing",
     "tracing_interceptor",
 ]

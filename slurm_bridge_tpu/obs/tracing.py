@@ -156,8 +156,12 @@ def available_exporters() -> list[str]:
 
 
 def make_exporter(name: str, **kwargs):
+    key = name.lower()
+    if key == "otlp" and key not in _EXPORTERS:
+        # registers itself on import; lazy so the base registry stays dep-free
+        import slurm_bridge_tpu.obs.otlp  # noqa: F401
     try:
-        factory = _EXPORTERS[name.lower()]
+        factory = _EXPORTERS[key]
     except KeyError:
         raise ValueError(
             f"unknown trace exporter {name!r}; available: {available_exporters()}"

@@ -1,0 +1,124 @@
+"""Hang-proof JAX backend acquisition for production daemons.
+
+A broken/unreachable accelerator must degrade the control plane, never
+wedge it: JAX backend initialisation can hang indefinitely (observed with
+a tunneled TPU whose setup stalls), and once a thread is stuck inside the
+init lock the whole process is poisoned — no other thread can reach a
+backend either. So the decision is made *before* any in-process
+initialisation, with the risky probe in a subprocess: a wedged init dies
+with the child, and the parent falls back to the CPU platform via a config
+update (which beats env/sitecustomize pins as long as nothing initialised
+yet).
+
+``ensure_backend()`` is called by every solver entry point
+(:class:`~slurm_bridge_tpu.solver.session.DeviceSolver`,
+:func:`~slurm_bridge_tpu.solver.auction.auction_place`,
+:func:`~slurm_bridge_tpu.solver.sharded.sharded_place`) — once per
+process; subsequent calls return the cached decision.
+
+Operator override: ``SBT_BACKEND=cpu`` skips the probe and pins CPU;
+``SBT_BACKEND=trust`` skips the probe and trusts whatever JAX picks
+(restoring pre-probe behavior when the accelerator is known-good).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+
+log = logging.getLogger("sbt.backend")
+
+_decided: str | None = None
+_lock = threading.Lock()
+
+
+def _force_cpu() -> None:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        import jax.extend.backend
+
+        jax.extend.backend.clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _backends_initialized() -> bool:
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge.backends_are_initialized())
+    except Exception:  # private-API drift: assume not initialised
+        return False
+
+
+def _probe_subprocess(timeout: float) -> str:
+    """Ask a child process which backend JAX would pick. Empty = failed."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return ""
+    if out.returncode != 0 or not out.stdout.strip():
+        return ""
+    return out.stdout.strip().splitlines()[-1]
+
+
+def ensure_backend(probe_timeout: float = 60.0) -> str:
+    """Decide (once) which JAX backend this process uses; returns its name.
+
+    Never blocks longer than ``probe_timeout`` + CPU init time, even when
+    the accelerator's PJRT plugin hangs during setup.
+    """
+    global _decided
+    with _lock:
+        if _decided is not None:
+            return _decided
+
+        import jax
+
+        forced = os.environ.get("SBT_BACKEND", "").lower()
+        if forced == "cpu":
+            _force_cpu()
+            _decided = "cpu"
+            return _decided
+        if _backends_initialized():
+            _decided = jax.default_backend()  # someone chose already; safe
+            return _decided
+        platforms = str(
+            getattr(jax.config, "jax_platforms", None)
+            or os.environ.get("JAX_PLATFORMS", "")
+        )
+        if platforms == "cpu":
+            _decided = "cpu"  # already pinned (tests, forced envs)
+            return _decided
+        if forced == "trust":
+            _decided = jax.default_backend()
+            return _decided
+
+        name = _probe_subprocess(probe_timeout)
+        if name:
+            _decided = name
+            return _decided
+        log.warning(
+            "accelerator backend probe failed or hung (>%.0fs) — "
+            "falling back to CPU; set SBT_BACKEND=trust to skip the probe",
+            probe_timeout,
+        )
+        _force_cpu()
+        _decided = "cpu"
+        return _decided
+
+
+def reset_for_tests() -> None:
+    global _decided
+    with _lock:
+        _decided = None

@@ -83,7 +83,16 @@ def init_distributed(
             "process_id": process_id,
         }
     elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
-        spec = {}  # jax reads its own env
+        # jax reads its own env — initialize with no args; an empty spec
+        # must not fall through the single-process guard below.
+        jax.distributed.initialize()
+        _initialized = True
+        log.info(
+            "jax.distributed up from JAX env: process %d/%d",
+            jax.process_index(),
+            jax.process_count(),
+        )
+        return jax.process_count() > 1
     else:
         spec = slurm_process_env()
     if spec is None or (spec.get("num_processes") or 1) <= 1:

@@ -33,6 +33,7 @@ The round steps are plain functions over full arrays so the sharded kernel
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from functools import partial
 
@@ -41,6 +42,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from slurm_bridge_tpu.solver.snapshot import ClusterSnapshot, JobBatch, Placement
+
+log = logging.getLogger("sbt.auction")
 
 
 @dataclass(frozen=True)
@@ -76,8 +79,11 @@ class AuctionConfig:
     dtype: str = "float32"  # score matrix dtype ("bfloat16" halves HBM traffic)
     #: score/choose via the fused pallas kernel (ops/bid_argmax.py) instead
     #: of the jnp [P,N] form. None = auto: on for the TPU backend. The
-    #: kernel's integer jitter hash is bit-exact with the jnp path, so
-    #: flipping this does not change placements (at affinity_weight=0).
+    #: kernel's integer jitter hash is bit-exact with the jnp path, so at
+    #: ``dtype="float32"`` (the kernel's only dtype) flipping this does not
+    #: change placements (at affinity_weight=0). With ``dtype="bfloat16"``
+    #: the jnp path quantises bids differently, so the solve falls back to
+    #: jnp rather than silently ignoring the dtype.
     use_pallas: bool | None = None
 
 
@@ -373,9 +379,20 @@ def auction_place(
         )
     if incumbent is None:
         incumbent = np.full(batch.num_shards, -1, np.int32)
+    from slurm_bridge_tpu.parallel.backend import ensure_backend
+
+    backend = ensure_backend()  # hang-proof: broken TPU degrades to CPU
     use_pallas = cfg.use_pallas
     if use_pallas is None:  # auto: the fused kernel targets the TPU backend
-        use_pallas = jax.default_backend() == "tpu"
+        use_pallas = backend == "tpu"
+    if use_pallas and cfg.dtype != "float32":
+        # the pallas kernel is float32-only; honouring cfg.dtype beats the
+        # kernel, and the two would quantise bids differently anyway
+        log.warning(
+            "use_pallas with dtype=%r is unsupported — using the jnp path",
+            cfg.dtype,
+        )
+        use_pallas = False
     scale = resource_scale(snapshot)
     assign, free_after = _auction_kernel(
         jnp.asarray(snapshot.free),

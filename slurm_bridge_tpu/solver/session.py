@@ -70,11 +70,16 @@ class DeviceSolver:
     """
 
     def __init__(self, snapshot: ClusterSnapshot, config: AuctionConfig | None = None):
+        from slurm_bridge_tpu.parallel.backend import ensure_backend
+
+        backend = ensure_backend()  # hang-proof: broken TPU degrades to CPU
         self.config = config or AuctionConfig()
         self._use_pallas = self.config.use_pallas
         if self._use_pallas is None:
-            self._use_pallas = jax.default_backend() == "tpu"
-        self._interpret = self._use_pallas and jax.default_backend() != "tpu"
+            self._use_pallas = backend == "tpu"
+        if self._use_pallas and self.config.dtype != "float32":
+            self._use_pallas = False  # kernel is float32-only; honour dtype
+        self._interpret = self._use_pallas and backend != "tpu"
         self.update_snapshot(snapshot)
 
     def update_snapshot(self, snapshot: ClusterSnapshot) -> None:

@@ -3,10 +3,12 @@
 Reference parity: the vendored hpcloud/tail fork (pkg/tail, SURVEY.md
 §2.8): ``Config`` with Follow/ReOpen/Poll/MaxLineSize/RateLimiter
 (tail.go:56-72), truncation restart, reopen-on-rotation (``tail -F``),
-and the leaky-bucket rate limiter (ratelimiter/leakybucket.go:97). The
-reference watches via inotify with a polling fallback; this implementation
-polls outright (same cadence as its 250ms polling watcher, watch/polling.go)
-— the TPU rebuild has no native-watcher dependency to vendor.
+and the leaky-bucket rate limiter (ratelimiter/leakybucket.go:97). Like
+the reference's inotify watcher with polling fallback
+(watch/inotify.go:133, watch/polling.go:117), waiting for growth is
+event-driven through native inotify (:mod:`slurm_bridge_tpu.utils.inotify`)
+when the kernel provides it, with the 250ms polling cadence as fallback
+(``TailConfig.poll`` forces either mode, mirroring Config.Poll).
 """
 
 from __future__ import annotations
@@ -16,6 +18,8 @@ import os
 import threading
 import time
 from dataclasses import dataclass, field
+
+from slurm_bridge_tpu.utils import inotify as _inotify
 
 
 class LeakyBucket:
@@ -60,6 +64,9 @@ class TailConfig:
     max_line_size: int = 0       # 0 = unlimited; longer lines are split
     from_end: bool = False       # start at EOF (Location{0, io.SeekEnd})
     rate_limiter: LeakyBucket | None = None
+    #: Config.Poll equivalent: True forces mtime polling, False forces
+    #: inotify (raises where unavailable), None = auto (inotify on Linux).
+    poll: bool | None = None
 
 
 @dataclass
@@ -88,9 +95,56 @@ class Tail:
         self._fh: io.BufferedReader | None = None
         self._ino: int | None = None
         self._buf = b""
+        self._watch: _inotify.Inotify | None = None
+        if self.config.poll is True:
+            self._want_inotify = False
+        elif self.config.poll is False:
+            if not _inotify.available():
+                raise RuntimeError("inotify forced (poll=False) but unavailable")
+            self._want_inotify = True
+        else:
+            self._want_inotify = _inotify.available()
 
     def stop(self) -> None:
         self._stop.set()
+        if self._watch is not None:
+            self._watch.wake()
+
+    # -- change waiting ---------------------------------------------------
+    def _wait_for_change(self, timeout: float) -> bool:
+        """Block until the file plausibly changed, the timeout elapsed, or
+        stop was requested; returns True only for stop.
+
+        The inotify mode watches the parent DIRECTORY (the reference's
+        inotify_tracker does the same) so creation and rotation of the
+        target name wake the tail even while the file doesn't exist. Events
+        for other names in the directory are filtered out. The timeout is
+        kept as a safety net — a missed event costs one polling interval,
+        never correctness.
+        """
+        if self._want_inotify and self._watch is None:
+            try:
+                w = _inotify.Inotify()
+                w.add_watch(os.path.dirname(self.path) or ".")
+                self._watch = w
+            except OSError:
+                self._want_inotify = False  # dir gone/odd fs: poll instead
+        if self._watch is None:
+            return self._stop.wait(timeout)
+        base = os.path.basename(self.path)
+        deadline = time.monotonic() + timeout
+        while not self._stop.is_set():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            events = self._watch.wait(remaining)
+            if self._stop.is_set():
+                break
+            if not events:
+                break  # timeout — fall through to the regular re-check
+            if any(name in ("", base) for _mask, name in events):
+                break  # our file (or the dir itself) changed
+        return self._stop.is_set()
 
     # -- file lifecycle ---------------------------------------------------
     def _open(self, *, initial: bool) -> bool:
@@ -129,48 +183,56 @@ class Tail:
     def __iter__(self):
         cfg = self.config
         opened_before = False
-        while not self._stop.is_set():
-            if self._fh is None:
-                if not self._open(initial=not opened_before):
-                    if opened_before and not cfg.reopen:
-                        return  # our file was rotated away and reopen is off
-                    if not cfg.follow and not cfg.reopen:
-                        return
-                    # follow: block until the file appears (tail -f semantics)
-                    if self._stop.wait(cfg.poll_interval):
-                        return
-                    continue
-                opened_before = True
-            chunk = self._fh.read(65536)
-            if chunk:
-                self._buf += chunk
-                yield from self._drain_lines()
-                continue
-            # EOF. Truncation/rotation checks, then follow-or-finish.
-            if self._rotated():
-                if cfg.reopen:
-                    self._close()
-                    continue
-                # plain truncation with reopen off: restart from the top,
-                # like the reference's pure-truncate handling; drop any
-                # partial line buffered from the pre-truncation file
-                try:
-                    if os.stat(self.path).st_ino == self._ino:
-                        self._fh.seek(0)
-                        self._buf = b""
+        try:
+            while not self._stop.is_set():
+                if self._fh is None:
+                    if not self._open(initial=not opened_before):
+                        if opened_before and not cfg.reopen:
+                            return  # our file was rotated away, reopen off
+                        if not cfg.follow and not cfg.reopen:
+                            return
+                        # follow: block until the file appears (tail -f)
+                        if self._wait_for_change(cfg.poll_interval):
+                            return
                         continue
-                except OSError:
-                    pass
-                break
-            if not cfg.follow:
-                break
-            if self._stop.wait(cfg.poll_interval):
-                break
-        # emit any unterminated final line
-        if self._buf:
-            yield from self._emit(self._buf)
-            self._buf = b""
-        self._close()
+                    opened_before = True
+                chunk = self._fh.read(65536)
+                if chunk:
+                    self._buf += chunk
+                    yield from self._drain_lines()
+                    continue
+                # EOF. Truncation/rotation checks, then follow-or-finish.
+                if self._rotated():
+                    if cfg.reopen:
+                        self._close()
+                        continue
+                    # plain truncation with reopen off: restart from the top,
+                    # like the reference's pure-truncate handling; drop any
+                    # partial line buffered from the pre-truncation file
+                    try:
+                        if os.stat(self.path).st_ino == self._ino:
+                            self._fh.seek(0)
+                            self._buf = b""
+                            continue
+                    except OSError:
+                        pass
+                    break
+                if not cfg.follow:
+                    break
+                if self._wait_for_change(cfg.poll_interval):
+                    break
+            # emit any unterminated final line
+            if self._buf:
+                yield from self._emit(self._buf)
+                self._buf = b""
+        finally:
+            self._close()
+            self._close_watch()
+
+    def _close_watch(self) -> None:
+        if self._watch is not None:
+            self._watch.close()
+            self._watch = None
 
     def _drain_lines(self):
         while True:

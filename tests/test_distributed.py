@@ -35,6 +35,21 @@ def test_init_single_process_noop(monkeypatch):
     assert dist.init_distributed() is False  # idempotent
 
 
+def test_jax_coordinator_env_calls_initialize(monkeypatch):
+    """ADVICE r1: with JAX_COORDINATOR_ADDRESS set, init must call
+    jax.distributed.initialize() directly — an empty spec routed through
+    the single-process guard silently skipped initialization."""
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.delenv("SLURM_PROCID", raising=False)
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "localhost:12345")
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize", lambda: calls.append(()))
+    dist.init_distributed()
+    assert calls == [()]
+    dist.init_distributed()  # idempotent: no second initialize
+    assert calls == [()]
+
+
 def test_hybrid_mesh_single_process():
     mesh = dist.hybrid_solver_mesh()
     assert mesh.axis_names == ("dp", "mp")

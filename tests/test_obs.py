@@ -93,6 +93,84 @@ class TestTracer:
         with pytest.raises(ValueError, match="unknown trace exporter"):
             make_exporter("jaeger-but-wrong")
 
+    def test_otlp_exporter_ships_decodable_spans(self):
+        """Wire-format export: spans must leave the process as OTLP/HTTP
+        JSON a real collector could ingest (the reference exports to
+        Jaeger, tracing_register_jaeger.go:29-52)."""
+        import http.server
+
+        from slurm_bridge_tpu.obs.otlp import OtlpHttpExporter
+
+        bodies: list[bytes] = []
+
+        class _Collector(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                assert self.path == "/v1/traces"
+                assert self.headers["Content-Type"] == "application/json"
+                bodies.append(self.rfile.read(int(self.headers["Content-Length"])))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), _Collector)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            exporter = OtlpHttpExporter(
+                f"http://127.0.0.1:{srv.server_port}",
+                service="sbt-test",
+                flush_interval=60.0,  # flush manually
+            )
+            tracer = Tracer("sbt-test").add_exporter(exporter)
+            with tracer.span("root", pod="p1") as root:
+                root.annotate("submitted")
+                with tracer.span("child"):
+                    pass
+            exporter.flush()
+            assert exporter.sent == 2 and exporter.dropped == 0
+        finally:
+            srv.shutdown()
+
+        payload = json.loads(b"".join(bodies))
+        rs = payload["resourceSpans"][0]
+        svc = {a["key"]: a["value"]["stringValue"]
+               for a in rs["resource"]["attributes"]}
+        assert svc["service.name"] == "sbt-test"
+        spans = {s["name"]: s for s in rs["scopeSpans"][0]["spans"]}
+        assert set(spans) == {"root", "child"}
+        assert len(spans["root"]["traceId"]) == 32
+        assert len(spans["root"]["spanId"]) == 16
+        assert spans["child"]["parentSpanId"] == spans["root"]["spanId"]
+        assert spans["child"]["traceId"] == spans["root"]["traceId"]
+        attrs = {a["key"]: a["value"]["stringValue"]
+                 for a in spans["root"]["attributes"]}
+        assert attrs["pod"] == "p1"
+        assert spans["root"]["events"][0]["name"] == "submitted"
+        assert int(spans["root"]["endTimeUnixNano"]) >= int(
+            spans["root"]["startTimeUnixNano"]
+        )
+
+    def test_otlp_survives_dead_collector(self):
+        from slurm_bridge_tpu.obs.otlp import OtlpHttpExporter
+
+        exporter = OtlpHttpExporter(
+            "http://127.0.0.1:1", service="x", flush_interval=60.0, timeout=0.3
+        )
+        tracer = Tracer("x").add_exporter(exporter)
+        with tracer.span("doomed"):
+            pass
+        exporter.flush()  # must not raise
+        assert exporter.dropped == 1 and exporter.sent == 0
+        exporter.close()
+
+    def test_otlp_in_registry(self):
+        from slurm_bridge_tpu.obs.otlp import OtlpHttpExporter
+
+        e = make_exporter("otlp", endpoint="http://127.0.0.1:1", timeout=0.1)
+        assert isinstance(e, OtlpHttpExporter)
+        e.close()
+
     def test_tracez_renders_stats(self):
         tracer = Tracer("svc")
         for _ in range(3):

@@ -162,6 +162,24 @@ def test_auction_deterministic():
     assert np.array_equal(a1.node_of, a2.node_of)
 
 
+def test_pallas_bf16_falls_back_to_jnp(caplog):
+    """use_pallas + dtype="bfloat16" is unsupported (the kernel is
+    float32-only); the solve must fall back to the jnp path, not silently
+    ignore the dtype (ADVICE r1)."""
+    import logging
+
+    snap, batch = random_scenario(16, 48, seed=1, load=0.5)
+    with caplog.at_level(logging.WARNING, logger="sbt.auction"):
+        a = auction_place(
+            snap, batch, AuctionConfig(rounds=4, dtype="bfloat16", use_pallas=True)
+        )
+    assert any("unsupported" in r.message for r in caplog.records)
+    b = auction_place(
+        snap, batch, AuctionConfig(rounds=4, dtype="bfloat16", use_pallas=False)
+    )
+    assert np.array_equal(a.node_of, b.node_of)
+
+
 def test_auction_empty_batch():
     snap, _ = random_scenario(8, 10, seed=0)
     from slurm_bridge_tpu.solver.snapshot import JobBatch

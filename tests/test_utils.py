@@ -182,16 +182,28 @@ class TestFsWatcher:
         assert not fs.exists(d)
 
 
+def _watch_modes():
+    """Both watcher backends, mirroring the reference's inotify/poll pair
+    (watch/inotify.go:133, watch/polling.go:117)."""
+    from slurm_bridge_tpu.utils import inotify as ino
+
+    modes = [pytest.param(True, id="poll")]
+    if ino.available():
+        modes.append(pytest.param(False, id="inotify"))
+    return modes
+
+
 class TestTail:
     def test_finite_read(self, tmp_path):
         p = tmp_path / "log"
         p.write_text("one\ntwo\nthree")
         assert list(tail_lines(str(p))) == ["one", "two", "three"]
 
-    def test_follow_sees_appends(self, tmp_path):
+    @pytest.mark.parametrize("poll", _watch_modes())
+    def test_follow_sees_appends(self, tmp_path, poll):
         p = tmp_path / "log"
         p.write_text("first\n")
-        tail = Tail(str(p), TailConfig(follow=True, poll_interval=0.02))
+        tail = Tail(str(p), TailConfig(follow=True, poll_interval=0.02, poll=poll))
         got = []
 
         def consume():
@@ -208,10 +220,11 @@ class TestTail:
         t.join(5)
         assert got == ["first", "second", "last"]
 
-    def test_truncation_restarts_from_top(self, tmp_path):
+    @pytest.mark.parametrize("poll", _watch_modes())
+    def test_truncation_restarts_from_top(self, tmp_path, poll):
         p = tmp_path / "log"
         p.write_text("aaaa\nbbbb\n")
-        tail = Tail(str(p), TailConfig(follow=True, poll_interval=0.02))
+        tail = Tail(str(p), TailConfig(follow=True, poll_interval=0.02, poll=poll))
         got = []
 
         def consume():
@@ -227,10 +240,14 @@ class TestTail:
         t.join(5)
         assert got == ["aaaa", "bbbb", "new"]
 
-    def test_reopen_follows_rotation(self, tmp_path):
+    @pytest.mark.parametrize("poll", _watch_modes())
+    def test_reopen_follows_rotation(self, tmp_path, poll):
         p = tmp_path / "log"
         p.write_text("before\n")
-        tail = Tail(str(p), TailConfig(follow=True, reopen=True, poll_interval=0.02))
+        tail = Tail(
+            str(p),
+            TailConfig(follow=True, reopen=True, poll_interval=0.02, poll=poll),
+        )
         got = []
 
         def consume():
@@ -247,6 +264,49 @@ class TestTail:
         p.write_text("after\n")  # new file at same path
         t.join(5)
         assert got == ["before", "after"]
+
+    def test_inotify_wakes_without_polling(self, tmp_path):
+        """The inotify path must see an append well inside one (huge)
+        polling interval — proving waits are event-driven, not timed."""
+        from slurm_bridge_tpu.utils import inotify as ino
+
+        if not ino.available():
+            pytest.skip("inotify unavailable")
+        p = tmp_path / "log"
+        p.write_text("")
+        tail = Tail(str(p), TailConfig(follow=True, poll_interval=30.0, poll=False))
+        got = []
+
+        def consume():
+            for line in tail:
+                got.append(line.text)
+                tail.stop()
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.2)
+        t0 = time.monotonic()
+        with open(p, "a") as f:
+            f.write("ping\n")
+        t.join(5)
+        elapsed = time.monotonic() - t0
+        assert got == ["ping"]
+        assert elapsed < 5.0, f"append took {elapsed:.1f}s to surface"
+
+    def test_stop_interrupts_inotify_wait(self, tmp_path):
+        from slurm_bridge_tpu.utils import inotify as ino
+
+        if not ino.available():
+            pytest.skip("inotify unavailable")
+        p = tmp_path / "log"
+        p.write_text("x\n")
+        tail = Tail(str(p), TailConfig(follow=True, poll_interval=30.0, poll=False))
+        t = threading.Thread(target=lambda: list(tail))
+        t.start()
+        time.sleep(0.2)
+        tail.stop()
+        t.join(3)
+        assert not t.is_alive(), "stop() did not wake the inotify wait"
 
     def test_max_line_size_splits(self, tmp_path):
         p = tmp_path / "log"
