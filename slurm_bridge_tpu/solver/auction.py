@@ -76,6 +76,19 @@ class AuctionConfig:
     #: shards at every load we measured (spread beats packing for raw
     #: placement count); >0 buys tighter packing at ~1% fewer placements.
     affinity_weight: float = 0.0
+    #: candidate-sampling ("power of K choices"): instead of a full [P, N]
+    #: argmax per round, each shard bids on K hash-sampled nodes from its
+    #: own partition — O(P·K) work instead of O(P·N). Because the bid is
+    #: jitter-dominated (see ``jitter``), the full argmax is already an
+    #: (essentially) uniform draw over feasible nodes, so sampling K≈64
+    #: candidates loses almost no placement quality while cutting per-round
+    #: cost ~N/K× — the difference between a 50 s and a sub-second solve on
+    #: a single CPU core at 50k×10k. A shard whose K draws all miss simply
+    #: retries next round under a fresh salt.
+    #: ``None`` = auto (full argmax on TPU where the MXU/pallas path wins;
+    #: sampled K=64 elsewhere once P·N ≥ 2**25); ``0`` = force full;
+    #: ``K>0`` = force sampled with K candidates.
+    candidates: int | None = None
     dtype: str = "float32"  # score matrix dtype ("bfloat16" halves HBM traffic)
     #: score/choose via the fused pallas kernel (ops/bid_argmax.py) instead
     #: of the jnp [P,N] form. None = auto: on for the TPU backend. The
@@ -85,6 +98,29 @@ class AuctionConfig:
     #: the jnp path quantises bids differently, so the solve falls back to
     #: jnp rather than silently ignoring the dtype.
     use_pallas: bool | None = None
+
+
+def _mix(pi: jnp.ndarray, ni: jnp.ndarray, salt: jnp.ndarray) -> jnp.ndarray:
+    """Murmur-style avalanche of (row, col, salt) uint32 streams — the one
+    hash underlying both the bid jitter and the candidate draws, so the
+    sampled path scores a candidate with bit-exactly the bid the full
+    [P, N] path would have given that same (shard, node, round)."""
+    h = (
+        pi * jnp.uint32(0x9E3779B1)
+        ^ ni * jnp.uint32(0x85EBCA77)
+        ^ salt * jnp.uint32(0xC2B2AE3D)
+    )
+    h ^= h >> 16
+    h *= jnp.uint32(0x85EBCA6B)
+    h ^= h >> 13
+    h *= jnp.uint32(0xC2B2AE35)
+    h ^= h >> 16
+    return h
+
+
+def _unit(h: jnp.ndarray, dtype) -> jnp.ndarray:
+    """uint32 hash → [0, 1): top 24 bits, exactly representable in f32."""
+    return ((h >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))).astype(dtype)
 
 
 def hash_jitter(p: int, n: int, salt, dtype, *, p_off=0, n_off=0) -> jnp.ndarray:
@@ -111,18 +147,7 @@ def hash_jitter(p: int, n: int, salt, dtype, *, p_off=0, n_off=0) -> jnp.ndarray
         n_off, jnp.int32
     ).astype(jnp.uint32)
     s = jnp.asarray(salt, jnp.int32).astype(jnp.uint32)
-    h = (
-        pi * jnp.uint32(0x9E3779B1)
-        ^ ni * jnp.uint32(0x85EBCA77)
-        ^ s * jnp.uint32(0xC2B2AE3D)
-    )
-    h ^= h >> 16
-    h *= jnp.uint32(0x85EBCA6B)
-    h ^= h >> 13
-    h *= jnp.uint32(0xC2B2AE35)
-    h ^= h >> 16
-    # top 24 bits → [0, 1): every value exactly representable in float32
-    return ((h >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))).astype(dtype)
+    return _unit(_mix(pi, ni, s), dtype)
 
 
 def segmented_cumsum(values: jnp.ndarray, segment_change: jnp.ndarray) -> jnp.ndarray:
@@ -228,6 +253,7 @@ def multi_mask(gang: jnp.ndarray, p: int) -> jnp.ndarray:
     static_argnames=(
         "rounds", "num_nodes", "eta", "jitter", "affinity_weight", "dtype",
         "use_pallas", "interpret", "gang_salvage_rounds", "gang_first",
+        "candidates",
     ),
 )
 def _auction_kernel(
@@ -241,6 +267,9 @@ def _auction_kernel(
     gang,  # [P] i32 (values < P)
     scale,  # [R] f32 resource normalisers
     incumbent,  # [P] i32 node currently held (-1 = free agent)
+    part_order,  # [N] i32 node indices grouped by partition (sampled mode)
+    samp_start,  # [P] i32 shard's slice start into part_order (sampled mode)
+    samp_count,  # [P] i32 shard's slice length (sampled mode; 0 = no nodes)
     *,
     rounds: int,
     num_nodes: int,
@@ -253,24 +282,28 @@ def _auction_kernel(
     interpret: bool = False,
     gang_salvage_rounds: int = AuctionConfig.gang_salvage_rounds,
     gang_first: bool = AuctionConfig.gang_first,
+    candidates: int = 0,
 ):
     p = dem.shape[0]
     n = num_nodes
     neg_inf = jnp.float32(-jnp.inf)
 
     dem_n = (dem * scale).astype(dtype)  # [P, R] normalised demand
-    # static (p, n) masks — partition + feature feasibility never changes
-    part_ok = (job_part[:, None] == node_part[None, :]) | (job_part[:, None] < 0)
-    feat_ok = (node_feat[None, :] & req_feat[:, None]) == req_feat[:, None]
-    static_ok = part_ok & feat_ok  # [P, N] bool
     # Streaming reschedule (BASELINE config #5): an incumbent shard — one
     # already running on a node — may only bid on the node it holds (Slurm
     # jobs cannot migrate). ``free0`` is expected to have ALL modeled usage
     # released, so incumbents re-admit against everyone else priority-ordered:
     # keep-vs-preempt falls out of the ordinary admission step.
     inc = incumbent >= 0
-    own = jax.lax.broadcasted_iota(jnp.int32, (p, n), 1) == incumbent[:, None]
-    static_ok = jnp.where(inc[:, None], own & static_ok, static_ok)
+    if candidates == 0:
+        # static (p, n) masks — partition + feature feasibility never
+        # changes (the sampled path checks per-candidate instead and never
+        # materialises anything [P, N]-shaped)
+        part_ok = (job_part[:, None] == node_part[None, :]) | (job_part[:, None] < 0)
+        feat_ok = (node_feat[None, :] & req_feat[:, None]) == req_feat[:, None]
+        static_ok = part_ok & feat_ok  # [P, N] bool
+        own = jax.lax.broadcasted_iota(jnp.int32, (p, n), 1) == incumbent[:, None]
+        static_ok = jnp.where(inc[:, None], own & static_ok, static_ok)
     multi = multi_mask(gang, p)
     # admission-ordering priority; only the kernel sees the gang-first boost
     prio_eff = prio + multi.astype(jnp.float32) * (1e4 if gang_first else 0.0)
@@ -284,7 +317,46 @@ def _auction_kernel(
         assign = jnp.where(rnd >= salvage_start, gang_revoke(assign, gang, p), assign)
         free = free0 - used_capacity(dem, assign, n)
 
-        if use_pallas:
+        if candidates > 0:
+            # power-of-K-choices: each shard draws K candidate nodes from
+            # its (partition, feature) slice of ``part_order`` and bids only
+            # on those. At affinity_weight=0 a candidate's bid (jitter −
+            # price) is bit-identical to what the full [P, N] path scores
+            # for the same (shard, node, round), so sampling changes only
+            # which nodes get *looked at*; with affinity_weight ≠ 0 the
+            # affinity term is summed in a different association order and
+            # near-ties may resolve differently.
+            kk = candidates
+            pi = jax.lax.broadcasted_iota(jnp.uint32, (p, kk), 0)
+            ki = jax.lax.broadcasted_iota(jnp.uint32, (p, kk), 1)
+            salt = jnp.asarray(rnd, jnp.int32).astype(jnp.uint32)
+            # independent stream from the bid jitter (different salt mix)
+            draw = _mix(pi, ki, salt * jnp.uint32(0x68E31DA4) + jnp.uint32(0x1B56C4E9))
+            cnt = jnp.maximum(samp_count, 1).astype(jnp.uint32)
+            idx = samp_start[:, None] + (draw % cnt[:, None]).astype(jnp.int32)
+            pool_hi = part_order.shape[0] - 1  # pool is longer than N
+            cand = part_order[jnp.clip(idx, 0, pool_hi)]  # [P, K] node ids
+            cand = jnp.where(inc[:, None], incumbent[:, None], cand)
+            has_cand = (samp_count > 0) | inc  # [P]
+            part_ok_k = (job_part[:, None] == node_part[cand]) | (
+                job_part[:, None] < 0
+            )
+            feat_ok_k = (node_feat[cand] & req_feat[:, None]) == req_feat[:, None]
+            freec = free[cand]  # [P, K, R] gather
+            cap_ok_k = jnp.all(dem[:, None, :] <= freec + 1e-6, axis=-1)
+            feas = has_cand[:, None] & part_ok_k & feat_ok_k & cap_ok_k
+            jit_k = _unit(
+                _mix(pi, cand.astype(jnp.uint32), salt), dtype
+            ) * jnp.asarray(jitter, dtype)
+            bid = jit_k - price[cand].astype(dtype)
+            if affinity_weight:
+                aff = -(dem_n[:, None, :] * (freec * scale).astype(dtype)).sum(-1)
+                bid = bid + jnp.asarray(affinity_weight, dtype) * aff
+            bid = jnp.where(feas, bid, neg_inf)
+            kbest = jnp.argmax(bid, axis=1)
+            choice = jnp.take_along_axis(cand, kbest[:, None], axis=1)[:, 0]
+            best = jnp.take_along_axis(bid, kbest[:, None], axis=1)[:, 0]
+        elif use_pallas:
             # fused tile-streaming kernel: no [P, N] intermediates in HBM
             from slurm_bridge_tpu.ops.bid_argmax import bid_argmax
 
@@ -335,6 +407,120 @@ def _auction_kernel(
     return assign, free0 - used_capacity(dem, assign, n)
 
 
+#: P·N work above which the non-TPU auto path switches to candidate
+#: sampling (~33M score entries ≈ the point where full-matrix rounds stop
+#: fitting in cache and a single CPU core falls behind the greedy packer).
+SAMPLING_MIN_WORK = 1 << 25
+
+
+def resolve_candidates(config: AuctionConfig, backend: str, p: int, n: int) -> int:
+    """Resolve ``AuctionConfig.candidates`` (None = auto) to a concrete K.
+
+    An explicit ``use_pallas=True`` wins over auto-sampling (the caller is
+    validating the fused kernel; silently running the sampled jnp path
+    instead would fake that validation)."""
+    if config.candidates is not None:
+        return max(0, int(config.candidates))
+    if config.use_pallas:
+        return 0
+    if backend != "tpu" and p * n >= SAMPLING_MIN_WORK:
+        return 64
+    return 0
+
+
+class CandidatePools:
+    """Per-snapshot candidate pools for the sampled path.
+
+    The sampled path draws each shard's K candidates from a contiguous
+    slice of one flat int32 array, so *what the slice contains* decides
+    placement quality. Uniform whole-cluster sampling would essentially
+    never find a 4-node partition inside a 10k-node cluster — and
+    partition-only slicing has the same cliff for rare feature bits (4
+    h100 nodes inside a 10k-node partition). So slices are conditioned on
+    everything cheap to condition on:
+
+    - shards with no feature requirement draw from their partition's slice
+      of the base order (``job_part < 0`` ⇒ the whole cluster);
+    - shards requiring feature bits draw from a (partition, bit) pool —
+      nodes of that partition carrying the shard's lowest required bit —
+      built lazily per distinct combo and appended to the flat array.
+      Remaining bits of a multi-bit mask are still checked in-kernel, so
+      pools narrow the draw, never widen feasibility.
+
+    The flat array grows only when a never-seen (partition, bit) combo
+    appears; its length is padded to a multiple of N so XLA recompiles at
+    most a handful of times over a stream of ticks.
+    """
+
+    def __init__(self, snapshot: ClusterSnapshot):
+        self.n = snapshot.num_nodes
+        self._node_part = snapshot.partition_of
+        self._node_feat = snapshot.features
+        order = np.argsort(snapshot.partition_of, kind="stable").astype(np.int32)
+        self._sorted_parts = snapshot.partition_of[order]
+        self._concat = order  # base order occupies [0, N)
+        self._offsets: dict[tuple[int, int], tuple[int, int]] = {}
+        #: bumped whenever ``array`` content/length changes (device restage)
+        self.version = 0
+        self._padded: np.ndarray | None = None
+
+    @property
+    def array(self) -> np.ndarray:
+        """The flat pool array, zero-padded to a multiple of N."""
+        if self._padded is None:
+            n = max(1, self.n)
+            total = ((len(self._concat) + n - 1) // n) * n
+            self._padded = np.zeros(total, np.int32)
+            self._padded[: len(self._concat)] = self._concat
+        return self._padded
+
+    def _feature_pool(self, pc: int, bit: int) -> tuple[int, int]:
+        """(start, count) of the pool for partition ``pc`` (−1 = any) and
+        feature ``bit`` — built and appended on first use."""
+        key = (pc, bit)
+        hit = self._offsets.get(key)
+        if hit is not None:
+            return hit
+        mask = (self._node_feat >> np.uint32(bit)) & np.uint32(1) == 1
+        if pc >= 0:
+            mask &= self._node_part == pc
+        ids = np.nonzero(mask)[0].astype(np.int32)
+        off = (len(self._concat), len(ids))
+        self._concat = np.concatenate([self._concat, ids])
+        self._offsets[key] = off
+        self._padded = None
+        self.version += 1
+        return off
+
+    def slices(self, batch: JobBatch) -> tuple[np.ndarray, np.ndarray]:
+        """Per-shard (start, count) into :attr:`array`.
+
+        A shard whose slice is empty (unknown partition, PAD_PARTITION,
+        required bit carried by no node, reserved bit 31) can never place —
+        the same verdict the full path's masks reach."""
+        jp = batch.partition_of
+        start = np.searchsorted(self._sorted_parts, jp, side="left")
+        end = np.searchsorted(self._sorted_parts, jp, side="right")
+        anyp = jp < 0
+        start = np.where(anyp, 0, start).astype(np.int32)
+        count = np.where(anyp, self.n, end - start).astype(np.int32)
+        req = batch.req_features
+        sel = np.nonzero(req != 0)[0]
+        if sel.size:
+            m = req[sel].astype(np.int64)
+            impossible = (m >> 31) != 0  # reserved sentinel: unplaceable
+            low = (m & -m).astype(np.float64)
+            bits = np.where(impossible, 0, np.log2(low).astype(np.int64))
+            combos = jp[sel].astype(np.int64) * 64 + bits  # distinct pairs
+            uniq, inverse = np.unique(combos, return_inverse=True)
+            table = np.empty((len(uniq), 2), np.int64)
+            for i, c in enumerate(uniq):
+                table[i] = self._feature_pool(int(c // 64), int(c % 64))
+            start[sel] = table[inverse, 0]
+            count[sel] = np.where(impossible, 0, table[inverse, 1])
+        return start, count
+
+
 def resource_scale(snapshot: ClusterSnapshot) -> np.ndarray:
     """Per-resource normaliser: 1 / mean per-node capacity.
 
@@ -382,7 +568,8 @@ def auction_place(
     from slurm_bridge_tpu.parallel.backend import ensure_backend
 
     backend = ensure_backend()  # hang-proof: broken TPU degrades to CPU
-    use_pallas = cfg.use_pallas
+    k = resolve_candidates(cfg, backend, batch.num_shards, snapshot.num_nodes)
+    use_pallas = cfg.use_pallas if k == 0 else False
     if use_pallas is None:  # auto: the fused kernel targets the TPU backend
         use_pallas = backend == "tpu"
     if use_pallas and cfg.dtype != "float32":
@@ -394,6 +581,14 @@ def auction_place(
         )
         use_pallas = False
     scale = resource_scale(snapshot)
+    if k > 0:
+        pools = CandidatePools(snapshot)
+        samp_start, samp_count = pools.slices(batch)
+        order = pools.array
+    else:  # unused by the full path — 1-element placeholders
+        order = np.zeros(1, np.int32)
+        samp_start = np.zeros(1, np.int32)
+        samp_count = np.zeros(1, np.int32)
     assign, free_after = _auction_kernel(
         jnp.asarray(snapshot.free),
         jnp.asarray(snapshot.partition_of),
@@ -405,6 +600,9 @@ def auction_place(
         jnp.asarray(normalize_gangs(batch.gang_id)),
         jnp.asarray(scale),
         jnp.asarray(incumbent, dtype=jnp.int32),
+        jnp.asarray(order),
+        jnp.asarray(samp_start),
+        jnp.asarray(samp_count),
         rounds=cfg.rounds,
         num_nodes=snapshot.num_nodes,
         eta=cfg.eta,
@@ -415,6 +613,7 @@ def auction_place(
         interpret=use_pallas and jax.default_backend() != "tpu",
         gang_salvage_rounds=cfg.gang_salvage_rounds,
         gang_first=cfg.gang_first,
+        candidates=k,
     )
     assign_np = np.asarray(assign)
     return Placement(

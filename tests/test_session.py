@@ -58,3 +58,25 @@ def test_empty_batch():
     p = DeviceSolver(snap, CFG).solve(empty)
     assert p.node_of.size == 0
     np.testing.assert_array_equal(p.free_after, snap.free)
+
+
+def test_update_snapshot_preserves_pools_when_only_free_changes():
+    """free/capacity change every tick; the candidate pools depend only on
+    the inventory shape and must survive (code-review r3 finding)."""
+    from slurm_bridge_tpu.solver.auction import AuctionConfig
+    from slurm_bridge_tpu.solver.session import DeviceSolver
+    from slurm_bridge_tpu.solver.snapshot import random_scenario
+
+    snap, batch = random_scenario(64, 200, seed=4, gpu_fraction=0.2)
+    solver = DeviceSolver(snap, AuctionConfig(rounds=4, candidates=8))
+    solver.solve(batch)  # builds pools lazily
+    pools = solver._pools
+    assert pools is not None
+    snap2 = random_scenario(64, 200, seed=4, gpu_fraction=0.2)[0]
+    snap2.free = snap2.free * 0.5  # capacity churn only
+    solver.update_snapshot(snap2)
+    assert solver._pools is pools  # preserved
+    snap3 = random_scenario(64, 200, seed=5, gpu_fraction=0.2)[0]
+    snap3.partition_of = (snap3.partition_of + 1) % 4  # inventory changed
+    solver.update_snapshot(snap3)
+    assert solver._pools is None  # invalidated

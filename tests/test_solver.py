@@ -369,3 +369,130 @@ def test_segmented_cumsum_precision():
     out = np.asarray(segmented_cumsum(jnp.asarray(vals), jnp.asarray(seg)))
     assert out[-2, 0] == 20_000.0
     assert out[-1, 0] == 40_000.0
+
+
+# ------------------------------------------------- candidate sampling (CPU)
+
+
+@pytest.mark.parametrize("seed", [1, 5])
+def test_sampled_auction_feasible(seed):
+    """The power-of-K-choices path obeys every constraint the full path does."""
+    snap, batch = random_scenario(64, 400, seed=seed, load=0.6,
+                                  gpu_fraction=0.2, gang_fraction=0.1)
+    pl = auction_place(snap, batch, AuctionConfig(rounds=12, candidates=16))
+    _check_feasible(snap, batch, pl)
+
+
+def test_sampled_auction_quality_parity():
+    """Sampling K=64 of 512 nodes must land within 3% of the full argmax —
+    the bid is jitter-dominated, so the full argmax is itself an essentially
+    uniform draw over feasible nodes (see AuctionConfig.candidates)."""
+    snap, batch = random_scenario(512, 3000, seed=11, load=0.7,
+                                  gpu_fraction=0.15, gang_fraction=0.05)
+    full = auction_place(snap, batch, AuctionConfig(rounds=12, candidates=0))
+    samp = auction_place(snap, batch, AuctionConfig(rounds=12, candidates=64))
+    _check_feasible(snap, batch, samp)
+    assert _placed_count(samp) >= 0.97 * _placed_count(full), (
+        f"sampled {_placed_count(samp)} vs full {_placed_count(full)}"
+    )
+
+
+def test_sampled_auction_deterministic():
+    snap, batch = random_scenario(64, 300, seed=9, gang_fraction=0.1)
+    cfg = AuctionConfig(candidates=8)
+    a1 = auction_place(snap, batch, cfg)
+    a2 = auction_place(snap, batch, cfg)
+    assert np.array_equal(a1.node_of, a2.node_of)
+
+
+def test_sampled_auction_finds_tiny_partition():
+    """Partition-sliced sampling must find a 4-node partition inside a big
+    cluster (uniform whole-cluster sampling essentially never would)."""
+    nodes = [
+        NodeInfo(name=f"n{i}", cpus=16, memory_mb=32768) for i in range(512)
+    ]
+    parts = [
+        PartitionInfo(name="big", nodes=[f"n{i}" for i in range(4, 512)]),
+        PartitionInfo(name="tiny", nodes=["n0", "n1", "n2", "n3"]),
+    ]
+    snap = encode_cluster(nodes, parts)
+    demands = [JobDemand(partition="tiny", cpus_per_task=1) for _ in range(8)]
+    batch = encode_jobs(demands, snap)
+    pl = auction_place(snap, batch, AuctionConfig(rounds=4, candidates=8))
+    assert pl.placed.all()
+    tiny_code = snap.partition_codes["tiny"]
+    assert all(snap.partition_of[nd] == tiny_code for nd in pl.node_of)
+
+
+def test_sampled_auction_incumbent_pinned():
+    """Incumbents bid only on the node they hold, sampled mode included."""
+    snap, batch = random_scenario(32, 40, seed=2, load=0.3)
+    incumbent = np.full(batch.num_shards, -1, np.int32)
+    # pin the first 5 shards to nodes that satisfy their partition
+    for s in range(5):
+        jp = batch.partition_of[s]
+        nd = int(np.nonzero(snap.partition_of == jp)[0][0])
+        incumbent[s] = nd
+    pl = auction_place(
+        snap, batch, AuctionConfig(rounds=8, candidates=8), incumbent=incumbent
+    )
+    for s in range(5):
+        assert pl.node_of[s] in (incumbent[s], -1)
+
+
+def test_resolve_candidates_auto():
+    from slurm_bridge_tpu.solver.auction import resolve_candidates
+
+    cfg = AuctionConfig()
+    assert resolve_candidates(cfg, "tpu", 50_000, 10_000) == 0
+    assert resolve_candidates(cfg, "cpu", 50_000, 10_000) == 64
+    assert resolve_candidates(cfg, "cpu", 100, 64) == 0  # small: full path
+    assert resolve_candidates(AuctionConfig(candidates=0), "cpu", 50_000, 10_000) == 0
+    assert resolve_candidates(AuctionConfig(candidates=32), "tpu", 100, 64) == 32
+
+
+def test_sampled_auction_finds_rare_feature_nodes():
+    """Feature-conditioned pools: jobs requiring a bit carried by 4 of 2048
+    nodes must still place under sampling (partition-only slicing would
+    draw a feasible candidate with prob ~1-(1-4/2048)^K per round and
+    routinely strand them)."""
+    nodes = [
+        NodeInfo(name=f"n{i}", cpus=16, memory_mb=32768,
+                 gpus=4 if i < 4 else 0,
+                 features=("h100",) if i < 4 else ())
+        for i in range(2048)
+    ]
+    parts = [PartitionInfo(name="all", nodes=[n.name for n in nodes])]
+    snap = encode_cluster(nodes, parts)
+    demands = [
+        JobDemand(partition="all", cpus_per_task=1, gres="gpu:h100:1")
+        for _ in range(4)
+    ] + [JobDemand(partition="all", cpus_per_task=1) for _ in range(64)]
+    batch = encode_jobs(demands, snap)
+    pl = auction_place(snap, batch, AuctionConfig(rounds=4, candidates=8))
+    assert pl.placed.all()
+    for s in range(4):  # the gres jobs landed on feature nodes
+        assert pl.node_of[s] < 4
+
+
+def test_candidate_pools_grow_and_restage():
+    """New (partition, bit) combos append to the flat pool and bump the
+    version; repeated combos reuse the cached slice."""
+    from slurm_bridge_tpu.solver.auction import CandidatePools
+
+    nodes = [
+        NodeInfo(name=f"n{i}", cpus=8, memory_mb=8192,
+                 features=("a100",) if i % 2 else ("h100",))
+        for i in range(32)
+    ]
+    parts = [PartitionInfo(name="all", nodes=[n.name for n in nodes])]
+    snap = encode_cluster(nodes, parts)
+    pools = CandidatePools(snap)
+    v0 = pools.version
+    b1 = encode_jobs([JobDemand(partition="all", gres="gpu:h100:1")], snap)
+    s1, c1 = pools.slices(b1)
+    assert pools.version > v0 and c1[0] == 16
+    v1 = pools.version
+    s2, c2 = pools.slices(b1)  # same combo: cached, no growth
+    assert pools.version == v1 and s2[0] == s1[0] and c2[0] == 16
+    assert len(pools.array) % snap.num_nodes == 0  # padded to a multiple of N
