@@ -31,6 +31,10 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description="slurm-bridge-tpu control plane")
     parser.add_argument("--endpoint", required=True, help="agent endpoint (host:port or *.sock)")
     parser.add_argument("--scheduler", default="auction", choices=["auction", "greedy"])
+    parser.add_argument("--scheduler-endpoint", default="",
+                        help="PlacementSolver sidecar endpoint (host:port or "
+                             "*.sock); empty = solve in-process (SURVEY §7: "
+                             "the solver as a gRPC sidecar)")
     parser.add_argument("--preemption", action="store_true",
                         help="let higher-priority pending jobs displace "
                              "lower-priority submitted ones (auction only)")
@@ -71,6 +75,7 @@ def main(argv: list[str] | None = None) -> int:
     bridge = Bridge(
         args.endpoint,
         scheduler_backend=args.scheduler,
+        solver_endpoint=args.scheduler_endpoint,
         preemption=args.preemption,
         state_file=args.state_file,
         configurator_interval=args.configurator_interval,

@@ -47,6 +47,8 @@ class Bridge:
         scheduler_backend: str = "auction",
         auction_config: AuctionConfig | None = None,
         preemption: bool = False,
+        solver_endpoint: str = "",
+        sharded: bool | None = None,
         scheduler_interval: float = 0.2,
         configurator_interval: float = 30.0,
         node_sync_interval: float = 0.25,
@@ -94,6 +96,8 @@ class Bridge:
             auction_config=auction_config,
             events=self.events,
             preemption=preemption,
+            solver_endpoint=solver_endpoint,
+            sharded=sharded,
         )
         self._sched_ticker = Ticker(
             scheduler_interval, self.scheduler.tick, name="scheduler"

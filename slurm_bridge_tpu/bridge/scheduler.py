@@ -84,6 +84,11 @@ class PlacementScheduler:
         events: EventRecorder | None = None,
         preemption: bool = False,
         bucket: int = 1024,
+        solver_endpoint: str = "",
+        sharded: bool | None = None,
+        sharded_threshold: int = 1 << 20,
+        retry_cancel_timeout: float = 2.0,
+        place_timeout: float = 120.0,
     ):
         if backend not in ("auction", "greedy"):
             raise ValueError(f"unknown scheduler backend {backend!r}")
@@ -94,7 +99,27 @@ class PlacementScheduler:
         self.events = events or EventRecorder()
         self.preemption = preemption
         self.bucket = bucket
+        #: sharded auto-select (VERDICT r2 #4): with ``sharded=None`` the
+        #: multi-device shard_map sweep engages when a mesh exists AND the
+        #: solve is big enough to amortize the collectives — tiny solves
+        #: stay single-device (the P×N threshold mirrors auction.py's
+        #: candidate-sampling cutover rule).
+        self.sharded = sharded
+        self.sharded_threshold = sharded_threshold
+        #: per-RPC deadline for retry-context cancels (ADVICE r2: a dead
+        #: agent must not stall the tick for the full deadline × backlog)
+        self.retry_cancel_timeout = retry_cancel_timeout
+        #: deadline for the remote Place RPC — a wedged sidecar must stall
+        #: a tick at most this long, never wedge the scheduler thread
+        self.place_timeout = place_timeout
         self._solver: DeviceSolver | None = None
+        #: out-of-process PlacementSolver sidecar (SURVEY §7 item 4): when
+        #: set, solves go over gRPC instead of in-process JAX
+        self._remote: ServiceClient | None = None
+        if solver_endpoint:
+            from slurm_bridge_tpu.wire.rpc import dial
+
+            self._remote = ServiceClient(dial(solver_endpoint), "PlacementSolver")
         # cancels whose pod vanished before the failure could be annotated;
         # retried alongside the annotated ones
         self._orphan_cancels: set[int] = set()
@@ -162,12 +187,59 @@ class PlacementScheduler:
         incumbents = self.incumbent_pods() if use_preemption else []
         t0 = time.perf_counter()
         partitions, nodes = self.cluster_state()
-        snapshot = encode_cluster(nodes, partitions)
         all_pods = pods + incumbents
         demands: list[JobDemand] = []
         for pod in all_pods:
             d = pod.spec.demand or JobDemand(partition=pod.spec.partition)
             demands.append(d)
+        n_pending = len(pods)
+        if self._remote is not None:
+            by_job_names, lost_jobs = self._solve_remote(
+                partitions, nodes, demands, all_pods, n_pending
+            )
+        else:
+            by_job_names, lost_jobs = self._solve_local(
+                partitions, nodes, demands, all_pods, n_pending
+            )
+
+        ready_nodes = {
+            vn.partition
+            for vn in self.store.list(VirtualNode.KIND)
+            if vn.ready and not vn.meta.deleted
+        }
+        placed = 0
+        for j, pod in enumerate(pods):
+            names = by_job_names.get(j)
+            partition = demands[j].partition
+            if names and partition in ready_nodes:
+                if self._bind(pod, partition_node_name(partition), tuple(names)):
+                    placed += 1
+            else:
+                reason = (
+                    "Unschedulable: insufficient capacity"
+                    if partition in ready_nodes
+                    else f"Unschedulable: no ready virtual node for partition {partition!r}"
+                )
+                self._mark_unschedulable(pod, reason)
+        preempted = 0
+        for j in lost_jobs:
+            if self._preempt(all_pods[j]):
+                preempted += 1
+        _tick_seconds.observe(time.perf_counter() - t0)
+        _pods_placed.inc(placed)
+        _pods_preempted.inc(preempted)
+        _pods_unplaced.set(len(pods) - placed)
+        return placed
+
+    def _solve_local(
+        self, partitions, nodes, demands, all_pods, n_pending
+    ) -> tuple[dict[int, list[str]], list[int]]:
+        """In-process solve: encode, pin incumbents, run the kernel.
+
+        Returns (job index → assigned node names, incumbent job indices
+        that lost their nodes and must be preempted).
+        """
+        snapshot = encode_cluster(nodes, partitions)
         batch = encode_jobs(demands, snapshot)
 
         # Streaming incumbents: pin each already-submitted shard to its
@@ -178,7 +250,6 @@ class PlacementScheduler:
         shard_rows: dict[int, list[int]] = {}
         for row in range(batch.num_shards):
             shard_rows.setdefault(int(batch.job_of[row]), []).append(row)
-        n_pending = len(pods)
         for j in range(n_pending, len(all_pods)):
             pod = all_pods[j]
             hints = pod.spec.placement_hint
@@ -202,7 +273,7 @@ class PlacementScheduler:
                     # without being bindable or preemptible
                     batch.partition_of[row] = PAD_PARTITION
                     batch.demand[row] = 0.0
-        if incumbents:
+        if n_pending < len(all_pods):
             # half-step boost: CR priorities are integers, so this flips
             # only exact ties — an equal-priority newcomer must NOT displace
             # running work (admission sorts pending rows first otherwise)
@@ -210,41 +281,87 @@ class PlacementScheduler:
 
         placement = self._solve(snapshot, batch, incumbent_arr)
         by_job = placement.by_job(batch)
-
-        ready_nodes = {
-            vn.partition
-            for vn in self.store.list(VirtualNode.KIND)
-            if vn.ready and not vn.meta.deleted
+        by_job_names = {
+            j: [snapshot.node_names[i] for i in idxs] for j, idxs in by_job.items()
         }
-        placed = 0
-        for j, pod in enumerate(pods):
-            node_idxs = by_job.get(j)
-            partition = demands[j].partition
-            if node_idxs and partition in ready_nodes:
-                hint = tuple(snapshot.node_names[i] for i in node_idxs)
-                if self._bind(pod, partition_node_name(partition), hint):
-                    placed += 1
-            else:
-                reason = (
-                    "Unschedulable: insufficient capacity"
-                    if partition in ready_nodes
-                    else f"Unschedulable: no ready virtual node for partition {partition!r}"
-                )
-                self._mark_unschedulable(pod, reason)
-        preempted = 0
-        for j in range(n_pending, len(all_pods)):
-            rows = shard_rows.get(j, [])
-            lost = any(
+        lost_jobs = [
+            j
+            for j in range(n_pending, len(all_pods))
+            if any(
                 incumbent_arr[r] >= 0 and placement.node_of[r] != incumbent_arr[r]
-                for r in rows
+                for r in shard_rows.get(j, [])
             )
-            if lost and self._preempt(all_pods[j]):
-                preempted += 1
-        _tick_seconds.observe(time.perf_counter() - t0)
-        _pods_placed.inc(placed)
-        _pods_preempted.inc(preempted)
-        _pods_unplaced.set(len(pods) - placed)
-        return placed
+        ]
+        return by_job_names, lost_jobs
+
+    def _solve_remote(
+        self, partitions, nodes, demands, all_pods, n_pending
+    ) -> tuple[dict[int, list[str]], list[int]]:
+        """Out-of-process solve via the PlacementSolver sidecar.
+
+        The sidecar owns the streaming-incumbent semantics (release usage,
+        pin shards, +0.5 tie-break — solver/service.py), so this path only
+        lowers demands to PlaceJobs and reads assignments back. Gangs admit
+        all-or-nothing, so a preempted incumbent simply has no node_names in
+        the response — unless every hinted node vanished from the inventory,
+        which the local path treats as "drop the shards, keep the pod".
+        """
+        from slurm_bridge_tpu.wire.convert import (
+            demand_to_place,
+            node_to_proto,
+            partition_to_proto,
+        )
+
+        jobs = []
+        for j, d in enumerate(demands):
+            job = demand_to_place(d, job_id=str(j))
+            if j >= n_pending:
+                job.incumbent_node_names.extend(all_pods[j].spec.placement_hint)
+            jobs.append(job)
+        try:
+            resp = self._remote.Place(
+                pb.PlaceRequest(
+                    jobs=jobs,
+                    inventory=[node_to_proto(n) for n in nodes],
+                    partitions=[partition_to_proto(p) for p in partitions],
+                    # greedy stays greedy; auction lets the sidecar auto-pick
+                    # its best device path (single-device vs sharded)
+                    solver=self.backend if self.backend == "greedy" else "",
+                ),
+                timeout=self.place_timeout,
+            )
+        except grpc.RpcError as e:
+            # fail open: place nothing, preempt nobody; the level-triggered
+            # loop retries next tick (same posture as an agent outage)
+            log.warning("remote Place failed (%s); skipping tick", e.code())
+            return {}, []
+        by_job_names = {
+            int(a.job_id): list(a.node_names)
+            for a in resp.assignments
+            if a.node_names
+        }
+        known = set()
+        for n in nodes:
+            known.add(n.name)
+        lost_jobs = [
+            j
+            for j in range(n_pending, len(all_pods))
+            if j not in by_job_names
+            and any(h in known for h in all_pods[j].spec.placement_hint)
+        ]
+        return by_job_names, lost_jobs
+
+    def _use_sharded(self, batch, snapshot) -> bool:
+        if self.sharded is not None:
+            return self.sharded
+        from slurm_bridge_tpu.parallel.backend import ensure_backend
+
+        ensure_backend()
+        import jax
+
+        if len(jax.devices()) < 2:
+            return False
+        return batch.num_shards * snapshot.num_nodes >= self.sharded_threshold
 
     def _solve(self, snapshot, batch, incumbent):
         if self.backend == "greedy":
@@ -256,11 +373,18 @@ class PlacementScheduler:
                 incumbent = np.concatenate(
                     [incumbent, np.full(batch.num_shards - p_real, -1, np.int32)]
                 )
-        if self._solver is None:
-            self._solver = DeviceSolver(snapshot, self.auction_config)
+        if self._use_sharded(batch, snapshot):
+            from slurm_bridge_tpu.solver.sharded import sharded_place
+
+            placement = sharded_place(
+                snapshot, batch, self.auction_config, incumbent=incumbent
+            )
         else:
-            self._solver.update_snapshot(snapshot)
-        placement = self._solver.solve(batch, incumbent=incumbent)
+            if self._solver is None:
+                self._solver = DeviceSolver(snapshot, self.auction_config)
+            else:
+                self._solver.update_snapshot(snapshot)
+            placement = self._solver.solve(batch, incumbent=incumbent)
         if placement.node_of.shape[0] != p_real:
             placement = Placement(
                 node_of=placement.node_of[:p_real],
@@ -310,12 +434,20 @@ class PlacementScheduler:
         )
         return True
 
-    def _cancel_jobs(self, job_ids: list[int], *, context: str) -> list[int]:
-        """CancelJob each id; returns the ids whose cancel failed."""
+    def _cancel_jobs(
+        self, job_ids: list[int], *, context: str, timeout: float | None = None
+    ) -> list[int]:
+        """CancelJob each id; returns the ids whose cancel failed.
+
+        Retry-context cancels pass a short ``timeout`` so a dead agent
+        costs the tick at most timeout × backlog, not the default RPC
+        deadline × backlog (ADVICE r2)."""
         failed: list[int] = []
         for job_id in job_ids:
             try:
-                self.client.CancelJob(pb.CancelJobRequest(job_id=job_id))
+                self.client.CancelJob(
+                    pb.CancelJobRequest(job_id=job_id), timeout=timeout
+                )
             except grpc.RpcError as e:
                 log.warning(
                     "%s: cancel job %d failed (will retry next tick): %s",
@@ -344,22 +476,33 @@ class PlacementScheduler:
 
     def _retry_pending_cancels(self) -> None:
         """Drain the pending-cancel backlog at the top of every tick."""
+        tmo = self.retry_cancel_timeout
         if self._orphan_cancels:
-            still = self._cancel_jobs(sorted(self._orphan_cancels), context="retry")
+            still = self._cancel_jobs(
+                sorted(self._orphan_cancels), context="retry", timeout=tmo
+            )
             self._orphan_cancels = set(still)
         for pod in self.store.list(Pod.KIND):
             pending = pod.meta.annotations.get(PENDING_CANCEL_ANNOTATION)
             if not pending:
                 continue
             ids = [int(t) for t in pending.split(",") if t]
-            still = set(self._cancel_jobs(ids, context="retry"))
+            still = set(self._cancel_jobs(ids, context="retry", timeout=tmo))
             if len(still) == len(ids):
                 continue  # nothing landed; annotation already correct
-            new_val = ",".join(str(i) for i in ids if i in still)
+            landed = set(ids) - still
 
             def record(p: Pod):
-                if new_val:
-                    p.meta.annotations[PENDING_CANCEL_ANNOTATION] = new_val
+                # derive from the pod's CURRENT annotation, removing only
+                # the ids whose cancel landed — a conflict-retry (or a
+                # concurrent writer adding fresh pending-cancel ids) must
+                # not be clobbered by a precomputed value (ADVICE r2)
+                current = p.meta.annotations.get(PENDING_CANCEL_ANNOTATION, "")
+                remaining = {int(x) for x in current.split(",") if x} - landed
+                if remaining:
+                    p.meta.annotations[PENDING_CANCEL_ANNOTATION] = ",".join(
+                        str(i) for i in sorted(remaining)
+                    )
                 else:
                     p.meta.annotations.pop(PENDING_CANCEL_ANNOTATION, None)
 

@@ -1,0 +1,302 @@
+"""PlacementSolver gRPC service — the solver as an out-of-process sidecar.
+
+SURVEY.md §7 item 4 calls for the JAX solver "exposed as a gRPC sidecar
+gated behind ``--scheduler=jax``" so the greedy in-process path stays
+intact. This module is that sidecar: a servicer lowering ``PlaceRequest``
+(jobs + node inventory + partitions) through :func:`encode_cluster` into
+the device-resident auction solver — or the greedy packer, or the
+``shard_map`` multi-device sweep — and answering with per-job node
+assignments.
+
+The service surface was declared in ``wire/workload.proto`` in round 2;
+implementing it here kills the declared-but-unimplemented anti-pattern the
+reference ships (``JobState`` panics, /root/reference/pkg/slurm-agent/api/slurm.go:48-51
+— our missing RPCs at worst return UNIMPLEMENTED via wire/rpc.py, and
+PlacementSolver no longer is one).
+
+Semantics mirror the in-process scheduler tick (bridge/scheduler.py):
+
+- ``PlaceJob.cpus/mem_mb/gpus`` are PER-NODE quantities; ``nodes > 1``
+  expands into that many gang shards admitted all-or-nothing.
+- ``incumbent_node_names`` marks a streaming incumbent (BASELINE config
+  #5): its usage is released back to free capacity, each shard is pinned to
+  its named node, and equal-priority newcomers cannot displace it (the
+  +0.5 half-step boost — CR priorities are integers, so this flips only
+  exact ties). An incumbent absent from the response was preempted.
+- unknown partition ⇒ any node; unknown required feature ⇒ unplaceable
+  (impossible bit 31, snapshot.py semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import numpy as np
+
+from slurm_bridge_tpu.solver.auction import AuctionConfig
+from slurm_bridge_tpu.solver.greedy import greedy_place
+from slurm_bridge_tpu.solver.session import DeviceSolver
+from slurm_bridge_tpu.solver.snapshot import (
+    PAD_PARTITION,
+    ClusterSnapshot,
+    JobBatch,
+    encode_cluster,
+)
+from slurm_bridge_tpu.wire import pb
+from slurm_bridge_tpu.wire.convert import node_from_proto, partition_from_proto
+
+log = logging.getLogger("sbt.solver.service")
+
+SOLVERS = ("auction", "greedy", "sharded")
+
+
+def auto_solver() -> str:
+    """Pick the best solver for this process: the sharded multi-device sweep
+    whenever a mesh is available, the single-device auction otherwise (the
+    same rule bench.py uses; reference analogue: one VK process per
+    partition, /root/reference/pkg/configurator/configurator.go:151-171)."""
+    from slurm_bridge_tpu.parallel.backend import ensure_backend
+
+    ensure_backend()  # hang-proof: never let a wedged accelerator block this
+    import jax
+
+    return "sharded" if len(jax.devices()) > 1 else "auction"
+
+
+class PlacementSolverServicer:
+    """Implements the ``PlacementSolver`` service from workload.proto.
+
+    One DeviceSolver is kept across Place calls so the staged snapshot
+    survives ticks against a slowly-changing inventory (session.py). Calls
+    are serialized — the solver session is single-threaded by design; gRPC
+    worker threads queue on the lock.
+    """
+
+    def __init__(
+        self,
+        config: AuctionConfig | None = None,
+        *,
+        solver: str = "",
+        bucket: int = 1024,
+    ):
+        if solver and solver not in SOLVERS:
+            raise ValueError(f"unknown solver {solver!r}")
+        self.config = config or AuctionConfig()
+        self.default_solver = solver
+        #: shard-axis bucketing (scheduler.py semantics): a streaming queue
+        #: whose length drifts tick to tick must not force a fresh XLA
+        #: compile per Place — pad to the bucket so the kernel sees a
+        #: handful of shapes
+        self.bucket = bucket
+        self._session: DeviceSolver | None = None
+        self._lock = threading.Lock()
+
+    # ---- RPCs ----
+
+    def Place(self, request: pb.PlaceRequest, context) -> pb.PlaceResponse:
+        solver = request.solver or self.default_solver or auto_solver()
+        if solver not in SOLVERS:
+            import grpc
+
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"unknown solver {solver!r} (want one of {SOLVERS})",
+            )
+        nodes = [node_from_proto(m) for m in request.inventory]
+        partitions = [partition_from_proto(m) for m in request.partitions]
+        if not partitions:
+            # inventory-only callers: one catch-all partition named "" so
+            # jobs with an empty partition match every node
+            from slurm_bridge_tpu.core.types import PartitionInfo
+
+            partitions = [PartitionInfo(name="", nodes=tuple(n.name for n in nodes))]
+        snapshot = encode_cluster(nodes, partitions)
+        batch, incumbent = self._encode(request.jobs, snapshot)
+
+        t0 = time.perf_counter()
+        with self._lock:
+            placement = self._solve(solver, snapshot, batch, incumbent)
+        solve_ms = (time.perf_counter() - t0) * 1e3
+
+        by_job = placement.by_job(batch)
+        assignments = []
+        placed = 0
+        for j, job in enumerate(request.jobs):
+            idxs = by_job.get(j, [])
+            if idxs:
+                placed += 1
+            assignments.append(
+                pb.Assignment(
+                    job_id=job.id or str(j),
+                    node_names=[snapshot.node_names[i] for i in idxs],
+                )
+            )
+        return pb.PlaceResponse(
+            assignments=assignments,
+            placed=placed,
+            total=len(request.jobs),
+            solve_ms=solve_ms,
+            solver=solver,
+        )
+
+    def SolverInfo(self, request, context) -> pb.SolverInfoResponse:
+        from slurm_bridge_tpu.parallel.backend import ensure_backend
+
+        backend = ensure_backend()
+        import jax
+
+        devices = len(jax.devices())
+        mesh = ""
+        if devices > 1:
+            from slurm_bridge_tpu.parallel.mesh import solver_mesh
+
+            m = solver_mesh()
+            mesh = ",".join(f"{k}={v}" for k, v in m.shape.items())
+        return pb.SolverInfoResponse(
+            backend=backend, devices=devices, mesh=mesh, solvers=list(SOLVERS)
+        )
+
+    # ---- lowering ----
+
+    def _encode(
+        self, jobs, snapshot: ClusterSnapshot
+    ) -> tuple[JobBatch, np.ndarray]:
+        rows_dem: list[tuple[float, float, float]] = []
+        rows_part: list[int] = []
+        rows_feat: list[int] = []
+        rows_prio: list[float] = []
+        rows_job: list[int] = []
+        rows_inc: list[int] = []
+        name_idx = {n: i for i, n in enumerate(snapshot.node_names)}
+        for j, job in enumerate(jobs):
+            nshards = max(1, int(job.nodes))
+            part = snapshot.partition_codes.get(job.partition, -1)
+            feat = 0
+            for f in job.req_features:
+                bit = snapshot.feature_codes.get(f)
+                feat |= 1 << (bit if bit is not None else 31)
+            pinned = list(job.incumbent_node_names)
+            for k in range(nshards):
+                dem = (float(job.cpus), float(job.mem_mb), float(job.gpus))
+                inc = -1
+                this_part = part
+                if pinned:
+                    node = name_idx.get(pinned[k]) if k < len(pinned) else None
+                    if node is not None:
+                        inc = node
+                        # release the incumbent's usage so everyone re-admits
+                        # against total capacity (scheduler.py tick semantics)
+                        snapshot.free[node] += np.asarray(dem, np.float32)
+                    else:
+                        # pinned node vanished from the inventory: drop the
+                        # shard from the solve — unpinned it would shadow
+                        # healthy nodes' capacity without being bindable
+                        this_part = int(PAD_PARTITION)
+                        dem = (0.0, 0.0, 0.0)
+                rows_dem.append(dem)
+                rows_part.append(this_part)
+                rows_feat.append(feat)
+                rows_prio.append(float(job.priority) + (0.5 if pinned else 0.0))
+                rows_job.append(j)
+                rows_inc.append(inc)
+        batch = JobBatch(
+            demand=np.asarray(rows_dem, dtype=np.float32).reshape(-1, 3),
+            partition_of=np.asarray(rows_part, dtype=np.int32),
+            req_features=np.asarray(rows_feat, dtype=np.uint32),
+            priority=np.asarray(rows_prio, dtype=np.float32),
+            gang_id=np.asarray(rows_job, dtype=np.int32),
+            job_of=np.asarray(rows_job, dtype=np.int32),
+        )
+        return batch, np.asarray(rows_inc, dtype=np.int32)
+
+    def _solve(self, solver, snapshot, batch, incumbent):
+        if batch.num_shards == 0:
+            from slurm_bridge_tpu.solver.snapshot import Placement
+
+            return Placement(
+                node_of=np.zeros(0, np.int32),
+                placed=np.zeros(0, bool),
+                free_after=snapshot.free.copy(),
+            )
+        if solver == "greedy":
+            return greedy_place(snapshot, batch)
+        p_real = batch.num_shards
+        if self.bucket:
+            from slurm_bridge_tpu.solver.snapshot import pad_batch
+
+            batch = pad_batch(batch, self.bucket)
+            if batch.num_shards != p_real:
+                incumbent = np.concatenate(
+                    [incumbent, np.full(batch.num_shards - p_real, -1, np.int32)]
+                )
+        if solver == "sharded":
+            from slurm_bridge_tpu.solver.sharded import sharded_place
+
+            placement = sharded_place(
+                snapshot, batch, self.config, incumbent=incumbent
+            )
+        else:
+            if self._session is None:
+                self._session = DeviceSolver(snapshot, self.config)
+            else:
+                self._session.update_snapshot(snapshot)
+            placement = self._session.solve(batch, incumbent=incumbent)
+        if placement.node_of.shape[0] != p_real:
+            from slurm_bridge_tpu.solver.snapshot import Placement
+
+            placement = Placement(
+                node_of=placement.node_of[:p_real],
+                placed=placement.placed[:p_real],
+                free_after=placement.free_after,
+            )
+        return placement
+
+
+def serve_solver(
+    endpoint: str, config: AuctionConfig | None = None, *, solver: str = ""
+):
+    """Start a gRPC server hosting the PlacementSolver at ``endpoint``."""
+    from slurm_bridge_tpu.wire.rpc import serve
+
+    return serve({"PlacementSolver": PlacementSolverServicer(config, solver=solver)}, endpoint)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``sbt-solver`` — run the placement solver as a standalone sidecar."""
+    import argparse
+    import signal
+
+    from slurm_bridge_tpu.obs.logging import setup_logging
+
+    parser = argparse.ArgumentParser(description="slurm-bridge-tpu placement solver sidecar")
+    parser.add_argument("--listen", default="0.0.0.0:9998",
+                        help="bind endpoint (host:port or *.sock)")
+    parser.add_argument("--solver", default="", choices=["", *SOLVERS],
+                        help="default solver when requests don't name one "
+                             "(empty = auto: sharded on a multi-device mesh)")
+    parser.add_argument("--rounds", type=int, default=0,
+                        help="auction rounds override (0 = config default)")
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    setup_logging(verbose=args.verbose)
+
+    cfg = AuctionConfig()
+    if args.rounds:
+        cfg = AuctionConfig(rounds=args.rounds)
+    server = serve_solver(args.listen, cfg, solver=args.solver)
+    log.info("placement solver serving on %s (port %s)", args.listen, server.bound_port)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.stop(grace=2).wait()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -186,6 +186,9 @@ def sharded_place(
     incumbent: np.ndarray | None = None,
 ) -> Placement:
     """Solve one tick sharded over every available device."""
+    from slurm_bridge_tpu.parallel.backend import ensure_backend
+
+    ensure_backend()  # hang-proof: a wedged accelerator degrades, not wedges
     cfg = config or AuctionConfig()
     mesh = mesh or solver_mesh()
     dp, mp = mesh.shape["dp"], mesh.shape["mp"]

@@ -175,7 +175,9 @@ def node_from_proto(m: pb.Node) -> NodeInfo:
         alloc_gpus=int(m.alloc_gpus),
         gpu_type=m.gpu_type,
         features=tuple(m.features),
-        state=m.state,
+        # proto3 unset == "": an unstated node state means schedulable
+        # (symmetric with partition_from_proto's `or "UP"`)
+        state=m.state or "IDLE",
     )
 
 
@@ -204,4 +206,47 @@ def partition_from_proto(m: pb.PartitionResponse) -> PartitionInfo:
         total_cpus=int(m.total_cpus),
         total_nodes=int(m.total_nodes),
         state=m.state or "UP",
+    )
+
+
+def demand_to_place(d: JobDemand, *, job_id: str = "") -> pb.PlaceJob:
+    """Lower a JobDemand into a PlaceJob for the PlacementSolver sidecar.
+
+    PlaceJob quantities are PER-NODE: the sizecar sizing rule
+    (solver/snapshot.py encode_jobs; pkg/slurm-bridge-operator/pod.go:143-162)
+    spreads cpu evenly across ``nodes`` shards — fractional per-shard cpu is
+    rounded UP so the wire form never understates the demand. gres is a
+    per-node quantity in Slurm and is not divided; the gres *type* rides
+    along as a required feature the solver matches against node features.
+    """
+    import math
+
+    from slurm_bridge_tpu.core.arrays import array_len
+
+    arr = array_len(d.array)
+    nshards = max(1, d.nodes)
+    cpu = math.ceil(d.total_cpus(arr) / nshards)
+    mem_per_cpu = d.mem_per_cpu_mb or 1024
+    gres_parts = d.gres.split(":") if d.gres else []
+    gpus = 0
+    features: list[str] = []
+    if gres_parts and gres_parts[0] == "gpu":
+        try:
+            gpus = int(gres_parts[-1].split("(")[0]) * max(1, arr)
+        except ValueError:
+            gpus = 0
+    # the gres TYPE is a feature constraint for ANY 3-part gres (tpu:v4:8
+    # as much as gpu:a100:2) — mirroring _required_features
+    # (solver/snapshot.py); only the count column is gpu-specific
+    if len(gres_parts) == 3:
+        features.append(gres_parts[1])
+    return pb.PlaceJob(
+        id=job_id,
+        cpus=cpu,
+        mem_mb=cpu * mem_per_cpu,
+        gpus=gpus,
+        partition=d.partition,
+        req_features=features,
+        nodes=nshards,
+        priority=float(d.priority),
     )

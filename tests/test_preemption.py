@@ -139,7 +139,7 @@ def test_failed_preempt_cancel_is_retried():
             self.down = True
             self.cancelled = []
 
-        def CancelJob(self, req):
+        def CancelJob(self, req, timeout=None):
             if self.down:
                 raise _Down()
             self.cancelled.append(req.job_id)

@@ -1,0 +1,285 @@
+"""PlacementSolver sidecar e2e: the solver served over a real gRPC
+boundary, and the bridge driving its whole product path through it.
+
+SURVEY.md §7 item 4 ("exposed as a gRPC sidecar"); the service was declared
+in workload.proto in round 2 — these tests pin the implementation so it can
+never regress to the reference's declared-but-unimplemented pattern
+(JobState panics, /root/reference/pkg/slurm-agent/api/slurm.go:48-51).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+
+import pytest
+
+from slurm_bridge_tpu.solver.service import PlacementSolverServicer, serve_solver
+from slurm_bridge_tpu.wire import ServiceClient, dial, pb
+
+
+@pytest.fixture
+def solver_client(tmp_path):
+    server = serve_solver(str(tmp_path / "solver.sock"))
+    client = ServiceClient(dial(str(tmp_path / "solver.sock")), "PlacementSolver")
+    yield client
+    client.close()
+    server.stop(None)
+
+
+def _inventory(n=4, cpus=8, mem=32000, features=()):
+    return [
+        pb.Node(name=f"n{i}", cpus=cpus, memory_mb=mem, features=list(features))
+        for i in range(n)
+    ]
+
+
+def _partitions(names_nodes):
+    return [
+        pb.PartitionResponse(name=name, nodes=list(nodes))
+        for name, nodes in names_nodes.items()
+    ]
+
+
+def test_place_basic(solver_client):
+    resp = solver_client.Place(
+        pb.PlaceRequest(
+            jobs=[
+                pb.PlaceJob(id="a", cpus=2, mem_mb=1024, partition="debug"),
+                pb.PlaceJob(id="b", cpus=2, mem_mb=1024, partition="debug"),
+            ],
+            inventory=_inventory(2, cpus=2),
+            partitions=_partitions({"debug": ["n0", "n1"]}),
+            solver="auction",
+        )
+    )
+    assert resp.placed == 2 and resp.total == 2
+    assert resp.solver == "auction"
+    assert resp.solve_ms > 0
+    names = {a.job_id: list(a.node_names) for a in resp.assignments}
+    # each job fills a whole node, so they must land on distinct ones
+    assert len(names["a"]) == 1 and len(names["b"]) == 1
+    assert names["a"] != names["b"]
+
+
+def test_place_greedy_and_gang(solver_client):
+    resp = solver_client.Place(
+        pb.PlaceRequest(
+            jobs=[pb.PlaceJob(id="gang", cpus=4, mem_mb=2048, nodes=3, partition="p")],
+            inventory=_inventory(4, cpus=4),
+            partitions=_partitions({"p": ["n0", "n1", "n2", "n3"]}),
+            solver="greedy",
+        )
+    )
+    assert resp.placed == 1
+    (a,) = resp.assignments
+    assert len(a.node_names) == 3 and len(set(a.node_names)) == 3
+
+
+def test_place_gang_all_or_nothing(solver_client):
+    # 3-node gang against 2 nodes: must place nothing, not a partial gang
+    resp = solver_client.Place(
+        pb.PlaceRequest(
+            jobs=[pb.PlaceJob(id="gang", cpus=1, mem_mb=512, nodes=3, partition="p")],
+            inventory=_inventory(2),
+            partitions=_partitions({"p": ["n0", "n1"]}),
+            solver="auction",
+        )
+    )
+    assert resp.placed == 0
+    assert list(resp.assignments[0].node_names) == []
+
+
+def test_place_feature_constraint(solver_client):
+    inv = _inventory(3) + [
+        pb.Node(name="gpu0", cpus=8, memory_mb=32000, gpus=4, features=["a100"])
+    ]
+    resp = solver_client.Place(
+        pb.PlaceRequest(
+            jobs=[
+                pb.PlaceJob(id="g", cpus=1, mem_mb=512, gpus=2,
+                            partition="p", req_features=["a100"]),
+                pb.PlaceJob(id="missing", cpus=1, mem_mb=512,
+                            partition="p", req_features=["h100"]),
+            ],
+            inventory=inv,
+            partitions=_partitions({"p": ["n0", "n1", "n2", "gpu0"]}),
+            solver="auction",
+        )
+    )
+    names = {a.job_id: list(a.node_names) for a in resp.assignments}
+    assert names["g"] == ["gpu0"]  # only the feature-matching node qualifies
+    assert names["missing"] == []  # unknown feature ⇒ unplaceable
+
+
+def test_place_priority_orders_admission(solver_client):
+    # one node, capacity for one job — the higher priority one must win
+    resp = solver_client.Place(
+        pb.PlaceRequest(
+            jobs=[
+                pb.PlaceJob(id="lo", cpus=4, mem_mb=1024, partition="p", priority=1),
+                pb.PlaceJob(id="hi", cpus=4, mem_mb=1024, partition="p", priority=9),
+            ],
+            inventory=_inventory(1, cpus=4),
+            partitions=_partitions({"p": ["n0"]}),
+            solver="auction",
+        )
+    )
+    names = {a.job_id: list(a.node_names) for a in resp.assignments}
+    assert names["hi"] == ["n0"] and names["lo"] == []
+
+
+def test_place_incumbent_kept_and_preempted(solver_client):
+    # incumbent holds the only node; an equal-priority newcomer must NOT
+    # displace it, a higher-priority one must
+    base = dict(cpus=4, mem_mb=1024, partition="p")
+    # the node's alloc_* reflects the incumbent's running job (that's what
+    # Slurm reports); the solver releases it so everyone re-admits against
+    # total capacity — without it the incumbent would double-count
+    inv = [
+        pb.Node(name="n0", cpus=4, memory_mb=32000,
+                alloc_cpus=4, alloc_memory_mb=1024)
+    ]
+    parts = _partitions({"p": ["n0"]})
+    kept = solver_client.Place(
+        pb.PlaceRequest(
+            jobs=[
+                pb.PlaceJob(id="inc", priority=1, incumbent_node_names=["n0"], **base),
+                pb.PlaceJob(id="new", priority=1, **base),
+            ],
+            inventory=inv, partitions=parts, solver="auction",
+        )
+    )
+    names = {a.job_id: list(a.node_names) for a in kept.assignments}
+    assert names["inc"] == ["n0"] and names["new"] == []
+
+    lost = solver_client.Place(
+        pb.PlaceRequest(
+            jobs=[
+                pb.PlaceJob(id="inc", priority=1, incumbent_node_names=["n0"], **base),
+                pb.PlaceJob(id="new", priority=9, **base),
+            ],
+            inventory=inv, partitions=parts, solver="auction",
+        )
+    )
+    names = {a.job_id: list(a.node_names) for a in lost.assignments}
+    assert names["new"] == ["n0"] and names["inc"] == []
+
+
+def test_place_no_partitions_catch_all(solver_client):
+    resp = solver_client.Place(
+        pb.PlaceRequest(
+            jobs=[pb.PlaceJob(id="j", cpus=1, mem_mb=512)],
+            inventory=_inventory(2),
+            solver="auction",
+        )
+    )
+    assert resp.placed == 1
+
+
+def test_place_unknown_solver_rejected(solver_client):
+    import grpc
+
+    with pytest.raises(grpc.RpcError) as ei:
+        solver_client.Place(
+            pb.PlaceRequest(
+                jobs=[pb.PlaceJob(id="j", cpus=1, mem_mb=512)],
+                inventory=_inventory(1),
+                solver="simplex",
+            )
+        )
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_solver_info(solver_client):
+    info = solver_client.SolverInfo(pb.SolverInfoRequest())
+    assert info.backend == "cpu"  # conftest pins JAX_PLATFORMS=cpu
+    assert info.devices >= 1
+    assert set(info.solvers) == {"auction", "greedy", "sharded"}
+    if info.devices > 1:
+        assert "dp=" in info.mesh
+
+
+def test_place_sharded_solver(solver_client):
+    """The sidecar can run the shard_map sweep over the 8-device CPU mesh."""
+    resp = solver_client.Place(
+        pb.PlaceRequest(
+            jobs=[
+                pb.PlaceJob(id=f"j{i}", cpus=2, mem_mb=1024, partition="p")
+                for i in range(16)
+            ],
+            inventory=_inventory(8, cpus=4),
+            partitions=_partitions({"p": [f"n{i}" for i in range(8)]}),
+            solver="sharded",
+        )
+    )
+    assert resp.solver == "sharded"
+    assert resp.placed == 16  # 8 nodes × 4 cpus / 2 = exactly fits
+
+
+# ------------------------------------------------------- product path e2e
+
+
+FAKESLURM = str(pathlib.Path(__file__).parent / "fakeslurm")
+
+CLUSTER = {
+    "partitions": {"tiny": {"nodes": ["t1", "t2"], "default": True}},
+    "nodes": {
+        "t1": {"cpus": 4, "memory_mb": 16000, "partition": "tiny"},
+        "t2": {"cpus": 4, "memory_mb": 16000, "partition": "tiny"},
+    },
+}
+
+
+def test_bridge_with_solver_sidecar(tmp_path, monkeypatch):
+    """The full control plane solving out-of-process: submit → the bridge
+    dials the PlacementSolver sidecar for placement → sbatch → success."""
+    from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
+    from slurm_bridge_tpu.bridge import Bridge, BridgeJobSpec, JobState
+    from slurm_bridge_tpu.wire import serve
+
+    state = tmp_path / "slurm-state"
+    state.mkdir(parents=True)
+    (state / "cluster.json").write_text(json.dumps(CLUSTER))
+    monkeypatch.setenv("SBT_FAKESLURM_STATE", str(state))
+    monkeypatch.setenv("PATH", FAKESLURM + os.pathsep + os.environ["PATH"])
+
+    agent_sock = str(tmp_path / "agent.sock")
+    agent = serve(
+        {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
+        agent_sock,
+    )
+    solver_sock = str(tmp_path / "solver.sock")
+    solver = serve_solver(solver_sock, solver="auction")
+    bridge = Bridge(
+        agent_sock,
+        scheduler_backend="auction",
+        solver_endpoint=solver_sock,
+        scheduler_interval=0.05,
+        configurator_interval=5.0,
+        node_sync_interval=0.05,
+    ).start()
+    try:
+        assert bridge.scheduler._remote is not None  # really out-of-process
+        bridge.submit(
+            "remote-solved",
+            BridgeJobSpec(partition="tiny", cpus_per_task=2,
+                          sbatch_script="#!/bin/sh\necho hi\n"),
+        )
+        job = bridge.wait("remote-solved", timeout=20.0)
+        assert job.status.state == JobState.SUCCEEDED
+        # the placement hint the sidecar chose reached sbatch --nodelist
+        recs = [json.loads(p.read_text()) for p in state.glob("job_*.json")]
+        tasks = [t for r in recs if "alias_of" not in r for t in r["tasks"]]
+        assert tasks and all(t["node"] in ("t1", "t2") for t in tasks)
+    finally:
+        bridge.stop()
+        solver.stop(None)
+        agent.stop(None)
+
+
+def test_servicer_rejects_bad_default():
+    with pytest.raises(ValueError):
+        PlacementSolverServicer(solver="nope")
