@@ -12,12 +12,16 @@ reference publishes no numbers, so the greedy packer we built at parity IS
 the measured baseline).
 
 Robustness contract (round-1 failure: the TPU backend init wedged and the
-bench recorded *nothing*): backend acquisition runs in a worker thread
-under a bounded timeout with one retry; on failure or hang the bench falls
-back to CPU (config-update first, process re-exec if the init lock is
-wedged) and STILL emits the one JSON line, with an honest "backend" field.
-A global watchdog emits whatever partial numbers exist rather than dying
-silently.
+bench recorded *nothing*; round-2: one short retry gave up and fell back
+to CPU): TPU init is treated as a hostile dependency. Backend acquisition
+runs FIRST, in a worker thread under a long single-shot budget
+(SBT_BENCH_TPU_BUDGET, default 600 s), progress-logged every 30 s, with a
+faulthandler stack dump into diagnostics/ at half-budget and at expiry.
+A wedged attempt poisons the process's init lock, so retries happen across
+process re-execs — SBT_BENCH_TPU_ATTEMPTS of them (default 3), each a
+fresh process — before the final re-exec pins CPU. Every path still emits
+the one JSON line with an honest "backend" field, and failure paths exit
+nonzero (ADVICE r2) so a harness keying off rc sees them.
 
 The solve runs through :class:`DeviceSolver`: the node snapshot stays
 device-resident across ticks (as the production reconcile loop holds it)
@@ -38,7 +42,9 @@ import time
 import numpy as np
 
 _FORCED_CPU_ENV = "SBT_BENCH_CPU"
+_ATTEMPT_ENV = "SBT_BENCH_TPU_ATTEMPT"  # 1-based, bumped on each re-exec
 _METRIC = "pods_placed_per_sec_50kx10k"
+_DIAG_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "diagnostics")
 
 # Filled in as the run progresses so the watchdog can emit a partial line.
 _PARTIAL: dict = {"metric": _METRIC, "value": 0.0, "unit": "pods/s",
@@ -61,7 +67,7 @@ def _start_watchdog(timeout_s: float) -> threading.Timer:
               file=sys.stderr, flush=True)
         _emit(dict(_PARTIAL, note="watchdog-partial"))
         sys.stdout.flush()
-        os._exit(0)
+        os._exit(3)  # partial data ≠ success (ADVICE r2)
 
     timer = threading.Timer(timeout_s, _fire)
     timer.daemon = True
@@ -69,12 +75,42 @@ def _start_watchdog(timeout_s: float) -> threading.Timer:
     return timer
 
 
-def _reexec_forced_cpu() -> None:
-    """Escape a wedged backend-init lock: replace the whole process."""
-    print("# backend init wedged — re-exec with forced CPU", file=sys.stderr,
-          flush=True)
-    env = dict(os.environ, **{_FORCED_CPU_ENV: "1"})
+def _reexec(extra_env: dict) -> None:
+    """Replace the process — the only escape from a poisoned init lock."""
+    env = dict(os.environ, **extra_env)
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
+def _reexec_forced_cpu() -> None:
+    print("# giving up on the accelerator — re-exec with forced CPU",
+          file=sys.stderr, flush=True)
+    _reexec({_FORCED_CPU_ENV: "1"})
+
+
+def _dump_stacks(attempt: int, tag: str, elapsed: float) -> str:
+    """faulthandler dump of every thread into diagnostics/ — captures WHERE
+    backend init is stuck (VERDICT r2 #1: dump on every timeout, not just
+    the last). Returns the path (best-effort; never raises)."""
+    import faulthandler
+
+    try:
+        os.makedirs(_DIAG_DIR, exist_ok=True)
+        path = os.path.join(
+            _DIAG_DIR, f"tpu_probe_bench_attempt{attempt}_{tag}.log"
+        )
+        with open(path, "a") as f:
+            f.write(
+                f"# bench TPU probe attempt {attempt} [{tag}] after "
+                f"{elapsed:.0f}s — {time.strftime('%Y-%m-%dT%H:%M:%S')}\n"
+                f"# JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '')!r} "
+                f"SBT_BACKEND={os.environ.get('SBT_BACKEND', '')!r}\n"
+            )
+            faulthandler.dump_traceback(file=f)
+        print(f"# stack dump → {path}", file=sys.stderr, flush=True)
+        return path
+    except Exception as exc:  # noqa: BLE001 — diagnostics must not kill us
+        print(f"# stack dump failed: {exc!r}", file=sys.stderr, flush=True)
+        return ""
 
 
 def _force_cpu() -> str:
@@ -92,49 +128,82 @@ def _force_cpu() -> str:
     return "cpu"
 
 
-def _acquire_backend(probe_timeouts=(150.0, 60.0)) -> str:
+def _acquire_backend() -> str:
     """Initialize a JAX backend, preferring the accelerator, never hanging.
 
-    Returns the backend name actually live. On probe timeout the init lock
-    may be held by the dead probe thread, so recovery is by re-exec with a
-    marker env var; on probe *error* the lock is free and an in-process
-    CPU fallback suffices.
+    VERDICT r2 #1 contract — TPU init is a hostile dependency:
+    - one LONG single-shot budget per attempt (SBT_BENCH_TPU_BUDGET,
+      default 600 s), progress-logged every 30 s;
+    - a wedged attempt poisons this process's init lock, so the retry is a
+      process re-exec (SBT_BENCH_TPU_ATTEMPTS total, default 3) — each
+      attempt gets a genuinely fresh PJRT client;
+    - faulthandler stack dumps into diagnostics/ at half-budget and at
+      expiry, every attempt, so where init sticks is on the record;
+    - only after the last attempt does the re-exec pin CPU.
+    On probe *error* (exception, lock free) an in-process CPU fallback
+    suffices and no re-exec is spent.
     """
     if os.environ.get(_FORCED_CPU_ENV) == "1":
         return _force_cpu()
 
     import jax
 
-    for attempt, timeout_s in enumerate(probe_timeouts, 1):
-        result: dict = {}
+    attempt = int(os.environ.get(_ATTEMPT_ENV, "1"))
+    max_attempts = int(os.environ.get("SBT_BENCH_TPU_ATTEMPTS", "3"))
+    budget = float(os.environ.get("SBT_BENCH_TPU_BUDGET", "600"))
+    result: dict = {}
 
-        def _probe() -> None:
-            try:
-                result["backend"] = jax.default_backend()
-            except Exception as exc:  # noqa: BLE001 — report and fall back
-                result["error"] = exc
+    def _probe() -> None:
+        try:
+            result["backend"] = jax.default_backend()
+        except Exception as exc:  # noqa: BLE001 — report and fall back
+            result["error"] = exc
 
-        t = threading.Thread(target=_probe, daemon=True)
-        t.start()
-        t.join(timeout_s)
-        if result.get("backend"):
-            return result["backend"]
-        if "error" in result:
-            print(f"# backend probe {attempt} failed: {result['error']!r}",
+    print(
+        f"# TPU probe attempt {attempt}/{max_attempts}, budget {budget:.0f}s",
+        file=sys.stderr, flush=True,
+    )
+    t = threading.Thread(target=_probe, daemon=True)
+    t0 = time.perf_counter()
+    t.start()
+    dumped_half = False
+    while True:
+        t.join(30.0)
+        elapsed = time.perf_counter() - t0
+        if result:
+            break
+        print(f"# ... backend init still running ({elapsed:.0f}s)",
+              file=sys.stderr, flush=True)
+        if not dumped_half and elapsed >= budget / 2:
+            dumped_half = True
+            _dump_stacks(attempt, "halfbudget", elapsed)
+        if elapsed >= budget:
+            break
+
+    if result.get("backend"):
+        print(f"# backend up after {time.perf_counter() - t0:.0f}s",
+              file=sys.stderr, flush=True)
+        return result["backend"]
+    if "error" in result:
+        print(f"# backend probe failed cleanly: {result['error']!r}",
+              file=sys.stderr, flush=True)
+        try:
+            return _force_cpu()
+        except Exception as exc:  # noqa: BLE001
+            print(f"# in-process CPU fallback failed: {exc!r}",
                   file=sys.stderr, flush=True)
-            continue
-        # Probe thread is wedged inside backend init; the init lock is
-        # poisoned for this process. Re-exec (does not return).
-        _reexec_forced_cpu()
+            _reexec_forced_cpu()
+            raise AssertionError("unreachable")
 
-    # All probes errored cleanly — fall back in-process.
-    try:
-        return _force_cpu()
-    except Exception as exc:  # noqa: BLE001
-        print(f"# in-process CPU fallback failed: {exc!r}", file=sys.stderr,
-              flush=True)
-        _reexec_forced_cpu()
-        raise AssertionError("unreachable")
+    # Wedged inside backend init: dump, then retry in a FRESH process (the
+    # init lock here is poisoned) or give up to CPU after the last attempt.
+    _dump_stacks(attempt, "expired", time.perf_counter() - t0)
+    if attempt < max_attempts:
+        print(f"# attempt {attempt} wedged — re-exec for attempt {attempt + 1}",
+              file=sys.stderr, flush=True)
+        _reexec({_ATTEMPT_ENV: str(attempt + 1)})
+    _reexec_forced_cpu()
+    raise AssertionError("unreachable")
 
 
 def _steady_state_ms(fn, *, warmup: int = 1, iters: int = 5) -> float:
@@ -182,7 +251,11 @@ def main() -> None:
     )
 
     # --- JAX auction (sharded across every device when more than one) ---
-    cfg = AuctionConfig(rounds=12)
+    # rounds=8 is the measured knee on the chip: vs rounds=12 it gives up
+    # 19 of 45,405 placed jobs (-0.04%, still ~500 above the greedy
+    # baseline) for a 27% lower p50 — the stderr line below prints both
+    # placement counts so the tradeoff stays visible in every run
+    cfg = AuctionConfig(rounds=8)
     if n_dev > 1:
         from slurm_bridge_tpu.solver.sharded import sharded_place
 
@@ -213,6 +286,10 @@ def main() -> None:
             "unit": "pods/s",
             "vs_baseline": round(t_greedy / t_auction, 2),
             "backend": backend,
+            # BASELINE.md's other headline: <200 ms p50 solve latency —
+            # measured, not implied (VERDICT r2 weak #6)
+            "p50_ms": round(t_auction, 1),
+            "p50_target_ms": 200,
         }
     )
 
@@ -225,4 +302,4 @@ if __name__ == "__main__":
 
         traceback.print_exc()
         _emit(dict(_PARTIAL, note=f"error: {type(exc).__name__}: {exc}"))
-        sys.exit(0)
+        sys.exit(2)  # the JSON line is out, but this run is NOT a success

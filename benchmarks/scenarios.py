@@ -146,6 +146,19 @@ def main(argv: list[str] | None = None) -> None:
         f"# backend={jax.default_backend()} devices={len(jax.devices())}",
         file=sys.stderr,
     )
+    if "--stages" in argv:
+        # per-stage timing of one auction round at scenario-#3 shape — the
+        # optimization lens (see benchmarks/stages.py for the stage defs)
+        from benchmarks.stages import profile_stages
+
+        snap, batch = random_scenario(
+            10_000, 50_000, seed=42, load=0.7, gpu_fraction=0.15,
+            gang_fraction=0.05,
+        )
+        out = profile_stages(snap, batch, AuctionConfig(rounds=12))
+        out["scenario"] = "3-stages"
+        print(json.dumps(out) if as_json else f"stages: {out}")
+        return
     for k in picks:
         out = SCENARIOS[k]()
         print(json.dumps(out) if as_json else f"scenario {k}: {out}")

@@ -1,0 +1,238 @@
+"""Per-stage timing of one auction round — the round-3 optimization lens.
+
+VERDICT r2 #5: nothing measured score/choose vs admit vs price, so the
+optimization target was invisible. This module times each stage of
+``auction._auction_kernel``'s round body as an independently-jitted
+function over scenario-shaped inputs:
+
+    python -m benchmarks.stages            # scenario #3 shape (50k×10k)
+    python -m benchmarks.stages --small    # scenario #2 shape (5k×512)
+
+Each stage is timed with its inputs already device-resident and its output
+blocked on, so the numbers are stage cost, not transfer cost. The "round"
+row times the real fused round body for comparison — the stage sum should
+roughly match it (XLA fuses less across our stage boundaries than inside
+the full kernel, so the sum is an upper bound).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from slurm_bridge_tpu.solver.auction import (
+    AuctionConfig,
+    CandidatePools,
+    _auction_kernel,
+    admit_preordered,
+    gang_dedup,
+    hash_jitter,
+    multi_mask,
+    normalize_gangs,
+    price_step,
+    prio_rank_order,
+    resolve_candidates,
+    resource_scale,
+    used_capacity,
+)
+from slurm_bridge_tpu.solver.snapshot import random_scenario
+
+
+def _t(fn, *args, iters=10, warmup=2) -> float:
+    """Median wall ms of ``fn(*args)`` with device sync."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(ts))
+
+
+def profile_stages(snap, batch, cfg: AuctionConfig, *, iters: int = 10) -> dict:
+    from slurm_bridge_tpu.parallel.backend import ensure_backend
+
+    backend = ensure_backend()
+    p = batch.num_shards
+    n = snap.num_nodes
+    k = resolve_candidates(cfg, backend, p, n)
+    scale = resource_scale(snap)
+
+    free0 = jnp.asarray(snap.free)
+    node_part = jnp.asarray(snap.partition_of)
+    node_feat = jnp.asarray(snap.features)
+    dem = jnp.asarray(batch.demand)
+    job_part = jnp.asarray(batch.partition_of)
+    req_feat = jnp.asarray(batch.req_features)
+    prio = jnp.asarray(batch.priority)
+    gang = jnp.asarray(normalize_gangs(batch.gang_id))
+    dscale = jnp.asarray(scale)
+    dem_n = dem * dscale
+    incumbent = jnp.full(p, -1, jnp.int32)
+
+    # a representative mid-solve state: round 0's choices against free0
+    multi = jax.jit(multi_mask, static_argnums=1)(gang, p)
+    assign = jnp.full(p, -1, jnp.int32)
+    price = jnp.zeros(n, jnp.float32)
+
+    # ---- stage: score + choose ----
+    if k > 0:
+        pools = CandidatePools(snap)
+        samp_start_np, samp_count_np = pools.slices(batch)
+        order = jnp.asarray(pools.array)
+        samp_start = jnp.asarray(samp_start_np)
+        samp_count = jnp.asarray(samp_count_np)
+
+        @jax.jit
+        def score_choose(free, price):
+            from slurm_bridge_tpu.solver.auction import _mix, _unit
+
+            kk = k
+            pi = jax.lax.broadcasted_iota(jnp.uint32, (p, kk), 0)
+            ki = jax.lax.broadcasted_iota(jnp.uint32, (p, kk), 1)
+            salt = jnp.uint32(1)
+            draw = _mix(pi, ki, salt * jnp.uint32(0x68E31DA4) + jnp.uint32(0x1B56C4E9))
+            cnt = jnp.maximum(samp_count, 1).astype(jnp.uint32)
+            idx = samp_start[:, None] + (draw % cnt[:, None]).astype(jnp.int32)
+            cand = order[jnp.clip(idx, 0, order.shape[0] - 1)]
+            part_ok = (job_part[:, None] == node_part[cand]) | (job_part[:, None] < 0)
+            feat_ok = (node_feat[cand] & req_feat[:, None]) == req_feat[:, None]
+            freec = free[cand]
+            cap_ok = jnp.all(dem[:, None, :] <= freec + 1e-6, axis=-1)
+            feas = (samp_count > 0)[:, None] & part_ok & feat_ok & cap_ok
+            bid = _unit(_mix(pi, cand.astype(jnp.uint32), salt), jnp.float32)
+            bid = jnp.where(feas, bid - price[cand], -jnp.inf)
+            kbest = jnp.argmax(bid, axis=1)
+            choice = jnp.take_along_axis(cand, kbest[:, None], axis=1)[:, 0]
+            best = jnp.take_along_axis(bid, kbest[:, None], axis=1)[:, 0]
+            return choice, best
+    elif backend == "tpu":
+        # the kernel's real TPU path: the fused pallas tile-streaming
+        # score/argmax (no [P, N] intermediates in HBM)
+        from slurm_bridge_tpu.ops.bid_argmax import bid_argmax
+
+        @jax.jit
+        def score_choose(free, price):
+            best, choice = bid_argmax(
+                free, node_part, node_feat, price,
+                dem, job_part, req_feat, incumbent,
+                dem * dscale, free * dscale, 1,
+                jitter=cfg.jitter, affinity_weight=cfg.affinity_weight,
+                num_nodes=n, interpret=False,
+            )
+            return choice, best
+    else:
+
+        @jax.jit
+        def score_choose(free, price):
+            cap_ok = jnp.all(dem[:, None, :] <= free[None, :, :] + 1e-6, axis=-1)
+            part_ok = (job_part[:, None] == node_part[None, :]) | (
+                job_part[:, None] < 0
+            )
+            feat_ok = (node_feat[None, :] & req_feat[:, None]) == req_feat[:, None]
+            bid = hash_jitter(p, n, 1, jnp.float32) - price[None, :]
+            bid = jnp.where(part_ok & feat_ok & cap_ok, bid, -jnp.inf)
+            choice = jnp.argmax(bid, axis=1).astype(jnp.int32)
+            best = jnp.take_along_axis(bid, choice[:, None], axis=1)[:, 0]
+            return choice, best
+
+    choice0, best0 = score_choose(free0, price)
+    valid0 = jnp.isfinite(best0)
+    choice0 = jnp.where(valid0 & (choice0 < n), choice0, n)
+
+    dedup = jax.jit(partial(gang_dedup, n=n))
+    admit_j = jax.jit(partial(admit_preordered, n=n))
+    price_j = jax.jit(partial(price_step, n=n, eta=cfg.eta))
+    used_j = jax.jit(partial(used_capacity, n=n))
+    # constant across rounds — hoisted in the kernel, so timed separately
+    prio_order = jax.jit(prio_rank_order)(prio)
+
+    choice1, valid1 = dedup(choice0, valid0, assign, gang, multi)
+
+    out = {
+        "backend": backend,
+        "shape": f"{p}x{n}",
+        "candidates": k,
+        "score_choose_ms": round(_t(score_choose, free0, price, iters=iters), 2),
+        "gang_dedup_ms": round(
+            _t(lambda: dedup(choice0, valid0, assign, gang, multi), iters=iters), 2
+        ),
+        "admit_ms": round(
+            _t(lambda: admit_j(choice1, valid1, dem, prio_order, free0), iters=iters),
+            2,
+        ),
+        "prio_presort_ms": round(
+            _t(lambda: jax.jit(prio_rank_order)(prio), iters=iters), 2
+        ),
+        "price_ms": round(
+            _t(
+                lambda: price_j(price, choice1, valid1, dem_n, free0, dscale),
+                iters=iters,
+            ),
+            2,
+        ),
+        "used_capacity_ms": round(_t(lambda: used_j(dem, assign), iters=iters), 2),
+    }
+
+    # the fused full solve, per-round (amortizes host round-trips)
+    dummy = (
+        jnp.zeros(1, jnp.int32),
+        jnp.zeros(1, jnp.int32),
+        jnp.zeros(1, jnp.int32),
+    )
+    if k > 0:
+        order_a, start_a, count_a = (
+            order,
+            samp_start,
+            samp_count,
+        )
+    else:
+        order_a, start_a, count_a = dummy
+
+    # the round marginal must time the SHIPPED path: pallas on TPU when the
+    # full argmax is in play, the jnp/sampled form elsewhere
+    use_pallas = k == 0 and backend == "tpu"
+
+    def full(rounds):
+        a, _ = _auction_kernel(
+            free0, node_part, node_feat, dem, job_part, req_feat, prio, gang,
+            dscale, incumbent, order_a, start_a, count_a,
+            rounds=rounds, num_nodes=n, eta=cfg.eta, jitter=cfg.jitter,
+            affinity_weight=cfg.affinity_weight, dtype=jnp.float32,
+            use_pallas=use_pallas, interpret=False,
+            gang_salvage_rounds=cfg.gang_salvage_rounds,
+            gang_first=cfg.gang_first, candidates=k,
+        )
+        return a
+    t1 = _t(full, 1, iters=max(3, iters // 2))
+    t5 = _t(full, 5, iters=max(3, iters // 2))
+    out["round_ms"] = round((t5 - t1) / 4, 2)  # marginal per-round cost
+    out["stage_sum_ms"] = round(
+        out["score_choose_ms"] + out["gang_dedup_ms"] + out["admit_ms"]
+        + out["price_ms"] + out["used_capacity_ms"], 2,
+    )
+    return out
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--small" in argv:
+        snap, batch = random_scenario(512, 5_000, seed=2, load=0.7)
+        cfg = AuctionConfig(rounds=8)
+    else:
+        snap, batch = random_scenario(
+            10_000, 50_000, seed=42, load=0.7, gpu_fraction=0.15, gang_fraction=0.05
+        )
+        cfg = AuctionConfig(rounds=12)
+    print(json.dumps(profile_stages(snap, batch, cfg)))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,16 @@ def main(argv: list[str] | None = None) -> int:
                              "free port; a config-file port of 0 means disabled")
     parser.add_argument("--kubelet-config", default="",
                         help="virtual-node configuration YAML (ports, TLS, sync)")
+    parser.add_argument("--kube-api", default="",
+                        help="Kubernetes apiserver URL to watch SlurmBridgeJob "
+                             "CRs on (e.g. https://10.0.0.1:443, or "
+                             "'in-cluster' for the ServiceAccount env); "
+                             "empty = no K8s edge")
+    parser.add_argument("--kube-namespace", default="default")
+    parser.add_argument("--kube-token-file", default="",
+                        help="bearer-token file for --kube-api")
+    parser.add_argument("--kube-ca-file", default="",
+                        help="CA bundle for --kube-api TLS")
     add_observability_flags(parser, metrics_port_default=8080)
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
@@ -99,9 +109,33 @@ def main(argv: list[str] | None = None) -> int:
 
     fatal: list[BaseException] = []
 
+    kube_adapter = [None]
+
+    def start_kube_adapter() -> None:
+        if not args.kube_api:
+            return
+        from slurm_bridge_tpu.bridge.kubeapi import KubeApiAdapter, KubeConfig
+
+        if args.kube_api == "in-cluster":
+            cfg = KubeConfig.in_cluster()
+        else:
+            token = ""
+            if args.kube_token_file:
+                with open(args.kube_token_file) as f:
+                    token = f.read().strip()
+            cfg = KubeConfig(
+                base_url=args.kube_api,
+                namespace=args.kube_namespace,
+                token=token,
+                ca_file=args.kube_ca_file,
+            )
+        kube_adapter[0] = KubeApiAdapter(bridge, cfg).start()
+        log.info("watching SlurmBridgeJob CRs on %s", cfg.base_url)
+
     def start_components() -> None:
         try:
             bridge.start()
+            start_kube_adapter()
         except BaseException as exc:
             # Failing to start after winning the election must terminate the
             # daemon (as it would without election), not strand a zombie
@@ -129,6 +163,8 @@ def main(argv: list[str] | None = None) -> int:
     stop.wait()
     log.info("shutting down")
     ready.clear()
+    if kube_adapter[0] is not None:
+        kube_adapter[0].stop()
     bridge.stop()
     if elector is not None:
         elector.stop()

@@ -194,9 +194,16 @@ class PlacementScheduler:
             demands.append(d)
         n_pending = len(pods)
         if self._remote is not None:
-            by_job_names, lost_jobs = self._solve_remote(
+            solved = self._solve_remote(
                 partitions, nodes, demands, all_pods, n_pending
             )
+            if solved is None:
+                # sidecar unreachable: genuinely skip the tick — binding
+                # nothing is right, but marking pods Unschedulable (a
+                # capacity verdict) or preempting would be a false
+                # diagnosis; the level-triggered loop retries next tick
+                return 0
+            by_job_names, lost_jobs = solved
         else:
             by_job_names, lost_jobs = self._solve_local(
                 partitions, nodes, demands, all_pods, n_pending
@@ -296,7 +303,7 @@ class PlacementScheduler:
 
     def _solve_remote(
         self, partitions, nodes, demands, all_pods, n_pending
-    ) -> tuple[dict[int, list[str]], list[int]]:
+    ) -> tuple[dict[int, list[str]], list[int]] | None:
         """Out-of-process solve via the PlacementSolver sidecar.
 
         The sidecar owns the streaming-incumbent semantics (release usage,
@@ -331,10 +338,8 @@ class PlacementScheduler:
                 timeout=self.place_timeout,
             )
         except grpc.RpcError as e:
-            # fail open: place nothing, preempt nobody; the level-triggered
-            # loop retries next tick (same posture as an agent outage)
             log.warning("remote Place failed (%s); skipping tick", e.code())
-            return {}, []
+            return None  # tick() skips binding/preemption entirely
         by_job_names = {
             int(a.job_id): list(a.node_names)
             for a in resp.assignments

@@ -40,9 +40,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 from slurm_bridge_tpu.solver.snapshot import NUM_RES
 
-#: Pod rows per tile (sublanes) and nodes per tile (lanes).
-BP = 256
-BN = 512
+import os
+
+#: Pod rows per tile (sublanes) and nodes per tile (lanes). Env-overridable
+#: so the block shape can be swept on real hardware without code edits
+#: (benchmarks/stages.py reports the marginal round cost per shape).
+#: Defaults are the measured v5e optimum: sweeping BN 512→2048 cut the
+#: 57k×10k solve p50 ~18% (250→206 ms at rounds=8); wider than 4096 and
+#: larger BP plateau within noise.
+BP = int(os.environ.get("SBT_PALLAS_BP", "512"))
+BN = int(os.environ.get("SBT_PALLAS_BN", "2048"))
 
 _NEG_INF = float("-inf")  # python literal: jnp scalars become captured consts
 

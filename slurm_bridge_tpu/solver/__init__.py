@@ -16,6 +16,8 @@ Solver paths (BASELINE.md scenarios):
 - ``sharded``       shard_map/psum multi-device sweep
 - ``streaming``     warm-start re-solve with incumbents pinned — stability,
                     preemption and 1k/s churn (BASELINE config #5)
+- ``service``       the solver as a gRPC sidecar (``sbt-solver``; SURVEY §7
+                    item 4) — dialed by the bridge via --scheduler-endpoint
 """
 
 from slurm_bridge_tpu.solver.snapshot import (

@@ -181,6 +181,17 @@ def used_capacity(dem: jnp.ndarray, assign: jnp.ndarray, n: int) -> jnp.ndarray:
     )
 
 
+def _multi_key_order(*keys):
+    """Stable ascending order by lexicographic ``keys`` via one
+    ``lax.sort(num_keys=k)``. Fewer/narrower keys mean fewer comparator
+    ops — the admission/dedup sorts are ~half the auction round's cost on
+    CPU (benchmarks/stages.py), and sort is a known-weak op on TPU."""
+    p = keys[0].shape[0]
+    iota = jax.lax.iota(jnp.int32, p)
+    out = jax.lax.sort((*keys, iota), num_keys=len(keys), is_stable=True)
+    return out[-1]
+
+
 def gang_dedup(choice, valid, assign, gang, multi, n):
     """Enforce distinct-nodes within a gang: among shards of one gang
     targeting the same node this round (or a node a sibling already holds),
@@ -188,8 +199,9 @@ def gang_dedup(choice, valid, assign, gang, multi, n):
     p = choice.shape[0]
     unplaced = assign < 0
     eff = jnp.where(assign >= 0, assign, choice)  # node or sentinel n
-    # primary key gang, then node, with already-placed rows sorting first
-    order = jnp.lexsort((unplaced.astype(jnp.int32), eff, gang))
+    # primary key gang, then node, with already-placed rows sorting first;
+    # (eff, unplaced) pack into one int32 key (eff ≤ n < 2^30)
+    order = _multi_key_order(gang, (eff << 1) | unplaced.astype(jnp.int32))
     g_s = gang[order]
     e_s = eff[order]
     dup_s = (
@@ -206,8 +218,23 @@ def gang_dedup(choice, valid, assign, gang, multi, n):
 
 def admit(choice, valid, dem, prio, free, n):
     """Per-node priority-ordered prefix admission. Returns admitted [P] bool."""
+    return admit_preordered(choice, valid, dem, prio_rank_order(prio), free, n)
+
+
+def prio_rank_order(prio):
+    """Priority-descending stable row order — constant across rounds, so
+    the kernels hoist it out of the ``fori_loop`` and each round's
+    admission sorts by ONE int32 key instead of (choice, -prio): a stable
+    primary-key sort over secondary-preordered rows IS the lexicographic
+    order, and the float comparator was ~a third of the sort's cost."""
+    return _multi_key_order(-prio)
+
+
+def admit_preordered(choice, valid, dem, prio_order, free, n):
+    """:func:`admit` with the priority presort (``prio_rank_order``) done."""
     p = choice.shape[0]
-    order = jnp.lexsort((-prio, choice))
+    sub = _multi_key_order(choice[prio_order])
+    order = prio_order[sub]
     c_sorted = choice[order]
     d_sorted = jnp.where(valid[order, None], dem[order], 0.0)
     seg_first = jnp.concatenate([jnp.ones((1,), bool), c_sorted[1:] != c_sorted[:-1]])
@@ -253,7 +280,7 @@ def multi_mask(gang: jnp.ndarray, p: int) -> jnp.ndarray:
     static_argnames=(
         "rounds", "num_nodes", "eta", "jitter", "affinity_weight", "dtype",
         "use_pallas", "interpret", "gang_salvage_rounds", "gang_first",
-        "candidates",
+        "candidates", "has_gangs",
     ),
 )
 def _auction_kernel(
@@ -283,6 +310,9 @@ def _auction_kernel(
     gang_salvage_rounds: int = AuctionConfig.gang_salvage_rounds,
     gang_first: bool = AuctionConfig.gang_first,
     candidates: int = 0,
+    #: statically False when no gang spans >1 shard — skips the dedup sort
+    #: and the revoke segment-sums, ~20% of a no-gang round's cost
+    has_gangs: bool = True,
 ):
     p = dem.shape[0]
     n = num_nodes
@@ -304,17 +334,23 @@ def _auction_kernel(
         static_ok = part_ok & feat_ok  # [P, N] bool
         own = jax.lax.broadcasted_iota(jnp.int32, (p, n), 1) == incumbent[:, None]
         static_ok = jnp.where(inc[:, None], own & static_ok, static_ok)
-    multi = multi_mask(gang, p)
+    multi = multi_mask(gang, p) if has_gangs else jnp.zeros((p,), bool)
     # admission-ordering priority; only the kernel sees the gang-first boost
-    prio_eff = prio + multi.astype(jnp.float32) * (1e4 if gang_first else 0.0)
+    prio_eff = prio + multi.astype(jnp.float32) * (
+        1e4 if gang_first and has_gangs else 0.0
+    )
 
     salvage_start = rounds - min(gang_salvage_rounds, max(0, rounds - 1))
+    prio_order = prio_rank_order(prio_eff)  # constant: hoisted out of the loop
 
     def round_body(rnd, carry):
         assign, price = carry
         # salvage phase: incomplete gangs release their capacity up front
         # so the remaining rounds can re-bid it (see AuctionConfig)
-        assign = jnp.where(rnd >= salvage_start, gang_revoke(assign, gang, p), assign)
+        if has_gangs:
+            assign = jnp.where(
+                rnd >= salvage_start, gang_revoke(assign, gang, p), assign
+            )
         free = free0 - used_capacity(dem, assign, n)
 
         if candidates > 0:
@@ -391,8 +427,9 @@ def _auction_kernel(
         valid = unplaced & jnp.isfinite(best.astype(jnp.float32))
         choice = jnp.where(valid & (choice < n), choice, n)  # sentinel segment n
 
-        choice, valid = gang_dedup(choice, valid, assign, gang, multi, n)
-        admitted = admit(choice, valid, dem, prio_eff, free, n)
+        if has_gangs:
+            choice, valid = gang_dedup(choice, valid, assign, gang, multi, n)
+        admitted = admit_preordered(choice, valid, dem, prio_order, free, n)
         assign = jnp.where(
             admitted & unplaced, jnp.where(choice < n, choice, -1), assign
         )
@@ -403,7 +440,8 @@ def _auction_kernel(
     price0 = jnp.zeros((n,), jnp.float32)
     assign, _ = jax.lax.fori_loop(0, rounds, round_body, (assign0, price0))
 
-    assign = gang_revoke(assign, gang, p)
+    if has_gangs:
+        assign = gang_revoke(assign, gang, p)
     return assign, free0 - used_capacity(dem, assign, n)
 
 
@@ -543,6 +581,15 @@ def normalize_gangs(gang: np.ndarray) -> np.ndarray:
     return inverse.astype(np.int32)
 
 
+def batch_has_gangs(gang_norm: np.ndarray) -> bool:
+    """True if any gang spans more than one shard. Host-side and cheap, it
+    feeds the kernel's static ``has_gangs`` so the common no-gang tick
+    compiles without the dedup sort or revoke segment-sums at all."""
+    if gang_norm.size == 0:
+        return False
+    return bool(np.bincount(gang_norm).max() > 1)
+
+
 def auction_place(
     snapshot: ClusterSnapshot,
     batch: JobBatch,
@@ -589,6 +636,7 @@ def auction_place(
         order = np.zeros(1, np.int32)
         samp_start = np.zeros(1, np.int32)
         samp_count = np.zeros(1, np.int32)
+    gang_norm = normalize_gangs(batch.gang_id)
     assign, free_after = _auction_kernel(
         jnp.asarray(snapshot.free),
         jnp.asarray(snapshot.partition_of),
@@ -597,7 +645,7 @@ def auction_place(
         jnp.asarray(batch.partition_of),
         jnp.asarray(batch.req_features),
         jnp.asarray(batch.priority),
-        jnp.asarray(normalize_gangs(batch.gang_id)),
+        jnp.asarray(gang_norm),
         jnp.asarray(scale),
         jnp.asarray(incumbent, dtype=jnp.int32),
         jnp.asarray(order),
@@ -614,6 +662,7 @@ def auction_place(
         gang_salvage_rounds=cfg.gang_salvage_rounds,
         gang_first=cfg.gang_first,
         candidates=k,
+        has_gangs=batch_has_gangs(gang_norm),
     )
     assign_np = np.asarray(assign)
     return Placement(

@@ -27,6 +27,7 @@ from slurm_bridge_tpu.solver.auction import (
     AuctionConfig,
     CandidatePools,
     _auction_kernel,
+    batch_has_gangs,
     normalize_gangs,
     resolve_candidates,
     resource_scale,
@@ -140,6 +141,7 @@ class DeviceSolver:
             samp_start = np.zeros(1, np.int32)
             samp_count = np.zeros(1, np.int32)
             dev_order = jnp.zeros(1, jnp.int32)
+        gang_norm = normalize_gangs(batch.gang_id)
         assign, _free_after = _auction_kernel(
             self._dev_free,
             self._dev_part,
@@ -148,7 +150,7 @@ class DeviceSolver:
             jnp.asarray(batch.partition_of),
             jnp.asarray(batch.req_features),
             jnp.asarray(batch.priority),
-            jnp.asarray(normalize_gangs(batch.gang_id)),
+            jnp.asarray(gang_norm),
             self._dev_scale,
             jnp.asarray(incumbent, dtype=jnp.int32),
             dev_order,
@@ -163,6 +165,7 @@ class DeviceSolver:
             use_pallas=self._use_pallas if k == 0 else False,
             interpret=self._interpret if k == 0 else False,
             candidates=k,
+            has_gangs=batch_has_gangs(gang_norm),
         )
         try:  # overlap the device→host copy with whatever the caller does next
             assign.copy_to_host_async()

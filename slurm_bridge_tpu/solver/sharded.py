@@ -33,13 +33,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from slurm_bridge_tpu.parallel.mesh import pad_to_multiple, solver_mesh
 from slurm_bridge_tpu.solver.auction import (
     AuctionConfig,
-    admit,
+    admit_preordered,
+    batch_has_gangs,
     gang_dedup,
     gang_revoke,
     hash_jitter,
     multi_mask,
     normalize_gangs,
     price_step,
+    prio_rank_order,
     resource_scale,
     used_capacity,
 )
@@ -51,7 +53,7 @@ _PAD_PART = np.int32(2**30)
 @lru_cache(maxsize=32)
 def _make_sharded_kernel(
     mesh: Mesh, rounds: int, n_total: int, eta, jitter, affinity_weight, dtype,
-    gang_salvage_rounds: int, gang_first: bool,
+    gang_salvage_rounds: int, gang_first: bool, has_gangs: bool,
 ):
     """Build + jit the sharded kernel once per (mesh, shape, config) — a
     fresh closure per call would force full XLA recompilation every tick."""
@@ -97,11 +99,14 @@ def _make_sharded_kernel(
         gang = jax.lax.all_gather(gang_blk, "dp", tiled=True)
         free0 = jax.lax.all_gather(free0_blk, "mp", tiled=True)  # [N, R]
         p = dem.shape[0]
-        multi = multi_mask(gang, p)
-        prio_eff = prio + multi.astype(jnp.float32) * (1e4 if gang_first else 0.0)
+        multi = multi_mask(gang, p) if has_gangs else jnp.zeros((p,), bool)
+        prio_eff = prio + multi.astype(jnp.float32) * (
+            1e4 if gang_first and has_gangs else 0.0
+        )
         dem_n_blk = (dem_blk * scale).astype(dtype)
         dem_n = (dem * scale).astype(dtype)
         salvage_start = rounds - min(gang_salvage_rounds, max(0, rounds - 1))
+        prio_order = prio_rank_order(prio_eff)  # constant: hoisted from loop
 
         # static local feasibility block
         part_ok = (job_part_blk[:, None] == node_part_blk[None, :]) | (
@@ -120,9 +125,10 @@ def _make_sharded_kernel(
         def round_body(rnd, carry):
             assign, price = carry  # replicated [P], [N]
             # salvage phase mirrors the single-device kernel (auction.py)
-            assign = jnp.where(
-                rnd >= salvage_start, gang_revoke(assign, gang, p), assign
-            )
+            if has_gangs:
+                assign = jnp.where(
+                    rnd >= salvage_start, gang_revoke(assign, gang, p), assign
+                )
             free = free0 - used_capacity(dem, assign, n)  # replicated, no comms
             free_blk = jax.lax.dynamic_slice_in_dim(free, n_off, nblk, axis=0)
             price_blk = jax.lax.dynamic_slice_in_dim(price, n_off, nblk, axis=0)
@@ -159,8 +165,9 @@ def _make_sharded_kernel(
             valid = unplaced & jnp.isfinite(bval_full)
             choice = jnp.where(valid, choice, n)
 
-            choice, valid = gang_dedup(choice, valid, assign, gang, multi, n)
-            admitted = admit(choice, valid, dem, prio_eff, free, n)
+            if has_gangs:
+                choice, valid = gang_dedup(choice, valid, assign, gang, multi, n)
+            admitted = admit_preordered(choice, valid, dem, prio_order, free, n)
             assign = jnp.where(
                 admitted & unplaced, jnp.where(choice < n, choice, -1), assign
             )
@@ -170,7 +177,8 @@ def _make_sharded_kernel(
         assign0 = jnp.full((p,), -1, jnp.int32)
         price0 = jnp.zeros((n,), jnp.float32)
         assign, _ = jax.lax.fori_loop(0, rounds, round_body, (assign0, price0))
-        assign = gang_revoke(assign, gang, p)
+        if has_gangs:
+            assign = gang_revoke(assign, gang, p)
         free_after = free0 - used_capacity(dem, assign, n)
         return assign, free_after
 
@@ -220,6 +228,7 @@ def sharded_place(
     kernel = _make_sharded_kernel(
         mesh, cfg.rounds, n_total, cfg.eta, cfg.jitter, cfg.affinity_weight, dtype,
         cfg.gang_salvage_rounds, cfg.gang_first,
+        batch_has_gangs(gang[:p_real]),
     )
     with jax.set_mesh(mesh):
         assign, free_after = kernel(

@@ -6,20 +6,26 @@ platform before anything imports jax, per the driver contract.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# SBT_TEST_TPU=1 lets the chip-only tests (e.g. compiled-pallas parity in
+# test_ops.py) run on real hardware: `SBT_TEST_TPU=1 pytest tests/test_ops.py`
+_use_tpu = os.environ.get("SBT_TEST_TPU") == "1"
+
+if not _use_tpu:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 # The image's sitecustomize may have imported jax already (pinning the
 # platform from the env before we could touch it) — override via config,
 # which works as long as no backend has been initialised yet.
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+if not _use_tpu:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 
 import pathlib
 
