@@ -218,7 +218,11 @@ def _steady_state_ms(fn, *, warmup: int = 1, iters: int = 5) -> float:
 
 
 def main() -> None:
-    _start_watchdog(1500.0)
+    # the watchdog must outlive one full probe budget plus the solve —
+    # a fixed constant would silently cut SBT_BENCH_TPU_BUDGET short,
+    # skipping the promised stack dump / re-exec attempts
+    budget = float(os.environ.get("SBT_BENCH_TPU_BUDGET", "600"))
+    _start_watchdog(budget + 900.0)
     backend = _acquire_backend()
 
     import jax

@@ -84,6 +84,8 @@ def profile_stages(snap, batch, cfg: AuctionConfig, *, iters: int = 10) -> dict:
 
     # ---- stage: score + choose ----
     if k > 0:
+        from slurm_bridge_tpu.solver.auction import sampled_score_choose
+
         pools = CandidatePools(snap)
         samp_start_np, samp_count_np = pools.slices(batch)
         order = jnp.asarray(pools.array)
@@ -92,27 +94,16 @@ def profile_stages(snap, batch, cfg: AuctionConfig, *, iters: int = 10) -> dict:
 
         @jax.jit
         def score_choose(free, price):
-            from slurm_bridge_tpu.solver.auction import _mix, _unit
-
-            kk = k
-            pi = jax.lax.broadcasted_iota(jnp.uint32, (p, kk), 0)
-            ki = jax.lax.broadcasted_iota(jnp.uint32, (p, kk), 1)
-            salt = jnp.uint32(1)
-            draw = _mix(pi, ki, salt * jnp.uint32(0x68E31DA4) + jnp.uint32(0x1B56C4E9))
-            cnt = jnp.maximum(samp_count, 1).astype(jnp.uint32)
-            idx = samp_start[:, None] + (draw % cnt[:, None]).astype(jnp.int32)
-            cand = order[jnp.clip(idx, 0, order.shape[0] - 1)]
-            part_ok = (job_part[:, None] == node_part[cand]) | (job_part[:, None] < 0)
-            feat_ok = (node_feat[cand] & req_feat[:, None]) == req_feat[:, None]
-            freec = free[cand]
-            cap_ok = jnp.all(dem[:, None, :] <= freec + 1e-6, axis=-1)
-            feas = (samp_count > 0)[:, None] & part_ok & feat_ok & cap_ok
-            bid = _unit(_mix(pi, cand.astype(jnp.uint32), salt), jnp.float32)
-            bid = jnp.where(feas, bid - price[cand], -jnp.inf)
-            kbest = jnp.argmax(bid, axis=1)
-            choice = jnp.take_along_axis(cand, kbest[:, None], axis=1)[:, 0]
-            best = jnp.take_along_axis(bid, kbest[:, None], axis=1)[:, 0]
-            return choice, best
+            # the SHIPPED sampled path (auction.sampled_score_choose) —
+            # shared, so this timing can never drift from the kernel
+            return sampled_score_choose(
+                free, price, dem, dem_n, job_part, req_feat,
+                node_part, node_feat, incumbent,
+                order, samp_start, samp_count, 1,
+                candidates=k, jitter=cfg.jitter,
+                affinity_weight=cfg.affinity_weight, dtype=jnp.float32,
+                scale=dscale,
+            )
     elif backend == "tpu":
         # the kernel's real TPU path: the fused pallas tile-streaming
         # score/argmax (no [P, N] intermediates in HBM)

@@ -21,6 +21,7 @@ the standard in-cluster ServiceAccount mount when present.
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import ssl
@@ -29,6 +30,11 @@ import time
 import urllib.error
 import urllib.request
 from dataclasses import dataclass
+
+#: Everything a dying apiserver connection can throw at us. HTTPException
+#: covers mid-chunk stream deaths (IncompleteRead, BadStatusLine) that are
+#: NOT URLError/OSError — missing it killed the watch thread permanently.
+_NET_ERRORS = (urllib.error.URLError, OSError, http.client.HTTPException, ValueError)
 
 from slurm_bridge_tpu.bridge.objects import (
     BridgeJob,
@@ -297,7 +303,7 @@ class KubeApiAdapter:
                 self._synced.set()
                 rv = (listing.get("metadata") or {}).get("resourceVersion", "")
                 self._stream_watch(rv)
-            except (urllib.error.URLError, OSError, ValueError) as exc:
+            except _NET_ERRORS as exc:
                 if self._stop.is_set():
                     pass
                 elif isinstance(exc, TimeoutError) or "timed out" in str(exc):
@@ -369,7 +375,7 @@ class KubeApiAdapter:
                 content_type="application/merge-patch+json",
             ):
                 pass
-        except (urllib.error.URLError, OSError) as exc:
+        except _NET_ERRORS as exc:
             # level-triggered: the next status event retries; a dead
-            # apiserver must not wedge the bridge
+            # apiserver must not wedge the bridge (or kill its thread)
             log.warning("status PATCH for %s failed: %s", name, exc)

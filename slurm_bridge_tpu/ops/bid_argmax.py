@@ -56,6 +56,11 @@ _NEG_INF = float("-inf")  # python literal: jnp scalars become captured consts
 
 def _kernel(
     salt_ref,  # SMEM (1, 1) i32 — round salt for the jitter hash
+    base_ref,  # SMEM (1, 2) i32 — (p_base, n_base) global offsets of this
+    #            operand block: a sharded caller (solver/sharded.py) passes
+    #            its shard_map block's position so the jitter hash and the
+    #            returned node ids are GLOBAL — bit-identical to what the
+    #            single-device kernel computes for the same (shard, node)
     dem_ref,  # VMEM (BP, R) f32 — raw per-shard demand
     job_part_ref,  # VMEM (BP, 1) i32
     req_feat_ref,  # VMEM (BP, 1) u32
@@ -81,8 +86,8 @@ def _kernel(
         best_idx_ref[:] = jnp.full_like(best_idx_ref, num_nodes)  # sentinel
 
     i = pl.program_id(0)
-    p_off = i * BP
-    n_off = j * BN
+    p_off = base_ref[0, 0] + i * BP
+    n_off = base_ref[0, 1] + j * BN
 
     # ---- feasibility, all as [BP,1] × [1,BN] broadcasts ----
     jp = job_part_ref[:]  # [BP, 1]
@@ -144,6 +149,8 @@ def bid_argmax(
     dem_n,  # [P, R] f32 normalised demand (affinity)
     free_n,  # [N, R] normalised free (affinity; any float dtype)
     salt,  # scalar i32 round salt
+    p_base=0,  # global row offset of this block (sharded callers)
+    n_base=0,  # global node offset of this block (sharded callers)
     *,
     jitter: float,
     affinity_weight: float,
@@ -185,6 +192,7 @@ def bid_argmax(
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 2), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((BP, NUM_RES), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((BP, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((BP, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
@@ -207,6 +215,9 @@ def bid_argmax(
         interpret=interpret,
     )(
         jnp.asarray(salt, jnp.int32).reshape(1, 1),
+        jnp.stack(
+            [jnp.asarray(p_base, jnp.int32), jnp.asarray(n_base, jnp.int32)]
+        ).reshape(1, 2),
         dem,
         job_part.reshape(-1, 1),
         req_feat.reshape(-1, 1),

@@ -216,6 +216,57 @@ def gang_dedup(choice, valid, assign, gang, multi, n):
     return jnp.where(valid, choice, n), valid
 
 
+def sampled_score_choose(
+    free, price, dem, dem_n, job_part, req_feat,
+    node_part, node_feat, incumbent,
+    part_order, samp_start, samp_count, rnd,
+    *, candidates, jitter, affinity_weight, dtype, scale,
+):
+    """One power-of-K-choices score/choose step: each shard draws K
+    candidate nodes from its (partition, feature) slice of ``part_order``
+    and bids only on those — O(P·K) instead of O(P·N). At
+    ``affinity_weight=0`` a candidate's bid (jitter − price) is
+    bit-identical to what the full [P, N] path scores for the same
+    (shard, node, round). Returns (choice [P] i32, best [P] dtype).
+
+    Shared verbatim by the jitted kernel's candidate branch and the stage
+    profiler (benchmarks/stages.py) so the timed algorithm can never drift
+    from the shipped one.
+    """
+    p = dem.shape[0]
+    kk = candidates
+    neg_inf = jnp.float32(-jnp.inf)
+    inc = incumbent >= 0
+    pi = jax.lax.broadcasted_iota(jnp.uint32, (p, kk), 0)
+    ki = jax.lax.broadcasted_iota(jnp.uint32, (p, kk), 1)
+    salt = jnp.asarray(rnd, jnp.int32).astype(jnp.uint32)
+    # independent stream from the bid jitter (different salt mix)
+    draw = _mix(pi, ki, salt * jnp.uint32(0x68E31DA4) + jnp.uint32(0x1B56C4E9))
+    cnt = jnp.maximum(samp_count, 1).astype(jnp.uint32)
+    idx = samp_start[:, None] + (draw % cnt[:, None]).astype(jnp.int32)
+    pool_hi = part_order.shape[0] - 1  # pool is longer than N
+    cand = part_order[jnp.clip(idx, 0, pool_hi)]  # [P, K] node ids
+    cand = jnp.where(inc[:, None], incumbent[:, None], cand)
+    has_cand = (samp_count > 0) | inc  # [P]
+    part_ok_k = (job_part[:, None] == node_part[cand]) | (job_part[:, None] < 0)
+    feat_ok_k = (node_feat[cand] & req_feat[:, None]) == req_feat[:, None]
+    freec = free[cand]  # [P, K, R] gather
+    cap_ok_k = jnp.all(dem[:, None, :] <= freec + 1e-6, axis=-1)
+    feas = has_cand[:, None] & part_ok_k & feat_ok_k & cap_ok_k
+    jit_k = _unit(
+        _mix(pi, cand.astype(jnp.uint32), salt), dtype
+    ) * jnp.asarray(jitter, dtype)
+    bid = jit_k - price[cand].astype(dtype)
+    if affinity_weight:
+        aff = -(dem_n[:, None, :] * (freec * scale).astype(dtype)).sum(-1)
+        bid = bid + jnp.asarray(affinity_weight, dtype) * aff
+    bid = jnp.where(feas, bid, neg_inf)
+    kbest = jnp.argmax(bid, axis=1)
+    choice = jnp.take_along_axis(cand, kbest[:, None], axis=1)[:, 0]
+    best = jnp.take_along_axis(bid, kbest[:, None], axis=1)[:, 0]
+    return choice, best
+
+
 def admit(choice, valid, dem, prio, free, n):
     """Per-node priority-ordered prefix admission. Returns admitted [P] bool."""
     return admit_preordered(choice, valid, dem, prio_rank_order(prio), free, n)
@@ -354,44 +405,17 @@ def _auction_kernel(
         free = free0 - used_capacity(dem, assign, n)
 
         if candidates > 0:
-            # power-of-K-choices: each shard draws K candidate nodes from
-            # its (partition, feature) slice of ``part_order`` and bids only
-            # on those. At affinity_weight=0 a candidate's bid (jitter −
-            # price) is bit-identical to what the full [P, N] path scores
-            # for the same (shard, node, round), so sampling changes only
-            # which nodes get *looked at*; with affinity_weight ≠ 0 the
-            # affinity term is summed in a different association order and
-            # near-ties may resolve differently.
-            kk = candidates
-            pi = jax.lax.broadcasted_iota(jnp.uint32, (p, kk), 0)
-            ki = jax.lax.broadcasted_iota(jnp.uint32, (p, kk), 1)
-            salt = jnp.asarray(rnd, jnp.int32).astype(jnp.uint32)
-            # independent stream from the bid jitter (different salt mix)
-            draw = _mix(pi, ki, salt * jnp.uint32(0x68E31DA4) + jnp.uint32(0x1B56C4E9))
-            cnt = jnp.maximum(samp_count, 1).astype(jnp.uint32)
-            idx = samp_start[:, None] + (draw % cnt[:, None]).astype(jnp.int32)
-            pool_hi = part_order.shape[0] - 1  # pool is longer than N
-            cand = part_order[jnp.clip(idx, 0, pool_hi)]  # [P, K] node ids
-            cand = jnp.where(inc[:, None], incumbent[:, None], cand)
-            has_cand = (samp_count > 0) | inc  # [P]
-            part_ok_k = (job_part[:, None] == node_part[cand]) | (
-                job_part[:, None] < 0
+            # power-of-K-choices (sampled_score_choose): sampling changes
+            # only which nodes get *looked at*; with affinity_weight ≠ 0
+            # the affinity term is summed in a different association order
+            # than the full path and near-ties may resolve differently.
+            choice, best = sampled_score_choose(
+                free, price, dem, dem_n, job_part, req_feat,
+                node_part, node_feat, incumbent,
+                part_order, samp_start, samp_count, rnd,
+                candidates=candidates, jitter=jitter,
+                affinity_weight=affinity_weight, dtype=dtype, scale=scale,
             )
-            feat_ok_k = (node_feat[cand] & req_feat[:, None]) == req_feat[:, None]
-            freec = free[cand]  # [P, K, R] gather
-            cap_ok_k = jnp.all(dem[:, None, :] <= freec + 1e-6, axis=-1)
-            feas = has_cand[:, None] & part_ok_k & feat_ok_k & cap_ok_k
-            jit_k = _unit(
-                _mix(pi, cand.astype(jnp.uint32), salt), dtype
-            ) * jnp.asarray(jitter, dtype)
-            bid = jit_k - price[cand].astype(dtype)
-            if affinity_weight:
-                aff = -(dem_n[:, None, :] * (freec * scale).astype(dtype)).sum(-1)
-                bid = bid + jnp.asarray(affinity_weight, dtype) * aff
-            bid = jnp.where(feas, bid, neg_inf)
-            kbest = jnp.argmax(bid, axis=1)
-            choice = jnp.take_along_axis(cand, kbest[:, None], axis=1)[:, 0]
-            best = jnp.take_along_axis(bid, kbest[:, None], axis=1)[:, 0]
         elif use_pallas:
             # fused tile-streaming kernel: no [P, N] intermediates in HBM
             from slurm_bridge_tpu.ops.bid_argmax import bid_argmax

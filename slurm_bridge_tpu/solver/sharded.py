@@ -54,6 +54,7 @@ _PAD_PART = np.int32(2**30)
 def _make_sharded_kernel(
     mesh: Mesh, rounds: int, n_total: int, eta, jitter, affinity_weight, dtype,
     gang_salvage_rounds: int, gang_first: bool, has_gangs: bool,
+    use_pallas: bool, interpret: bool,
 ):
     """Build + jit the sharded kernel once per (mesh, shape, config) — a
     fresh closure per call would force full XLA recompilation every tick."""
@@ -135,21 +136,40 @@ def _make_sharded_kernel(
             free_n_blk = (free_blk * scale).astype(dtype)
 
             # ---- sharded P×N block: score + local argmax ----
-            cap_ok = jnp.all(dem_blk[:, None, :] <= free_blk[None, :, :] + 1e-6, -1)
-            feasible = static_ok & cap_ok
-            affinity = -(dem_n_blk @ free_n_blk.T)  # [P/dp, N/mp]
-            jit_mat = hash_jitter(
-                pblk, nblk, rnd, dtype, p_off=p_off, n_off=n_off
-            ) * jnp.asarray(jitter, dtype)
-            bid = (
-                jnp.asarray(affinity_weight, dtype) * affinity
-                + jit_mat
-                - price_blk[None, :].astype(dtype)
-            )
-            bid = jnp.where(feasible, bid, neg_inf)
-            lidx = jnp.argmax(bid, axis=1).astype(jnp.int32)  # [P/dp]
-            lval = jnp.take_along_axis(bid, lidx[:, None], axis=1)[:, 0]
-            gidx = n_off + lidx
+            if use_pallas:
+                # the fused tile-streaming kernel on the LOCAL block, with
+                # (p_off, n_off) passed through so the jitter hash and the
+                # returned ids are global — bit-identical to the
+                # single-device pallas path for the same (shard, node)
+                from slurm_bridge_tpu.ops.bid_argmax import bid_argmax
+
+                lval, gidx = bid_argmax(
+                    free_blk, node_part_blk, node_feat_blk, price_blk,
+                    dem_blk, job_part_blk, req_feat_blk, incumbent_blk,
+                    dem_n_blk.astype(jnp.float32),
+                    free_n_blk.astype(jnp.float32),
+                    rnd, p_base=p_off, n_base=n_off,
+                    jitter=jitter, affinity_weight=affinity_weight,
+                    num_nodes=n, interpret=interpret,
+                )
+            else:
+                cap_ok = jnp.all(
+                    dem_blk[:, None, :] <= free_blk[None, :, :] + 1e-6, -1
+                )
+                feasible = static_ok & cap_ok
+                affinity = -(dem_n_blk @ free_n_blk.T)  # [P/dp, N/mp]
+                jit_mat = hash_jitter(
+                    pblk, nblk, rnd, dtype, p_off=p_off, n_off=n_off
+                ) * jnp.asarray(jitter, dtype)
+                bid = (
+                    jnp.asarray(affinity_weight, dtype) * affinity
+                    + jit_mat
+                    - price_blk[None, :].astype(dtype)
+                )
+                bid = jnp.where(feasible, bid, neg_inf)
+                lidx = jnp.argmax(bid, axis=1).astype(jnp.int32)  # [P/dp]
+                lval = jnp.take_along_axis(bid, lidx[:, None], axis=1)[:, 0]
+                gidx = n_off + lidx
 
             # ---- winner across node blocks (all_gather over mp) ----
             vals = jax.lax.all_gather(lval.astype(jnp.float32), "mp")  # [mp, P/dp]
@@ -196,9 +216,17 @@ def sharded_place(
     """Solve one tick sharded over every available device."""
     from slurm_bridge_tpu.parallel.backend import ensure_backend
 
-    ensure_backend()  # hang-proof: a wedged accelerator degrades, not wedges
+    backend = ensure_backend()  # hang-proof: wedged accelerator degrades
     cfg = config or AuctionConfig()
     mesh = mesh or solver_mesh()
+    # per-block score/choose via the fused pallas kernel — same auto rule
+    # as the single-device path (auction_place): on for TPU, float32 only
+    use_pallas = cfg.use_pallas
+    if use_pallas is None:
+        use_pallas = backend == "tpu"
+    if use_pallas and cfg.dtype != "float32":
+        use_pallas = False
+    interpret = use_pallas and jax.default_backend() != "tpu"
     dp, mp = mesh.shape["dp"], mesh.shape["mp"]
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
@@ -229,6 +257,7 @@ def sharded_place(
         mesh, cfg.rounds, n_total, cfg.eta, cfg.jitter, cfg.affinity_weight, dtype,
         cfg.gang_salvage_rounds, cfg.gang_first,
         batch_has_gangs(gang[:p_real]),
+        use_pallas, interpret,
     )
     with jax.set_mesh(mesh):
         assign, free_after = kernel(

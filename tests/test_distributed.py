@@ -177,3 +177,20 @@ def test_scheduler_sharded_autoselect_threshold():
         assert sched_low._use_sharded(small_batch, small_snap)
     forced_off = PlacementScheduler(ObjectStore(), client=None, sharded=False)
     assert not forced_off._use_sharded(small_batch, small_snap)
+
+
+def test_sharded_pallas_block_path_matches_jnp():
+    """The sharded kernel's per-block pallas score/choose (used on TPU)
+    must place identically to its jnp block path: the kernel receives the
+    block's global (p_off, n_off), so the jitter hash is the same global
+    field both paths sample."""
+    from slurm_bridge_tpu.solver import AuctionConfig
+    from slurm_bridge_tpu.solver.sharded import sharded_place
+    from slurm_bridge_tpu.solver.snapshot import random_scenario
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-device CPU mesh")
+    snap, batch = random_scenario(48, 96, seed=23, load=0.6, gang_fraction=0.1)
+    jnp_path = sharded_place(snap, batch, AuctionConfig(rounds=3, use_pallas=False))
+    pallas_path = sharded_place(snap, batch, AuctionConfig(rounds=3, use_pallas=True))
+    np.testing.assert_array_equal(jnp_path.node_of, pallas_path.node_of)

@@ -336,8 +336,10 @@ def test_sharded_kernel_cached():
     import jax.numpy as jnp
 
     mesh = solver_mesh()
-    k1 = _make_sharded_kernel(mesh, 4, 16, 0.5, 1.0, 0.25, jnp.float32, 2, False, True)
-    k2 = _make_sharded_kernel(mesh, 4, 16, 0.5, 1.0, 0.25, jnp.float32, 2, False, True)
+    k1 = _make_sharded_kernel(mesh, 4, 16, 0.5, 1.0, 0.25, jnp.float32, 2, False,
+                              True, False, False)
+    k2 = _make_sharded_kernel(mesh, 4, 16, 0.5, 1.0, 0.25, jnp.float32, 2, False,
+                              True, False, False)
     assert k1 is k2
 
 
