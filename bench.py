@@ -150,7 +150,13 @@ def _acquire_backend() -> str:
 
     attempt = int(os.environ.get(_ATTEMPT_ENV, "1"))
     max_attempts = int(os.environ.get("SBT_BENCH_TPU_ATTEMPTS", "3"))
-    budget = float(os.environ.get("SBT_BENCH_TPU_BUDGET", "600"))
+    # halve the budget per attempt (600 → 300 → 150 by default): the first
+    # window is generous, but a wedge that survived it rarely clears, and
+    # the total must leave room for the forced-CPU solve inside whatever
+    # patience the outer harness has
+    budget = float(os.environ.get("SBT_BENCH_TPU_BUDGET", "600")) / (
+        2 ** (attempt - 1)
+    )
     result: dict = {}
 
     def _probe() -> None:

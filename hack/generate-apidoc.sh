@@ -1,0 +1,7 @@
+#!/bin/sh
+# Regenerate docs/api.md from the wire descriptor + CLI surfaces.
+# Reference parity: hack/generate-apidoc.sh.
+set -eu
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS=cpu python hack/gen_apidoc.py > docs/api.md
+echo "regenerated docs/api.md"

@@ -227,7 +227,8 @@ def sampled_score_choose(
     and bids only on those — O(P·K) instead of O(P·N). At
     ``affinity_weight=0`` a candidate's bid (jitter − price) is
     bit-identical to what the full [P, N] path scores for the same
-    (shard, node, round). Returns (choice [P] i32, best [P] dtype).
+    (shard, node, round). Returns (choice [P] i32, best [P] — f32, or
+    ``dtype`` widened to f32 by the −inf mask when dtype is bfloat16).
 
     Shared verbatim by the jitted kernel's candidate branch and the stage
     profiler (benchmarks/stages.py) so the timed algorithm can never drift

@@ -278,9 +278,29 @@ def main(argv: list[str] | None = None) -> int:
                              "(empty = auto: sharded on a multi-device mesh)")
     parser.add_argument("--rounds", type=int, default=0,
                         help="auction rounds override (0 = config default)")
+    parser.add_argument("--distributed", action="store_true",
+                        help="join a multi-host jax.distributed mesh before "
+                             "serving (coordinator from the Slurm env or "
+                             "JAX_COORDINATOR_ADDRESS — parallel/distributed.py); "
+                             "the sharded solver then spans every host's chips "
+                             "over ICI/DCN")
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
     setup_logging(verbose=args.verbose)
+
+    if args.distributed:
+        from slurm_bridge_tpu.parallel.distributed import init_distributed
+
+        if init_distributed():
+            import jax
+
+            log.info(
+                "joined distributed mesh: process %d/%d, %d local / %d global devices",
+                jax.process_index(), jax.process_count(),
+                jax.local_device_count(), jax.device_count(),
+            )
+        else:
+            log.info("single-process (no coordinator in env); serving local devices")
 
     cfg = AuctionConfig()
     if args.rounds:

@@ -108,3 +108,29 @@ def test_install_script_flags_match_agent():
     execstart = text.split("ExecStart=")[1].split("Restart=")[0]
     for flag in re.findall(r"(--[a-z-]+)", execstart):
         assert flag in declared, f"installer passes unknown flag {flag}"
+
+
+def test_apidoc_in_sync():
+    """docs/api.md must match what hack/gen_apidoc.py generates — the doc
+    is derived from the live wire descriptor + CLI surfaces, so a drift
+    means someone changed the contract without regenerating
+    (`sh hack/generate-apidoc.sh`). Mirrors the reference's no-diff CI
+    hygiene (.github/workflows/test-go.yml)."""
+    import io
+    import pathlib
+    import sys
+    from contextlib import redirect_stdout
+
+    repo = pathlib.Path(__file__).parent.parent
+    sys.path.insert(0, str(repo / "hack"))
+    try:
+        import gen_apidoc
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            gen_apidoc.main()
+        assert buf.getvalue() == (repo / "docs" / "api.md").read_text(), (
+            "docs/api.md is stale — run `sh hack/generate-apidoc.sh`"
+        )
+    finally:
+        sys.path.remove(str(repo / "hack"))
