@@ -15,11 +15,12 @@ Robustness contract (round-1 failure: the TPU backend init wedged and the
 bench recorded *nothing*; round-2: one short retry gave up and fell back
 to CPU): TPU init is treated as a hostile dependency. Backend acquisition
 runs FIRST, in a worker thread under a long single-shot budget
-(SBT_BENCH_TPU_BUDGET, default 600 s), progress-logged every 30 s, with a
-faulthandler stack dump into diagnostics/ at half-budget and at expiry.
-A wedged attempt poisons the process's init lock, so retries happen across
-process re-execs — SBT_BENCH_TPU_ATTEMPTS of them (default 3), each a
-fresh process — before the final re-exec pins CPU. Every path still emits
+(SBT_BENCH_TPU_BUDGET seconds for attempt 1, default 600, HALVED on each
+retry: 600 → 300 → 150), progress-logged every 30 s, with a faulthandler
+stack dump into diagnostics/ at half-budget and at expiry. A wedged
+attempt poisons the process's init lock, so retries happen across process
+re-execs — SBT_BENCH_TPU_ATTEMPTS of them (default 3), each a fresh
+process — before the final re-exec pins CPU. Every path still emits
 the one JSON line with an honest "backend" field, and failure paths exit
 nonzero (ADVICE r2) so a harness keying off rc sees them.
 
@@ -132,8 +133,10 @@ def _acquire_backend() -> str:
     """Initialize a JAX backend, preferring the accelerator, never hanging.
 
     VERDICT r2 #1 contract — TPU init is a hostile dependency:
-    - one LONG single-shot budget per attempt (SBT_BENCH_TPU_BUDGET,
-      default 600 s), progress-logged every 30 s;
+    - one LONG single-shot budget for the first attempt
+      (SBT_BENCH_TPU_BUDGET, default 600 s), halved on each retry — a
+      wedge that survived a full window rarely clears, and the total must
+      leave room for the forced-CPU solve; progress-logged every 30 s;
     - a wedged attempt poisons this process's init lock, so the retry is a
       process re-exec (SBT_BENCH_TPU_ATTEMPTS total, default 3) — each
       attempt gets a genuinely fresh PJRT client;

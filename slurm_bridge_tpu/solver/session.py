@@ -87,23 +87,35 @@ class DeviceSolver:
         self.update_snapshot(snapshot)
 
     def update_snapshot(self, snapshot: ClusterSnapshot) -> None:
-        prior = getattr(self, "snapshot", None)
+        # Compare against COPIES of what was last staged, not the stored
+        # snapshot object: callers (StreamingSim, tests) mutate snapshot
+        # arrays in place (drain a node by zeroing its free row), and an
+        # identity-shared reference would make every such change invisible
+        # — the staged device arrays would never refresh.
+        prior = getattr(self, "_staged", None)
         # two tiers of reuse: free/capacity change every tick (jobs run and
         # finish), but the *inventory shape* — node set, partitions,
         # feature bits — changes only when the cluster itself does, and it
         # alone determines the candidate pools
         same_inventory = (
             prior is not None
-            and prior.num_nodes == snapshot.num_nodes
-            and np.array_equal(prior.partition_of, snapshot.partition_of)
-            and np.array_equal(prior.features, snapshot.features)
+            and prior["n"] == snapshot.num_nodes
+            and np.array_equal(prior["part"], snapshot.partition_of)
+            and np.array_equal(prior["feat"], snapshot.features)
         )
         same_all = (
             same_inventory
-            and np.array_equal(prior.free, snapshot.free)
-            and np.array_equal(prior.capacity, snapshot.capacity)  # scale input
+            and np.array_equal(prior["free"], snapshot.free)
+            and np.array_equal(prior["cap"], snapshot.capacity)  # scale input
         )
         self.snapshot = snapshot
+        self._staged = {
+            "n": snapshot.num_nodes,
+            "part": snapshot.partition_of.copy(),
+            "feat": snapshot.features.copy(),
+            "free": snapshot.free.copy(),
+            "cap": snapshot.capacity.copy(),
+        }
         if same_all:
             return  # keep every staged device array
         self._scale = resource_scale(snapshot)

@@ -68,6 +68,7 @@ def streaming_place(
     preemption: bool = True,
     sharded: bool = False,
     bucket: int = 4096,
+    session=None,
 ) -> TickResult:
     """Re-solve one tick with incumbents pinned to their nodes.
 
@@ -104,6 +105,13 @@ def streaming_place(
         from slurm_bridge_tpu.solver.sharded import sharded_place
 
         placement = sharded_place(snapshot, solve_batch, config, incumbent=solve_inc)
+    elif session is not None:
+        # device-resident path (the production scheduler's): the snapshot
+        # stays staged across ticks; only changed tiers re-upload. The
+        # session's OWN config governs this branch — callers owning a
+        # session (StreamingSim) rebuild it when their config changes.
+        session.update_snapshot(snapshot)
+        placement = session.solve(solve_batch, incumbent=solve_inc)
     else:
         placement = auction_place(snapshot, solve_batch, config, incumbent=solve_inc)
     if solve_batch.num_shards != p_real:
@@ -141,6 +149,9 @@ class StreamingSim:
     sharded: bool = False
     assign: np.ndarray = field(init=False)
     _next_job: int = field(init=False)
+    #: lazily-created DeviceSolver so the snapshot stays staged across
+    #: ticks (the production scheduler's pattern); unused when sharded
+    _session: object = field(init=False, default=None)
 
     def __post_init__(self):
         self.assign = np.full(self.batch.num_shards, -1, np.int32)
@@ -193,6 +204,16 @@ class StreamingSim:
     # ---- solve ----
 
     def tick(self) -> TickResult:
+        if not self.sharded:
+            from slurm_bridge_tpu.solver.session import DeviceSolver
+
+            # (re)build the session when absent OR when sim.config changed
+            # since it was built — the session path would otherwise solve
+            # with a stale config forever (AuctionConfig is frozen, so
+            # equality is the right staleness check)
+            want = self.config or AuctionConfig()
+            if self._session is None or self._session.config != want:
+                self._session = DeviceSolver(self.snapshot, want)
         result = streaming_place(
             self.snapshot,
             self.batch,
@@ -200,6 +221,7 @@ class StreamingSim:
             self.config,
             preemption=self.preemption,
             sharded=self.sharded,
+            session=self._session,
         )
         self.assign = np.where(
             result.placement.placed, result.placement.node_of, -1

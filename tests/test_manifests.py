@@ -121,7 +121,15 @@ def test_apidoc_in_sync():
     import sys
     from contextlib import redirect_stdout
 
+    import pytest
+
     repo = pathlib.Path(__file__).parent.parent
+    committed = (repo / "docs" / "api.md").read_text()
+    # argparse help formatting changes across Python minors (3.10 options
+    # header, 3.13 usage wrapping) — only compare on the generating version
+    tag = f"on python {sys.version_info.major}.{sys.version_info.minor} "
+    if tag not in committed.splitlines()[0]:
+        pytest.skip("docs/api.md generated under a different Python minor")
     sys.path.insert(0, str(repo / "hack"))
     try:
         import gen_apidoc
@@ -129,7 +137,7 @@ def test_apidoc_in_sync():
         buf = io.StringIO()
         with redirect_stdout(buf):
             gen_apidoc.main()
-        assert buf.getvalue() == (repo / "docs" / "api.md").read_text(), (
+        assert buf.getvalue() == committed, (
             "docs/api.md is stale — run `sh hack/generate-apidoc.sh`"
         )
     finally:

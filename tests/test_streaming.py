@@ -227,3 +227,30 @@ def test_sharded_streaming_matches_single_device():
                           CFG, sharded=True, preemption=False)
     _check_feasible(snap, batch, res.placement)
     assert res.stability == 1.0
+
+
+def test_sim_session_sees_in_place_snapshot_mutation():
+    """Regression (r3 review): StreamingSim holds a persistent DeviceSolver
+    whose update_snapshot used to compare against the SAME object the sim
+    mutates in place — draining a node between ticks was invisible and a
+    non-preemptible incumbent kept a zero-capacity node forever."""
+    from slurm_bridge_tpu.core.types import JobDemand, NodeInfo, PartitionInfo
+    from slurm_bridge_tpu.solver.snapshot import encode_cluster, encode_jobs
+    from slurm_bridge_tpu.solver.streaming import StreamingSim
+
+    nodes = [NodeInfo(name=f"n{i}", cpus=4, memory_mb=8192, state="IDLE")
+             for i in range(2)]
+    parts = [PartitionInfo(name="p", nodes=("n0", "n1"))]
+    snap = encode_cluster(nodes, parts)
+    batch = encode_jobs([JobDemand(partition="p", cpus_per_task=4)], snap)
+    sim = StreamingSim(snap, batch, config=AuctionConfig(rounds=4),
+                       preemption=False)
+    first = sim.tick()
+    assert first.placement.placed.all()
+    held = int(first.placement.node_of[0])
+    # drain the held node in place — the next tick MUST move or preempt
+    sim.snapshot.free[held] = 0.0
+    second = sim.tick()
+    assert not (second.kept.any() and second.placement.node_of[0] == held), (
+        "incumbent kept a drained node: staged snapshot went stale"
+    )
