@@ -44,10 +44,21 @@ from slurm_bridge_tpu.solver.snapshot import (
     JobBatch,
     encode_cluster,
 )
+from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.wire import pb
 from slurm_bridge_tpu.wire.convert import node_from_proto, partition_from_proto
 
 log = logging.getLogger("sbt.solver.service")
+
+_solve_seconds = REGISTRY.histogram(
+    "sbt_solver_place_seconds", "PlacementSolver.Place solve wall time"
+)
+_place_total = REGISTRY.counter(
+    "sbt_solver_place_requests_total", "Place RPCs served"
+)
+_placed_total = REGISTRY.counter(
+    "sbt_solver_jobs_placed_total", "jobs placed across all Place RPCs"
+)
 
 SOLVERS = ("auction", "greedy", "sharded")
 
@@ -119,6 +130,8 @@ class PlacementSolverServicer:
         with self._lock:
             placement = self._solve(solver, snapshot, batch, incumbent)
         solve_ms = (time.perf_counter() - t0) * 1e3
+        _solve_seconds.observe(solve_ms / 1e3)
+        _place_total.inc()
 
         by_job = placement.by_job(batch)
         assignments = []
@@ -133,6 +146,7 @@ class PlacementSolverServicer:
                     node_names=[snapshot.node_names[i] for i in idxs],
                 )
             )
+        _placed_total.inc(placed)
         return pb.PlaceResponse(
             assignments=assignments,
             placed=placed,
@@ -257,10 +271,19 @@ class PlacementSolverServicer:
 def serve_solver(
     endpoint: str, config: AuctionConfig | None = None, *, solver: str = ""
 ):
-    """Start a gRPC server hosting the PlacementSolver at ``endpoint``."""
+    """Start a gRPC server hosting the PlacementSolver at ``endpoint``.
+
+    Wraps RPCs in the tracing interceptor (a span per Place, visible at
+    /debug/tracez when --metrics-port is set) — same wiring as the agent
+    (agent/main.py)."""
+    from slurm_bridge_tpu.obs.tracing import tracing_interceptor
     from slurm_bridge_tpu.wire.rpc import serve
 
-    return serve({"PlacementSolver": PlacementSolverServicer(config, solver=solver)}, endpoint)
+    return serve(
+        {"PlacementSolver": PlacementSolverServicer(config, solver=solver)},
+        endpoint,
+        interceptors=(tracing_interceptor(),),
+    )
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -268,11 +291,16 @@ def main(argv: list[str] | None = None) -> int:
     import argparse
     import signal
 
+    from slurm_bridge_tpu.obs.bootstrap import (
+        add_observability_flags,
+        start_observability,
+    )
     from slurm_bridge_tpu.obs.logging import setup_logging
 
     parser = argparse.ArgumentParser(description="slurm-bridge-tpu placement solver sidecar")
     parser.add_argument("--listen", default="0.0.0.0:9998",
                         help="bind endpoint (host:port or *.sock)")
+    add_observability_flags(parser)
     parser.add_argument("--solver", default="", choices=["", *SOLVERS],
                         help="default solver when requests don't name one "
                              "(empty = auto: sharded on a multi-device mesh)")
@@ -306,6 +334,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.rounds:
         cfg = AuctionConfig(rounds=args.rounds)
     server = serve_solver(args.listen, cfg, solver=args.solver)
+    httpd = start_observability("sbt-solver", args)
     log.info("placement solver serving on %s (port %s)", args.listen, server.bound_port)
 
     stop = threading.Event()
@@ -313,6 +342,8 @@ def main(argv: list[str] | None = None) -> int:
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
     server.stop(grace=2).wait()
+    if httpd is not None:
+        httpd.shutdown()
     return 0
 
 

@@ -252,3 +252,25 @@ def test_deleted_cr_cancels_job(fake_slurm, tmp_path):
         bridge.stop()
         agent.stop(None)
         api.stop()
+
+
+def test_in_cluster_config(tmp_path, monkeypatch):
+    """KubeConfig.in_cluster reads the standard ServiceAccount mount."""
+    import slurm_bridge_tpu.bridge.kubeapi as kubeapi
+
+    sa = tmp_path / "serviceaccount"
+    sa.mkdir()
+    (sa / "token").write_text("tok-123\n")
+    (sa / "namespace").write_text("jobs-ns")
+    (sa / "ca.crt").write_text("---cert---")
+    monkeypatch.setattr(kubeapi, "_SA_DIR", str(sa))
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.9.8.7")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "6443")
+    cfg = KubeConfig.in_cluster()
+    assert cfg.base_url == "https://10.9.8.7:6443"
+    assert cfg.token == "tok-123"
+    assert cfg.namespace == "jobs-ns"
+    assert cfg.ca_file == str(sa / "ca.crt")
+    assert cfg.jobs_path("j", subresource="status") == (
+        "/apis/kubecluster.org/v1alpha1/namespaces/jobs-ns/slurmbridgejobs/j/status"
+    )
