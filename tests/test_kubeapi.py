@@ -105,8 +105,13 @@ class _FakeApiServer:
                     except (BrokenPipeError, ConnectionResetError):
                         pass
                     return
+                # the list MUST include the CRs: the adapter reconciles
+                # managed-but-unlisted names as deletions on every re-list,
+                # so an empty list would cancel in-flight jobs the moment
+                # the watch stream ends (AlreadyExists dedupes the overlap
+                # between this list and the watch replay)
                 body = json.dumps(
-                    {"items": [], "metadata": {"resourceVersion": "1"}}
+                    {"items": outer.crs, "metadata": {"resourceVersion": "1"}}
                 ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -274,3 +279,56 @@ def test_in_cluster_config(tmp_path, monkeypatch):
     assert cfg.jobs_path("j", subresource="status") == (
         "/apis/kubecluster.org/v1alpha1/namespaces/jobs-ns/slurmbridgejobs/j/status"
     )
+
+
+def test_many_crs_adopted_and_statused_under_load(fake_slurm, tmp_path):
+    """Race/load: a burst of CRs arrives on the watch stream while jobs
+    run and finish; every one must be adopted exactly once and reach a
+    Succeeded status PATCH (test_races.py's philosophy applied to the
+    adapter's two racing threads)."""
+    from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
+    from slurm_bridge_tpu.bridge import Bridge
+    from slurm_bridge_tpu.wire import serve
+
+    n = 12
+    base = _sample_crs()[0]
+    crs = []
+    for i in range(n):
+        cr = json.loads(json.dumps(base))
+        cr["metadata"]["name"] = f"burst-{i}"
+        cr["spec"]["cpusPerTask"] = 1
+        cr["spec"].pop("array", None)
+        cr["spec"]["sbatchScript"] = "#!/bin/sh\necho ok\n"
+        crs.append(cr)
+    api = _FakeApiServer(crs)
+    sock = str(tmp_path / "agent.sock")
+    agent = serve(
+        {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
+        sock,
+    )
+    bridge = Bridge(
+        sock, scheduler_interval=0.05, configurator_interval=5.0,
+        node_sync_interval=0.05,
+    ).start()
+    adapter = KubeApiAdapter(
+        bridge, KubeConfig(base_url=api.url, token="test-token"), backoff=0.2
+    ).start()
+    try:
+        assert _wait(
+            lambda: sum(1 for j in bridge.list()
+                        if j.name.startswith("burst-")) == n,
+            timeout=30.0,
+        ), "not all CRs adopted"
+        ok = lambda: {
+            name for name, p in api.patches
+            if p["status"]["state"] == "Succeeded"
+        } >= {f"burst-{i}" for i in range(n)}
+        assert _wait(ok, timeout=40.0), (
+            f"missing terminal patches; got "
+            f"{sorted({nm for nm, p in api.patches if p['status']['state'] == 'Succeeded'})}"
+        )
+    finally:
+        adapter.stop()
+        bridge.stop()
+        agent.stop(None)
+        api.stop()
