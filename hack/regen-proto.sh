@@ -5,10 +5,14 @@
 # image has protoc but not the grpc python plugin, so only the message
 # module is generated; service stubs are derived from the descriptor at
 # runtime (wire/rpc.py — exactly what generated stubs do, minus codegen).
+#
+# The .protoc-version stamp records the generating toolchain so the
+# hygiene check (hack/run-checks.sh) can tell real drift from version skew.
 set -eu
 cd "$(dirname "$0")/.."
 protoc \
   --proto_path=slurm_bridge_tpu/wire \
   --python_out=slurm_bridge_tpu/wire \
   slurm_bridge_tpu/wire/workload.proto
+protoc --version > slurm_bridge_tpu/wire/.protoc-version
 echo "regenerated slurm_bridge_tpu/wire/workload_pb2.py"

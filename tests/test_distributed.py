@@ -194,3 +194,78 @@ def test_sharded_pallas_block_path_matches_jnp():
     jnp_path = sharded_place(snap, batch, AuctionConfig(rounds=3, use_pallas=False))
     pallas_path = sharded_place(snap, batch, AuctionConfig(rounds=3, use_pallas=True))
     np.testing.assert_array_equal(jnp_path.node_of, pallas_path.node_of)
+
+
+def test_multiprocess_distributed_sharded_solve(tmp_path):
+    """REAL multi-host evidence: two OS processes, four CPU devices each,
+    joined by jax.distributed into one 8-device global mesh — the sharded
+    solve's collectives cross the process boundary (Gloo here; DCN on real
+    pods), and both ranks must compute the identical placement.
+
+    This is the jax.distributed path (parallel/distributed.py's target)
+    actually executing, not just building meshes in one process."""
+    import json
+    import pathlib
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    with socket.socket() as s:  # grab a free coordinator port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(f"""
+        import hashlib, json, os, sys
+        rank = int(sys.argv[1])
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["SBT_BACKEND"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 4)
+        jax.distributed.initialize(
+            "localhost:{port}", num_processes=2, process_id=rank)
+        sys.path.insert(0, {str(pathlib.Path(__file__).parent.parent)!r})
+        from slurm_bridge_tpu.solver import AuctionConfig
+        from slurm_bridge_tpu.solver.sharded import sharded_place
+        from slurm_bridge_tpu.solver.snapshot import random_scenario
+        from slurm_bridge_tpu.parallel.mesh import solver_mesh
+        snap, batch = random_scenario(64, 200, seed=7, load=0.6,
+                                      gang_fraction=0.1)
+        mesh = solver_mesh()
+        pl = sharded_place(snap, batch, AuctionConfig(rounds=4), mesh=mesh)
+        print(json.dumps({{
+            "rank": rank,
+            "devices": jax.device_count(),
+            "local": jax.local_device_count(),
+            "placed": int(pl.placed.sum()),
+            "digest": hashlib.sha256(pl.node_of.tobytes()).hexdigest(),
+        }}), flush=True)
+    """))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(rank)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # a failed/timed-out rank must not orphan its peer blocked inside
+        # jax.distributed.initialize waiting on a dead coordinator
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    assert all(o["devices"] == 8 and o["local"] == 4 for o in outs), outs
+    assert outs[0]["placed"] > 0
+    # both ranks computed the SAME placement — replicated outputs agree
+    # across the process boundary
+    assert outs[0]["digest"] == outs[1]["digest"], outs
+    assert outs[0]["placed"] == outs[1]["placed"]

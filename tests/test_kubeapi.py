@@ -15,6 +15,7 @@ import os
 import pathlib
 import threading
 import time
+from contextlib import contextmanager
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
@@ -173,31 +174,45 @@ def _wait(pred, timeout=25.0):
     return False
 
 
-def test_sample_cr_flows_to_solve_and_status_flows_back(fake_slurm, tmp_path):
+
+@contextmanager
+def _stack(crs, tmp_path, **kube_kwargs):
+    """fakeslurm agent + Bridge + KubeApiAdapter against a fake apiserver
+    serving ``crs`` — one shared setup/teardown for every e2e test here."""
     from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
-    from slurm_bridge_tpu.bridge import Bridge, JobState
+    from slurm_bridge_tpu.bridge import Bridge
     from slurm_bridge_tpu.wire import serve
 
-    # serve ONLY the hello sample — the mpi one wants 8 gpu nodes
-    hello = _sample_crs()[0]
-    api = _FakeApiServer([hello])
+    api = _FakeApiServer(crs)
     sock = str(tmp_path / "agent.sock")
     agent = serve(
         {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
         sock,
     )
     bridge = Bridge(
-        sock,
-        scheduler_interval=0.05,
-        configurator_interval=5.0,
+        sock, scheduler_interval=0.05, configurator_interval=5.0,
         node_sync_interval=0.05,
     ).start()
     adapter = KubeApiAdapter(
         bridge,
-        KubeConfig(base_url=api.url, namespace="default", token="test-token"),
+        KubeConfig(base_url=api.url, token="test-token", **kube_kwargs),
         backoff=0.2,
     ).start()
     try:
+        yield api, bridge, adapter
+    finally:
+        adapter.stop()
+        bridge.stop()
+        agent.stop(None)
+        api.stop()
+
+
+def test_sample_cr_flows_to_solve_and_status_flows_back(fake_slurm, tmp_path):
+    from slurm_bridge_tpu.bridge import JobState
+
+    # serve ONLY the hello sample — the mpi one wants 8 gpu nodes
+    hello = _sample_crs()[0]
+    with _stack([hello], tmp_path, namespace="default") as (api, bridge, adapter):
         # the CR lands in the bridge and runs to completion via fakeslurm
         assert _wait(lambda: any(j.name == "sample-hello" for j in bridge.list()))
         job = bridge.wait("sample-hello", timeout=25.0)
@@ -213,19 +228,10 @@ def test_sample_cr_flows_to_solve_and_status_flows_back(fake_slurm, tmp_path):
         terminal = [p for n, p in api.patches
                     if n == "sample-hello" and p["status"]["state"] == "Succeeded"]
         assert terminal[-1]["status"]["subjobs"], "subjob map empty"
-    finally:
-        adapter.stop()
-        bridge.stop()
-        agent.stop(None)
-        api.stop()
 
 
 def test_deleted_cr_cancels_job(fake_slurm, tmp_path):
     """A DELETED watch event must cancel the mirrored job."""
-    from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
-    from slurm_bridge_tpu.bridge import Bridge
-    from slurm_bridge_tpu.wire import serve
-
     hello = _sample_crs()[0]
     # long-running script so the delete lands mid-flight
     hello = json.loads(json.dumps(hello))
@@ -233,30 +239,13 @@ def test_deleted_cr_cancels_job(fake_slurm, tmp_path):
     hello["spec"].pop("array", None)
     hello["metadata"]["name"] = "doomed"
 
-    api = _FakeApiServer([hello])
-    sock = str(tmp_path / "agent.sock")
-    agent = serve(
-        {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
-        sock,
-    )
-    bridge = Bridge(
-        sock, scheduler_interval=0.05, configurator_interval=5.0,
-        node_sync_interval=0.05,
-    ).start()
-    adapter = KubeApiAdapter(
-        bridge,
-        KubeConfig(base_url=api.url, token="test-token"),
-        backoff=0.2,
-    ).start()
-    try:
+    with _stack([hello], tmp_path) as (api, bridge, adapter):
         assert _wait(lambda: any(j.name == "doomed" for j in bridge.list()))
+        # the apiserver must stop listing it too, or the adapter's re-list
+        # deletion-reconciliation would re-adopt it after the watch window
+        api.crs.clear()
         adapter._handle_event({"type": "DELETED", "object": hello})
         assert _wait(lambda: all(j.name != "doomed" for j in bridge.list()))
-    finally:
-        adapter.stop()
-        bridge.stop()
-        agent.stop(None)
-        api.stop()
 
 
 def test_in_cluster_config(tmp_path, monkeypatch):
@@ -286,10 +275,6 @@ def test_many_crs_adopted_and_statused_under_load(fake_slurm, tmp_path):
     run and finish; every one must be adopted exactly once and reach a
     Succeeded status PATCH (test_races.py's philosophy applied to the
     adapter's two racing threads)."""
-    from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
-    from slurm_bridge_tpu.bridge import Bridge
-    from slurm_bridge_tpu.wire import serve
-
     n = 12
     base = _sample_crs()[0]
     crs = []
@@ -300,20 +285,7 @@ def test_many_crs_adopted_and_statused_under_load(fake_slurm, tmp_path):
         cr["spec"].pop("array", None)
         cr["spec"]["sbatchScript"] = "#!/bin/sh\necho ok\n"
         crs.append(cr)
-    api = _FakeApiServer(crs)
-    sock = str(tmp_path / "agent.sock")
-    agent = serve(
-        {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
-        sock,
-    )
-    bridge = Bridge(
-        sock, scheduler_interval=0.05, configurator_interval=5.0,
-        node_sync_interval=0.05,
-    ).start()
-    adapter = KubeApiAdapter(
-        bridge, KubeConfig(base_url=api.url, token="test-token"), backoff=0.2
-    ).start()
-    try:
+    with _stack(crs, tmp_path) as (api, bridge, adapter):
         assert _wait(
             lambda: sum(1 for j in bridge.list()
                         if j.name.startswith("burst-")) == n,
@@ -327,8 +299,3 @@ def test_many_crs_adopted_and_statused_under_load(fake_slurm, tmp_path):
             f"missing terminal patches; got "
             f"{sorted({nm for nm, p in api.patches if p['status']['state'] == 'Succeeded'})}"
         )
-    finally:
-        adapter.stop()
-        bridge.stop()
-        agent.stop(None)
-        api.stop()
