@@ -320,8 +320,12 @@ def test_feature_bit31_reserved():
 
 
 def test_solver_mesh_partial_factors():
+    import jax
+
     from slurm_bridge_tpu.parallel import solver_mesh
 
+    if len(jax.devices()) != 8:
+        pytest.skip("assumes the 8-device CPU test mesh")
     m = solver_mesh(dp=8)
     assert m.shape["dp"] == 8 and m.shape["mp"] == 1
     m = solver_mesh(mp=4)
