@@ -299,3 +299,47 @@ def test_many_crs_adopted_and_statused_under_load(fake_slurm, tmp_path):
             f"missing terminal patches; got "
             f"{sorted({nm for nm, p in api.patches if p['status']['state'] == 'Succeeded'})}"
         )
+
+
+def test_kubeconfig_tls_with_custom_ca(tmp_path):
+    """The https + ca_file path: a TLS apiserver with a self-signed cert is
+    trusted via KubeConfig.ca_file (the in-cluster shape) — and rejected
+    without it."""
+    import ssl
+    import urllib.error
+
+    from slurm_bridge_tpu.utils.certs import ensure_self_signed
+
+    cert = str(tmp_path / "tls.crt")
+    key = str(tmp_path / "tls.key")
+    assert ensure_self_signed(cert, key, common_name="localhost")
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps({"items": [], "metadata": {}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"https://localhost:{httpd.server_address[1]}"
+    try:
+        trusted = KubeConfig(base_url=url, ca_file=cert)
+        with trusted.open(trusted.jobs_path()) as resp:
+            assert json.load(resp)["items"] == []
+        untrusted = KubeConfig(base_url=url)  # system CAs don't know ours
+        with pytest.raises(urllib.error.URLError):
+            untrusted.open(untrusted.jobs_path()).read()
+        insecure = KubeConfig(base_url=url, insecure_skip_verify=True)
+        with insecure.open(insecure.jobs_path()) as resp:
+            assert json.load(resp)["items"] == []
+    finally:
+        httpd.shutdown()

@@ -283,3 +283,67 @@ def test_bridge_with_solver_sidecar(tmp_path, monkeypatch):
 def test_servicer_rejects_bad_default():
     with pytest.raises(ValueError):
         PlacementSolverServicer(solver="nope")
+
+
+def test_bridge_survives_solver_sidecar_restart(tmp_path, monkeypatch):
+    """Chaos: the sidecar dies mid-flight — the bridge fails OPEN (pods
+    stay Pending, no false Unschedulable verdicts, no preemptions, no
+    crash) and recovers the moment a new sidecar binds the same socket."""
+    from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
+    from slurm_bridge_tpu.bridge import Bridge, BridgeJobSpec, JobState
+    from slurm_bridge_tpu.wire import serve
+
+    state = tmp_path / "slurm-state"
+    state.mkdir(parents=True)
+    (state / "cluster.json").write_text(json.dumps(CLUSTER))
+    monkeypatch.setenv("SBT_FAKESLURM_STATE", str(state))
+    monkeypatch.setenv("PATH", FAKESLURM + os.pathsep + os.environ["PATH"])
+
+    agent_sock = str(tmp_path / "agent.sock")
+    agent = serve(
+        {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
+        agent_sock,
+    )
+    solver_sock = str(tmp_path / "solver.sock")
+    solver = serve_solver(solver_sock, solver="auction")
+    bridge = Bridge(
+        agent_sock,
+        scheduler_backend="auction",
+        solver_endpoint=solver_sock,
+        scheduler_interval=0.05,
+        configurator_interval=5.0,
+        node_sync_interval=0.05,
+    ).start()
+    # a short Place deadline so downtime ticks resolve fast in this test
+    bridge.scheduler.place_timeout = 2.0
+    try:
+        # sidecar down BEFORE any solve of this job (grpc removes the
+        # socket file itself on shutdown)
+        solver.stop(None)
+        bridge.submit(
+            "survivor",
+            BridgeJobSpec(partition="tiny", cpus_per_task=2,
+                          sbatch_script="#!/bin/sh\necho back\n"),
+        )
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            job = bridge.get("survivor")
+            assert job.status.state not in (JobState.FAILED,), job.status
+            time.sleep(0.1)
+        # still pending, and NOT marked with a false capacity verdict
+        from slurm_bridge_tpu.bridge.objects import Pod
+        from slurm_bridge_tpu.bridge.operator import sizecar_name
+
+        pod = bridge.store.get(Pod.KIND, sizecar_name("survivor"))
+        assert "Unschedulable" not in (pod.status.reason or ""), pod.status
+
+        # new sidecar on the same socket → the next tick succeeds
+        solver2 = serve_solver(solver_sock, solver="auction")
+        try:
+            job = bridge.wait("survivor", timeout=25.0)
+            assert job.status.state == JobState.SUCCEEDED
+        finally:
+            solver2.stop(None)
+    finally:
+        bridge.stop()
+        agent.stop(None)
