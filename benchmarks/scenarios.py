@@ -104,7 +104,12 @@ def scenario_4() -> dict:
     out = _solve_metrics(
         snap,
         batch,
-        AuctionConfig(rounds=16, gang_salvage_rounds=8, gang_first=True),
+        # affinity 0.05: a mild best-fit bias de-fragments the cluster for
+        # 8-node gangs (measured on v5e: 11,918 → 11,991 of greedy's 12,000
+        # at ~same latency). Gang-heavy only — on the mixed headline
+        # scenario the same bias LOSES ~1.8% (see AuctionConfig).
+        AuctionConfig(rounds=16, gang_salvage_rounds=8, gang_first=True,
+                      affinity_weight=0.05),
     )
     gangs = np.unique(batch.gang_id).size
     out.update(scenario=4, gangs=int(gangs))

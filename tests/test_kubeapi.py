@@ -308,6 +308,7 @@ def test_kubeconfig_tls_with_custom_ca(tmp_path):
     import ssl
     import urllib.error
 
+    pytest.importorskip("cryptography")  # cert generation needs it
     from slurm_bridge_tpu.utils.certs import ensure_self_signed
 
     cert = str(tmp_path / "tls.crt")

@@ -233,11 +233,15 @@ CLUSTER = {
 }
 
 
-def test_bridge_with_solver_sidecar(tmp_path, monkeypatch):
-    """The full control plane solving out-of-process: submit → the bridge
-    dials the PlacementSolver sidecar for placement → sbatch → success."""
+from contextlib import contextmanager
+
+
+@contextmanager
+def _sidecar_stack(tmp_path, monkeypatch, **bridge_kwargs):
+    """fakeslurm + agent + solver sidecar + Bridge dialing it — shared by
+    the sidecar e2e tests (same shape as test_kubeapi._stack)."""
     from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
-    from slurm_bridge_tpu.bridge import Bridge, BridgeJobSpec, JobState
+    from slurm_bridge_tpu.bridge import Bridge
     from slurm_bridge_tpu.wire import serve
 
     state = tmp_path / "slurm-state"
@@ -260,8 +264,22 @@ def test_bridge_with_solver_sidecar(tmp_path, monkeypatch):
         scheduler_interval=0.05,
         configurator_interval=5.0,
         node_sync_interval=0.05,
+        **bridge_kwargs,
     ).start()
     try:
+        yield bridge, solver, solver_sock, state
+    finally:
+        bridge.stop()
+        solver.stop(None)
+        agent.stop(None)
+
+
+def test_bridge_with_solver_sidecar(tmp_path, monkeypatch):
+    """The full control plane solving out-of-process: submit → the bridge
+    dials the PlacementSolver sidecar for placement → sbatch → success."""
+    from slurm_bridge_tpu.bridge import BridgeJobSpec, JobState
+
+    with _sidecar_stack(tmp_path, monkeypatch) as (bridge, solver, _sock, state):
         assert bridge.scheduler._remote is not None  # really out-of-process
         bridge.submit(
             "remote-solved",
@@ -274,10 +292,6 @@ def test_bridge_with_solver_sidecar(tmp_path, monkeypatch):
         recs = [json.loads(p.read_text()) for p in state.glob("job_*.json")]
         tasks = [t for r in recs if "alias_of" not in r for t in r["tasks"]]
         assert tasks and all(t["node"] in ("t1", "t2") for t in tasks)
-    finally:
-        bridge.stop()
-        solver.stop(None)
-        agent.stop(None)
 
 
 def test_servicer_rejects_bad_default():
@@ -289,34 +303,11 @@ def test_bridge_survives_solver_sidecar_restart(tmp_path, monkeypatch):
     """Chaos: the sidecar dies mid-flight — the bridge fails OPEN (pods
     stay Pending, no false Unschedulable verdicts, no preemptions, no
     crash) and recovers the moment a new sidecar binds the same socket."""
-    from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
-    from slurm_bridge_tpu.bridge import Bridge, BridgeJobSpec, JobState
-    from slurm_bridge_tpu.wire import serve
+    from slurm_bridge_tpu.bridge import BridgeJobSpec, JobState
 
-    state = tmp_path / "slurm-state"
-    state.mkdir(parents=True)
-    (state / "cluster.json").write_text(json.dumps(CLUSTER))
-    monkeypatch.setenv("SBT_FAKESLURM_STATE", str(state))
-    monkeypatch.setenv("PATH", FAKESLURM + os.pathsep + os.environ["PATH"])
-
-    agent_sock = str(tmp_path / "agent.sock")
-    agent = serve(
-        {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
-        agent_sock,
-    )
-    solver_sock = str(tmp_path / "solver.sock")
-    solver = serve_solver(solver_sock, solver="auction")
-    bridge = Bridge(
-        agent_sock,
-        scheduler_backend="auction",
-        solver_endpoint=solver_sock,
-        scheduler_interval=0.05,
-        configurator_interval=5.0,
-        node_sync_interval=0.05,
-    ).start()
-    # a short Place deadline so downtime ticks resolve fast in this test
-    bridge.scheduler.place_timeout = 2.0
-    try:
+    with _sidecar_stack(tmp_path, monkeypatch) as (bridge, solver, solver_sock, _state):
+        # a short Place deadline so downtime ticks resolve fast in this test
+        bridge.scheduler.place_timeout = 2.0
         # sidecar down BEFORE any solve of this job (grpc removes the
         # socket file itself on shutdown)
         solver.stop(None)
@@ -344,6 +335,3 @@ def test_bridge_survives_solver_sidecar_restart(tmp_path, monkeypatch):
             assert job.status.state == JobState.SUCCEEDED
         finally:
             solver2.stop(None)
-    finally:
-        bridge.stop()
-        agent.stop(None)
