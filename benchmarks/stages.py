@@ -84,7 +84,10 @@ def profile_stages(snap, batch, cfg: AuctionConfig, *, iters: int = 10) -> dict:
 
     # ---- stage: score + choose ----
     if k > 0:
-        from slurm_bridge_tpu.solver.auction import sampled_score_choose
+        from slurm_bridge_tpu.solver.auction import (
+            batch_needs_feat_check,
+            sampled_score_choose,
+        )
 
         pools = CandidatePools(snap)
         samp_start_np, samp_count_np = pools.slices(batch)
@@ -103,6 +106,7 @@ def profile_stages(snap, batch, cfg: AuctionConfig, *, iters: int = 10) -> dict:
                 candidates=k, jitter=cfg.jitter,
                 affinity_weight=cfg.affinity_weight, dtype=jnp.float32,
                 scale=dscale,
+                check_feats=batch_needs_feat_check(batch.req_features),
             )
     elif backend == "tpu":
         # the kernel's real TPU path: the fused pallas tile-streaming

@@ -224,6 +224,7 @@ def sampled_score_choose(
     node_part, node_feat, incumbent,
     part_order, samp_start, samp_count, rnd,
     *, candidates, jitter, affinity_weight, dtype, scale,
+    check_feats: bool = True,
 ):
     """One power-of-K-choices score/choose step: each shard draws K
     candidate nodes from its (partition, feature) slice of ``part_order``
@@ -252,11 +253,29 @@ def sampled_score_choose(
     cand = part_order[jnp.clip(idx, 0, pool_hi)]  # [P, K] node ids
     cand = jnp.where(inc[:, None], incumbent[:, None], cand)
     has_cand = (samp_count > 0) | inc  # [P]
-    part_ok_k = (job_part[:, None] == node_part[cand]) | (job_part[:, None] < 0)
-    feat_ok_k = (node_feat[cand] & req_feat[:, None]) == req_feat[:, None]
     freec = free[cand]  # [P, K, R] gather
     cap_ok_k = jnp.all(dem[:, None, :] <= freec + 1e-6, axis=-1)
-    feas = has_cand[:, None] & part_ok_k & feat_ok_k & cap_ok_k
+    feas = has_cand[:, None] & cap_ok_k
+    # NO per-candidate partition check: every draw comes from the shard's
+    # own partition slice of ``part_order`` (CandidatePools); an
+    # unknown/PAD partition yields samp_count=0. The feature check narrows
+    # only multi-bit masks (pools are conditioned on the lowest required
+    # bit; single-bit masks are fully enforced by the pool, bit 31 by the
+    # empty slice), so callers pass check_feats=False when no mask has
+    # >1 bit — two [P, K] gather+compare streams gone from the CPU
+    # fallback's hot loop.
+    #
+    # Incumbent-substituted candidates do NOT come from the pools, and a
+    # node can be repartitioned or lose a feature label while a shard runs
+    # on it — so incumbent rows are re-validated explicitly ([P] gathers,
+    # not [P, K]), keeping preemption parity with the dense path.
+    inc_node = jnp.clip(incumbent, 0, node_part.shape[0] - 1)
+    inc_feas = ((job_part == node_part[inc_node]) | (job_part < 0)) & (
+        (node_feat[inc_node] & req_feat) == req_feat
+    )
+    feas &= (~inc | inc_feas)[:, None]
+    if check_feats:
+        feas &= (node_feat[cand] & req_feat[:, None]) == req_feat[:, None]
     jit_k = _unit(
         _mix(pi, cand.astype(jnp.uint32), salt), dtype
     ) * jnp.asarray(jitter, dtype)
@@ -335,7 +354,7 @@ def multi_mask(gang: jnp.ndarray, p: int) -> jnp.ndarray:
     static_argnames=(
         "rounds", "num_nodes", "eta", "jitter", "affinity_weight", "dtype",
         "use_pallas", "interpret", "gang_salvage_rounds", "gang_first",
-        "candidates", "has_gangs",
+        "candidates", "has_gangs", "check_feats",
     ),
 )
 def _auction_kernel(
@@ -368,6 +387,10 @@ def _auction_kernel(
     #: statically False when no gang spans >1 shard — skips the dedup sort
     #: and the revoke segment-sums, ~20% of a no-gang round's cost
     has_gangs: bool = True,
+    #: sampled path only: False when no req_features mask has >1 bit (the
+    #: candidate pools then fully enforce features) — see
+    #: sampled_score_choose
+    check_feats: bool = True,
 ):
     p = dem.shape[0]
     n = num_nodes
@@ -419,6 +442,7 @@ def _auction_kernel(
                 part_order, samp_start, samp_count, rnd,
                 candidates=candidates, jitter=jitter,
                 affinity_weight=affinity_weight, dtype=dtype, scale=scale,
+                check_feats=check_feats,
             )
         elif use_pallas:
             # fused tile-streaming kernel: no [P, N] intermediates in HBM
@@ -609,6 +633,16 @@ def normalize_gangs(gang: np.ndarray) -> np.ndarray:
     return inverse.astype(np.int32)
 
 
+def batch_needs_feat_check(req_features: np.ndarray) -> bool:
+    """True if any required-feature mask carries more than one bit — the
+    only case the sampled path's in-kernel feature check still narrows
+    (single-bit masks are fully enforced by the candidate pools)."""
+    if req_features.size == 0:
+        return False
+    r = req_features.astype(np.uint32)
+    return bool(np.any((r & (r - np.uint32(1))) != 0))
+
+
 def batch_has_gangs(gang_norm: np.ndarray) -> bool:
     """True if any gang spans more than one shard. Host-side and cheap, it
     feeds the kernel's static ``has_gangs`` so the common no-gang tick
@@ -691,6 +725,7 @@ def auction_place(
         gang_first=cfg.gang_first,
         candidates=k,
         has_gangs=batch_has_gangs(gang_norm),
+        check_feats=k > 0 and batch_needs_feat_check(batch.req_features),
     )
     assign_np = np.asarray(assign)
     return Placement(

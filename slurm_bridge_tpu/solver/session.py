@@ -28,6 +28,7 @@ from slurm_bridge_tpu.solver.auction import (
     CandidatePools,
     _auction_kernel,
     batch_has_gangs,
+    batch_needs_feat_check,
     normalize_gangs,
     resolve_candidates,
     resource_scale,
@@ -178,6 +179,7 @@ class DeviceSolver:
             interpret=self._interpret if k == 0 else False,
             candidates=k,
             has_gangs=batch_has_gangs(gang_norm),
+            check_feats=k > 0 and batch_needs_feat_check(batch.req_features),
         )
         try:  # overlap the device→host copy with whatever the caller does next
             assign.copy_to_host_async()

@@ -502,3 +502,55 @@ def test_candidate_pools_grow_and_restage():
     s2, c2 = pools.slices(b1)  # same combo: cached, no growth
     assert pools.version == v1 and s2[0] == s1[0] and c2[0] == 16
     assert len(pools.array) % snap.num_nodes == 0  # padded to a multiple of N
+
+
+def test_sampled_incumbent_revalidated_against_node_changes():
+    """Regression (r3 review): the sampled path substitutes the incumbent's
+    node as its only candidate WITHOUT drawing from the partition-
+    conditioned pools, so it must re-validate partition/feature feasibility
+    explicitly — a repartitioned or relabeled node must evict the shard on
+    BOTH paths, or the dense and sampled solvers disagree on preemption."""
+    from slurm_bridge_tpu.solver.snapshot import ClusterSnapshot
+
+    def snap_two_nodes(node0_part, node0_feat):
+        return ClusterSnapshot(
+            node_names=["h0", "h1"],
+            capacity=np.full((2, 3), 64, np.float32),
+            free=np.full((2, 3), 64, np.float32),
+            partition_of=np.array([node0_part, 1], np.int32),
+            features=np.array([node0_feat, 0], np.uint32),
+            partition_codes={"a": 0, "b": 1},
+            feature_codes={"f": 0},
+        )
+
+    from slurm_bridge_tpu.solver.snapshot import JobBatch
+
+    def batch_one(req_feat=0):
+        return JobBatch(
+            demand=np.full((1, 3), 4, np.float32),
+            partition_of=np.array([0], np.int32),
+            req_features=np.array([req_feat], np.uint32),
+            priority=np.ones(1, np.float32),
+            gang_id=np.zeros(1, np.int32),
+            job_of=np.zeros(1, np.int32),
+        )
+
+    incumbent = np.array([0], np.int32)  # shard holds node h0
+    for label, snap, batch in (
+        # h0 was repartitioned away from the shard's partition
+        ("partition", snap_two_nodes(node0_part=1, node0_feat=1), batch_one(1)),
+        # h0 lost the single-bit feature the shard requires
+        ("feature", snap_two_nodes(node0_part=0, node0_feat=0), batch_one(1)),
+    ):
+        dense = auction_place(
+            snap, batch, AuctionConfig(rounds=4, candidates=0),
+            incumbent=incumbent,
+        )
+        sampled = auction_place(
+            snap, batch, AuctionConfig(rounds=4, candidates=2),
+            incumbent=incumbent,
+        )
+        assert not dense.placed[0], f"{label}: dense kept an infeasible node"
+        assert not sampled.placed[0], (
+            f"{label}: sampled kept an infeasible incumbent node"
+        )
