@@ -45,7 +45,9 @@ import numpy as np
 _FORCED_CPU_ENV = "SBT_BENCH_CPU"
 _ATTEMPT_ENV = "SBT_BENCH_TPU_ATTEMPT"  # 1-based, bumped on each re-exec
 _METRIC = "pods_placed_per_sec_50kx10k"
-_DIAG_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "diagnostics")
+_DIAG_DIR = os.environ.get("SBT_BENCH_DIAG_DIR") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "diagnostics"
+)
 
 # Filled in as the run progresses so the watchdog can emit a partial line.
 _PARTIAL: dict = {"metric": _METRIC, "value": 0.0, "unit": "pods/s",
@@ -177,7 +179,10 @@ def _acquire_backend() -> str:
     t.start()
     dumped_half = False
     while True:
-        t.join(30.0)
+        # bounded by the remaining budget: a sub-30s budget must not sit
+        # out a full 30s progress interval per attempt
+        remaining = budget - (time.perf_counter() - t0)
+        t.join(min(30.0, max(remaining, 0.1)))
         elapsed = time.perf_counter() - t0
         if result:
             break

@@ -57,3 +57,53 @@ def test_bench_probe_attempt_env_halves_budget():
     assert out.returncode == 0
     # forced CPU skips probing entirely — the marker env wins over attempts
     assert "TPU probe attempt" not in out.stderr
+
+
+def test_bench_wedged_backend_chain_still_emits(tmp_path):
+    """The path that burned rounds 1-2: a backend whose init HANGS. A fake
+    `jax` module shadows the real one and sleeps forever in
+    default_backend(); the bench must walk the whole contract — attempt 1
+    (stack dumps at half-budget and expiry) → re-exec attempt 2 → re-exec
+    forced CPU — and even when the forced-CPU fallback also fails (the
+    fake can't run XLA either), still emit exactly ONE JSON line, marked
+    with `note`, and exit nonzero."""
+    fake = tmp_path / "shadow"
+    fake.mkdir()
+    (fake / "jax.py").write_text(
+        "import time\n"
+        "class _Cfg:\n"
+        "    def update(self, *a, **k):\n"
+        "        raise RuntimeError('fake jax cannot configure')\n"
+        "config = _Cfg()\n"
+        "def default_backend():\n"
+        "    time.sleep(3600)\n"
+        "def devices():\n"
+        "    return []\n"
+    )
+    diag = tmp_path / "diag"
+    env = dict(
+        os.environ,
+        PYTHONPATH=str(fake),
+        SBT_BENCH_SHAPE="100,16",
+        SBT_BENCH_TPU_BUDGET="4",
+        SBT_BENCH_TPU_ATTEMPTS="2",
+        SBT_BENCH_DIAG_DIR=str(diag),
+    )
+    env.pop("SBT_BENCH_CPU", None)
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode != 0, "a failed bench must not look like success"
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"exactly one JSON line, got {lines!r}"
+    payload = json.loads(lines[0])
+    assert "note" in payload, payload
+    # the attempt chain actually walked: both attempts probed and dumped
+    assert "attempt 1/2" in out.stderr
+    assert "attempt 2/2" in out.stderr
+    assert "forced CPU" in out.stderr
+    dumps = list(diag.glob("tpu_probe_bench_attempt*"))
+    assert len(dumps) >= 2, f"expected per-attempt stack dumps, got {dumps}"
+    assert "default_backend" in dumps[0].read_text(), "dump lacks the stuck frame"
