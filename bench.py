@@ -179,10 +179,16 @@ def _acquire_backend() -> str:
     t.start()
     dumped_half = False
     while True:
-        # bounded by the remaining budget: a sub-30s budget must not sit
-        # out a full 30s progress interval per attempt
-        remaining = budget - (time.perf_counter() - t0)
-        t.join(min(30.0, max(remaining, 0.1)))
+        # bounded by the remaining budget (a sub-30s budget must not sit
+        # out a full 30s progress interval) AND by the half-budget
+        # checkpoint while it is still pending — the two dumps exist to
+        # show whether the wedge moved between them, so they must not
+        # collapse into one instant
+        now = time.perf_counter() - t0
+        bound = min(30.0, max(budget - now, 0.1))
+        if not dumped_half:
+            bound = min(bound, max(budget / 2 - now, 0.1))
+        t.join(bound)
         elapsed = time.perf_counter() - t0
         if result:
             break

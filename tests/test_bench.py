@@ -90,6 +90,7 @@ def test_bench_wedged_backend_chain_still_emits(tmp_path):
         SBT_BENCH_DIAG_DIR=str(diag),
     )
     env.pop("SBT_BENCH_CPU", None)
+    env.pop("SBT_BENCH_TPU_ATTEMPT", None)
     env.pop("JAX_PLATFORMS", None)
     out = subprocess.run(
         [sys.executable, str(REPO / "bench.py")],

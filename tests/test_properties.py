@@ -1,0 +1,100 @@
+"""Property-based tests (hypothesis) for the parser layer and solver
+invariants — breadth the reference's table-driven tests never reach
+(its ~20 hand-picked ParseDuration cases, parse_test.go:27-120, miss the
+adversarial corners a generator finds)."""
+
+from __future__ import annotations
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from slurm_bridge_tpu.core.arrays import array_len, parse_array_spec
+from slurm_bridge_tpu.core.durations import format_duration, parse_duration
+from slurm_bridge_tpu.core.hostlist import compress_hostlist, expand_hostlist
+
+# ---------------------------------------------------------------- durations
+
+
+@given(st.integers(min_value=0, max_value=10_000 * 24 * 3600))
+def test_duration_roundtrip(seconds):
+    """format → parse is the identity for any non-negative duration."""
+    assert parse_duration(format_duration(seconds)) == seconds
+
+
+@given(st.integers(min_value=0, max_value=365), st.integers(0, 23),
+       st.integers(0, 59), st.integers(0, 59))
+def test_duration_dhms_form(d, h, m, s):
+    assert parse_duration(f"{d}-{h:02d}:{m:02d}:{s:02d}") == (
+        d * 86400 + h * 3600 + m * 60 + s
+    )
+
+
+# ---------------------------------------------------------------- hostlists
+
+_host = st.from_regex(r"[a-z]{1,4}[0-9]{1,4}", fullmatch=True)
+
+
+@given(st.lists(_host, min_size=1, max_size=30, unique=True))
+def test_hostlist_roundtrip(hosts):
+    """expand(compress(hosts)) preserves the host SET (compress may
+    reorder into numeric runs)."""
+    assert set(expand_hostlist(compress_hostlist(hosts))) == set(hosts)
+
+
+@given(st.text(alphabet="abc123[]-,", max_size=20))
+@settings(max_examples=200)
+def test_hostlist_expand_never_crashes(expr):
+    """Arbitrary bracket soup must parse or raise ValueError — never
+    IndexError/TypeError/hang (the agent feeds scontrol output here)."""
+    try:
+        expand_hostlist(expr)
+    except ValueError:
+        pass
+
+
+# ------------------------------------------------------------------ arrays
+
+
+@given(st.integers(0, 300), st.integers(0, 300), st.integers(1, 7))
+def test_array_spec_ranges(a, b, step):
+    lo, hi = min(a, b), max(a, b)
+    ids = parse_array_spec(f"{lo}-{hi}:{step}")
+    assert ids == list(range(lo, hi + 1, step))
+    assert array_len(f"{lo}-{hi}:{step}") == len(ids)
+
+
+@given(st.text(alphabet="0123456789-,:%", max_size=16))
+@settings(max_examples=200)
+def test_array_spec_never_crashes(spec):
+    try:
+        parse_array_spec(spec)
+    except ValueError:
+        pass
+
+
+# ---------------------------------------------------------------- solver
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    st.integers(2, 12),   # nodes
+    st.integers(1, 40),   # jobs
+    st.randoms(use_true_random=False),
+)
+def test_auction_feasible_on_random_tiny_scenarios(n, p, rnd):
+    """Every placement the auction returns satisfies capacity, partition,
+    feature, and gang invariants — on generator-driven shapes, not just
+    the fixed seeds the scenario tests use."""
+    from slurm_bridge_tpu.solver import AuctionConfig, auction_place
+    from slurm_bridge_tpu.solver.snapshot import random_scenario
+    from tests.test_solver import _check_feasible
+
+    seed = rnd.randrange(2**31)
+    snap, batch = random_scenario(
+        n, p, seed=seed, load=rnd.choice([0.3, 0.8, 1.5]),
+        gang_fraction=rnd.choice([0.0, 0.4]), gang_size=2,
+        gpu_fraction=rnd.choice([0.0, 0.5]),
+    )
+    placement = auction_place(snap, batch, AuctionConfig(rounds=4))
+    _check_feasible(snap, batch, placement)
