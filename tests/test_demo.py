@@ -1,0 +1,57 @@
+"""The quick-start promise, executed: ``sbt-demo`` (and ``--preemption``)
+must run the zero-infrastructure walk exactly as docs/quick-start.md
+instructs — fake Slurm on PATH, no cluster — and end in OK.
+
+Run as real subprocesses (the module's __main__ path), not in-process:
+these are the commands a new user types first.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).parent.parent
+FAKESLURM = str(REPO / "tests" / "fakeslurm")
+
+
+def _run_demo(args: list[str], timeout: float) -> subprocess.CompletedProcess:
+    env = dict(
+        os.environ,
+        PATH=FAKESLURM + os.pathsep + os.environ["PATH"],
+        JAX_PLATFORMS="cpu",
+        SBT_BACKEND="cpu",
+    )
+    return subprocess.run(
+        [sys.executable, "-m", "slurm_bridge_tpu.bridge.demo", *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=str(REPO),
+    )
+
+
+@pytest.mark.parametrize("scheduler", ["auction", "greedy"])
+def test_demo_walks_a_job_to_success(scheduler):
+    out = _run_demo(["--scheduler", scheduler], timeout=180)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-1000:]
+    assert "demo OK" in out.stdout
+    assert "job state: Succeeded" in out.stdout
+    assert "hello-from-slurm" in out.stdout  # logs actually streamed
+
+
+def test_demo_preemption_narrative():
+    out = _run_demo(["--preemption"], timeout=240)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-1000:]
+    assert "preemption demo OK" in out.stdout
+    # the four acts, in order
+    text = out.stdout
+    acts = [text.index(marker) for marker in (
+        "low: RUNNING",
+        "low: preempted",
+        "high: Succeeded",
+        "low: RUNNING again",
+    )]
+    assert acts == sorted(acts), f"narrative out of order:\n{text}"
