@@ -344,3 +344,52 @@ def test_kubeconfig_tls_with_custom_ca(tmp_path):
             assert json.load(resp)["items"] == []
     finally:
         httpd.shutdown()
+
+
+def test_full_constellation_cr_to_sidecar_to_status(fake_slurm, tmp_path):
+    """Capstone: every process boundary at once. A CR arrives from the
+    (fake) apiserver, the bridge solves it OUT-OF-PROCESS via the
+    PlacementSolver sidecar, the job runs on (fake) Slurm, and the
+    terminal status PATCHes back to the CR — the complete deployment
+    topology of docs/quick-start.md §2 + §2b in one test."""
+    from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
+    from slurm_bridge_tpu.bridge import Bridge, JobState
+    from slurm_bridge_tpu.solver.service import serve_solver
+    from slurm_bridge_tpu.wire import serve
+
+    hello = _sample_crs()[0]
+    api = _FakeApiServer([hello])
+    agent_sock = str(tmp_path / "agent.sock")
+    agent = serve(
+        {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
+        agent_sock,
+    )
+    solver_sock = str(tmp_path / "solver.sock")
+    solver = serve_solver(solver_sock, solver="auction")
+    bridge = Bridge(
+        agent_sock,
+        solver_endpoint=solver_sock,
+        scheduler_interval=0.05, configurator_interval=5.0,
+        node_sync_interval=0.05,
+    ).start()
+    adapter = KubeApiAdapter(
+        bridge, KubeConfig(base_url=api.url, token="test-token"), backoff=0.2
+    ).start()
+    try:
+        assert bridge.scheduler._remote is not None
+        job = None
+        assert _wait(lambda: any(j.name == "sample-hello" for j in bridge.list()))
+        job = bridge.wait("sample-hello", timeout=30.0)
+        assert job.status.state == JobState.SUCCEEDED
+        assert _wait(
+            lambda: any(
+                n == "sample-hello" and p["status"]["state"] == "Succeeded"
+                for n, p in api.patches
+            )
+        )
+    finally:
+        adapter.stop()
+        bridge.stop()
+        solver.stop(None)
+        agent.stop(None)
+        api.stop()
