@@ -174,6 +174,16 @@ def validate_bridge_job(job: BridgeJob) -> None:
         raise ValidationError("spec.partition is required")
     if not job.spec.sbatch_script.strip():
         raise ValidationError("spec.sbatchScript is required")
+    if job.spec.array:
+        # reject malformed/oversized specs at ingress: raised deeper (the
+        # sizing path) the ValueError would spin the reconcile-retry loop
+        # forever instead of failing the job with a reason
+        from slurm_bridge_tpu.core.arrays import array_len
+
+        try:
+            array_len(job.spec.array)
+        except ValueError as exc:
+            raise ValidationError(f"invalid spec.array: {exc}") from None
 
 
 # ---------------------------------------------------------------- Pod

@@ -10,18 +10,33 @@ array length multiplies placement demand (pod.go:153-156).
 
 from __future__ import annotations
 
+from typing import Iterator
 
-def parse_array_spec(spec: str) -> list[int]:
-    """Expand an --array spec into the sorted list of task ids."""
+#: Upper bound on task ids — slurm.conf MaxArraySize's own ceiling (slurm
+#: caps array indices at 4M; the common default is 1001). Without it,
+#: "--array=0-99999999" from a user script would materialize a
+#: hundred-million-element list in the control plane (found by hypothesis,
+#: tests/test_properties.py).
+MAX_ARRAY_SIZE = 4_000_001
+
+#: Expansion sizes up to this are counted exactly (set union over chunks);
+#: beyond it, multi-chunk counts fall back to the per-chunk arithmetic sum
+#: — a conservative upper bound when chunks overlap, but no multi-million
+#: element set ever exists in the sizing hot path.
+_EXACT_COUNT_LIMIT = 1 << 16
+
+
+def _iter_chunks(spec: str) -> Iterator[tuple[int, int, int]]:
+    """Yield (lo, hi, step) per comma-chunk — the ONE implementation of
+    the --array grammar; expansion and counting both consume it."""
     s = spec.strip()
     if not s:
-        return []
+        return
     # strip %N throttle suffix (applies to the whole spec)
     if "%" in s:
         s, _, throttle = s.rpartition("%")
         if not throttle.isdigit():
             raise ValueError(f"bad array throttle in {spec!r}")
-    ids: set[int] = set()
     for chunk in s.split(","):
         chunk = chunk.strip()
         if not chunk:
@@ -39,16 +54,41 @@ def parse_array_spec(spec: str) -> list[int]:
             lo, hi = int(lo_s), int(hi_s)
             if hi < lo:
                 raise ValueError(f"inverted array range in {spec!r}")
-            ids.update(range(lo, hi + 1, step))
         else:
             if not chunk.isdigit():
                 raise ValueError(f"bad array id in {spec!r}")
-            ids.add(int(chunk))
+            lo = hi = int(chunk)
+        if hi >= MAX_ARRAY_SIZE:
+            raise ValueError(
+                f"array range in {spec!r} exceeds MaxArraySize "
+                f"({MAX_ARRAY_SIZE - 1})"
+            )
+        yield lo, hi, step
+
+
+def parse_array_spec(spec: str) -> list[int]:
+    """Expand an --array spec into the sorted list of task ids."""
+    ids: set[int] = set()
+    for lo, hi, step in _iter_chunks(spec):
+        ids.update(range(lo, hi + 1, step))
     return sorted(ids)
 
 
 def array_len(spec: str) -> int:
-    """Number of array tasks; 1 for the empty spec (non-array job)."""
-    if not spec.strip():
+    """Number of array tasks; 1 for the empty spec (non-array job).
+
+    Counted arithmetically per chunk — the sizecar sizing hot path never
+    materializes task ids for large legal specs. Multi-chunk specs whose
+    arithmetic total stays small are counted exactly (duplicates between
+    overlapping chunks collapse, matching :func:`parse_array_spec`);
+    larger ones use the per-chunk sum, a conservative upper bound."""
+    chunks = list(_iter_chunks(spec))
+    if not chunks:
         return 1
-    return max(1, len(parse_array_spec(spec)))
+    total = sum((hi - lo) // step + 1 for lo, hi, step in chunks)
+    if len(chunks) > 1 and total <= _EXACT_COUNT_LIMIT:
+        ids: set[int] = set()
+        for lo, hi, step in chunks:
+            ids.update(range(lo, hi + 1, step))
+        total = len(ids)
+    return max(1, total)

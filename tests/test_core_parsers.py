@@ -342,3 +342,45 @@ def test_quoted_directive_values():
 def test_directive_trailing_comment():
     d = extract_batch_resources("#!/bin/sh\n#SBATCH --nodes=3  # three nodes\ntrue\n")
     assert d.demand.nodes == 3
+
+
+def test_array_len_no_materialization_and_exact_overlap():
+    """Large legal specs count arithmetically (no multi-million-element
+    set — found by hypothesis); small comma lists stay exact across
+    overlapping chunks; oversized specs are rejected."""
+    import time
+
+    from slurm_bridge_tpu.core.arrays import MAX_ARRAY_SIZE, array_len
+
+    t0 = time.perf_counter()
+    assert array_len("0-3999999") == 4_000_000
+    assert array_len("0-3999999,0") == 4_000_001  # conservative upper bound
+    assert (time.perf_counter() - t0) < 0.1, "large count must not expand"
+    assert array_len("0-10,5-15") == 16  # small overlap counted exactly
+    assert array_len("0-15%4") == 16
+    with pytest.raises(ValueError):
+        array_len(f"0-{MAX_ARRAY_SIZE}")
+
+
+def test_validate_rejects_bad_array_spec_at_ingress():
+    """An oversized/malformed --array must fail validation with a reason,
+    not spin the reconcile loop on a deep ValueError (r3 review)."""
+    from slurm_bridge_tpu.bridge.objects import (
+        BridgeJob,
+        BridgeJobSpec,
+        Meta,
+        ValidationError,
+        validate_bridge_job,
+    )
+
+    def job(array):
+        return BridgeJob(
+            meta=Meta(name="j"),
+            spec=BridgeJobSpec(partition="p", sbatch_script="#!/bin/sh\n",
+                               array=array),
+        )
+
+    validate_bridge_job(job("0-3"))  # sane spec passes
+    for bad in ("0-99999999", "1-", "a-b", "1-5:0"):
+        with pytest.raises(ValidationError):
+            validate_bridge_job(job(bad))

@@ -377,7 +377,6 @@ def test_full_constellation_cr_to_sidecar_to_status(fake_slurm, tmp_path):
     ).start()
     try:
         assert bridge.scheduler._remote is not None
-        job = None
         assert _wait(lambda: any(j.name == "sample-hello" for j in bridge.list()))
         job = bridge.wait("sample-hello", timeout=30.0)
         assert job.status.state == JobState.SUCCEEDED

@@ -65,12 +65,36 @@ def test_array_spec_ranges(a, b, step):
 
 
 @given(st.text(alphabet="0123456789-,:%", max_size=16))
-@settings(max_examples=200)
+@settings(max_examples=200, deadline=None)  # legal 4M-range expansion is slow
 def test_array_spec_never_crashes(spec):
     try:
         parse_array_spec(spec)
     except ValueError:
         pass
+
+
+@given(st.integers(0, 10**12), st.integers(0, 10**12))
+def test_array_spec_bounded(a, b):
+    """Absurd --array ranges from user scripts must raise, never
+    materialize (found by hypothesis: '0-3000000' stalled the control
+    plane's sizing path; Slurm itself enforces MaxArraySize)."""
+    from slurm_bridge_tpu.core.arrays import MAX_ARRAY_SIZE
+
+    lo, hi = min(a, b), max(a, b)
+    spec = f"{lo}-{hi}"
+    if hi >= MAX_ARRAY_SIZE:
+        try:
+            parse_array_spec(spec)
+            raise AssertionError("oversized range must be rejected")
+        except ValueError:
+            pass
+        try:
+            array_len(spec)
+            raise AssertionError("oversized range must be rejected")
+        except ValueError:
+            pass
+    else:
+        assert array_len(spec) == (hi - lo) + 1
 
 
 # ---------------------------------------------------------------- solver
