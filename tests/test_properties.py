@@ -122,3 +122,38 @@ def test_auction_feasible_on_random_tiny_scenarios(n, p, rnd):
     )
     placement = auction_place(snap, batch, AuctionConfig(rounds=4))
     _check_feasible(snap, batch, placement)
+
+
+# ----------------------------------------------- script / scontrol parsers
+
+
+@given(st.text(max_size=200))
+@settings(max_examples=100)
+def test_sbatch_extract_never_crashes(script):
+    """#SBATCH header extraction feeds on raw user scripts — arbitrary
+    bytes must parse or ValueError, never crash (reference analogue:
+    extractBatchResourcesFromScript, parse.go:30-124)."""
+    from slurm_bridge_tpu.core.sbatch import extract_batch_resources
+
+    try:
+        extract_batch_resources(script)
+    except ValueError:
+        pass
+
+
+@given(st.text(max_size=300))
+@settings(max_examples=100)
+def test_scontrol_parsers_never_crash(text):
+    """scontrol/sacct output parsing is the agent's L0 boundary; a
+    malformed record (truncated output, locale surprises) must degrade,
+    not crash the agent."""
+    from slurm_bridge_tpu.core.scontrol import (
+        parse_job_info,
+        parse_partition_info,
+    )
+
+    for fn in (parse_job_info, parse_partition_info):
+        try:
+            fn(text)
+        except ValueError:
+            pass
