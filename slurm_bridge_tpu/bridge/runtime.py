@@ -44,7 +44,7 @@ class Bridge:
         self,
         agent_endpoint: str,
         *,
-        scheduler_backend: str = "auction",
+        scheduler_backend: str = "auto",
         auction_config: AuctionConfig | None = None,
         preemption: bool = False,
         solver_endpoint: str = "",

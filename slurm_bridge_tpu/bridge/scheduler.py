@@ -7,7 +7,10 @@ status tick, this scheduler takes ONE batched snapshot of the whole node
 inventory per tick, lowers the entire pending queue into dense matrices,
 and solves the assignment with the JAX auction kernel (or the greedy packer
 behind ``backend="greedy"`` — the reference-parity path kept intact per
-BASELINE.md's north star).
+BASELINE.md's north star). The default ``backend="auto"`` routes each tick
+by backend and problem size (solver/routing.py): solves below the device
+dispatch floor — or any solve without an accelerator — run on the indexed
+native packer instead of paying a device round-trip.
 
 A placed job's pod is bound to its partition's virtual node; the exact
 Slurm nodes the solver chose ride along as ``spec.placement_hint`` (the
@@ -79,7 +82,7 @@ class PlacementScheduler:
         store: ObjectStore,
         client: ServiceClient,
         *,
-        backend: str = "auction",
+        backend: str = "auto",
         auction_config: AuctionConfig | None = None,
         events: EventRecorder | None = None,
         preemption: bool = False,
@@ -90,8 +93,14 @@ class PlacementScheduler:
         retry_cancel_timeout: float = 2.0,
         place_timeout: float = 120.0,
     ):
-        if backend not in ("auction", "greedy"):
+        if backend not in ("auto", "auction", "greedy"):
             raise ValueError(f"unknown scheduler backend {backend!r}")
+        if backend == "auto":
+            # validate-at-ingress: a malformed SBT_ROUTE_FLOOR_CELLS must
+            # refuse startup, not fail every tick inside _solve
+            from slurm_bridge_tpu.solver.routing import floor_cells
+
+            floor_cells()
         self.store = store
         self.client = client
         self.backend = backend
@@ -123,6 +132,10 @@ class PlacementScheduler:
         # cancels whose pod vanished before the failure could be annotated;
         # retried alongside the annotated ones
         self._orphan_cancels: set[int] = set()
+        #: which engine the last local solve ran on ("greedy", "native",
+        #: "auction", "auction-sharded") — observability for the routing
+        #: decision (VERDICT r3 #5); tests assert on it
+        self.last_route: str = ""
 
     # ---- inventory ----
 
@@ -183,7 +196,7 @@ class PlacementScheduler:
             return 0
         # preemption needs incumbent pinning, which only the auction kernel
         # honours — the greedy oracle would spuriously displace everyone
-        use_preemption = self.preemption and self.backend == "auction"
+        use_preemption = self.preemption and self.backend in ("auto", "auction")
         incumbents = self.incumbent_pods() if use_preemption else []
         t0 = time.perf_counter()
         partitions, nodes = self.cluster_state()
@@ -370,7 +383,23 @@ class PlacementScheduler:
 
     def _solve(self, snapshot, batch, incumbent):
         if self.backend == "greedy":
+            self.last_route = "greedy"
             return greedy_place(snapshot, batch)
+        # auto routing (VERDICT r3 #5): a solve below the device dispatch
+        # floor — or any solve without an accelerator — goes to the indexed
+        # native packer (greedy-parity quality, no dispatch round-trip).
+        # Pinned incumbents force the auction kernel: only it honours them,
+        # and routing them to the packer would spuriously preempt everyone.
+        if self.backend == "auto" and not (incumbent >= 0).any():
+            from slurm_bridge_tpu.solver.routing import choose_path
+
+            if choose_path(batch.num_shards, snapshot.num_nodes) == "native":
+                from slurm_bridge_tpu.solver.indexed_native import (
+                    indexed_place_native,
+                )
+
+                self.last_route = "native"
+                return indexed_place_native(snapshot, batch)
         p_real = batch.num_shards
         if self.bucket:
             batch = pad_batch(batch, self.bucket)
@@ -381,10 +410,12 @@ class PlacementScheduler:
         if self._use_sharded(batch, snapshot):
             from slurm_bridge_tpu.solver.sharded import sharded_place
 
+            self.last_route = "auction-sharded"
             placement = sharded_place(
                 snapshot, batch, self.auction_config, incumbent=incumbent
             )
         else:
+            self.last_route = "auction"
             if self._solver is None:
                 self._solver = DeviceSolver(snapshot, self.auction_config)
             else:

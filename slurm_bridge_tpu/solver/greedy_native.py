@@ -1,72 +1,31 @@
 """ctypes binding for the native greedy packer (solver/native/greedy.cpp).
 
-The shared library is compiled on first use with g++ -O3 and cached next to
-the source; rebuilds happen automatically when the source is newer than the
-binary. No pybind11 dependency — plain C ABI via ctypes.
+This is the measured baseline the ≥10× target is defined against
+(BASELINE.md) — semantics bit-identical to the Python oracle
+:func:`greedy.greedy_place`, asserted by the test suite. Built on first
+use via the shared loader (:mod:`nativelib`); a host without a C++
+toolchain falls back to the oracle (identical placements, just slow).
 """
 
 from __future__ import annotations
 
-import ctypes
+import logging
 import pathlib
-import subprocess
-import threading
 
-import numpy as np
-
+from slurm_bridge_tpu.solver.nativelib import (
+    NativeBuildError,
+    call_place,
+    load_symbol,
+    place_argtypes,
+)
 from slurm_bridge_tpu.solver.snapshot import ClusterSnapshot, JobBatch, Placement
+
+log = logging.getLogger("sbt.solver")
 
 _SRC = pathlib.Path(__file__).parent / "native" / "greedy.cpp"
 _LIB = pathlib.Path(__file__).parent / "native" / "libsbtgreedy.so"
-_lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
 
-
-def _build() -> None:
-    cmd = [
-        "g++",
-        "-O3",
-        "-march=native",
-        "-shared",
-        "-fPIC",
-        "-std=c++17",
-        str(_SRC),
-        "-o",
-        str(_LIB),
-    ]
-    subprocess.run(cmd, check=True, capture_output=True)
-
-
-def _load() -> ctypes.CDLL:
-    global _lib
-    with _lock:
-        if _lib is not None:
-            return _lib
-        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
-            _build()
-        lib = ctypes.CDLL(str(_LIB))
-        lib.sbt_greedy_place.restype = ctypes.c_int
-        lib.sbt_greedy_place.argtypes = [
-            ctypes.c_int,  # n
-            ctypes.c_int,  # r
-            ctypes.POINTER(ctypes.c_float),  # free_io
-            ctypes.POINTER(ctypes.c_int32),  # node_part
-            ctypes.POINTER(ctypes.c_uint32),  # node_feat
-            ctypes.c_int,  # p
-            ctypes.POINTER(ctypes.c_float),  # dem
-            ctypes.POINTER(ctypes.c_int32),  # job_part
-            ctypes.POINTER(ctypes.c_uint32),  # req_feat
-            ctypes.POINTER(ctypes.c_float),  # prio
-            ctypes.POINTER(ctypes.c_int32),  # gang
-            ctypes.c_int,  # best_fit
-            ctypes.POINTER(ctypes.c_int32),  # out_assign
-        ]
-        _lib = lib
-        return lib
-
-
-def _ptr(a: np.ndarray, ctype):
-    return a.ctypes.data_as(ctypes.POINTER(ctype))
+_build_failed = False
 
 
 def greedy_place_native(
@@ -76,36 +35,19 @@ def greedy_place_native(
     best_fit: bool = True,
 ) -> Placement:
     """Drop-in replacement for :func:`greedy.greedy_place`, ~100× faster."""
-    lib = _load()
-    n, r = snapshot.free.shape
-    p = batch.num_shards
-    free_io = np.ascontiguousarray(snapshot.free, dtype=np.float32).copy()
-    assign = np.full(p, -1, dtype=np.int32)
-    node_part = np.ascontiguousarray(snapshot.partition_of, dtype=np.int32)
-    node_feat = np.ascontiguousarray(snapshot.features, dtype=np.uint32)
-    dem = np.ascontiguousarray(batch.demand, dtype=np.float32)
-    job_part = np.ascontiguousarray(batch.partition_of, dtype=np.int32)
-    req_feat = np.ascontiguousarray(batch.req_features, dtype=np.uint32)
-    prio = np.ascontiguousarray(batch.priority, dtype=np.float32)
-    # gang ids index a p-sized table in C++ — remap arbitrary ids into [0, p)
-    from slurm_bridge_tpu.solver.auction import normalize_gangs
+    global _build_failed
+    if _build_failed:
+        from slurm_bridge_tpu.solver.greedy import greedy_place
 
-    gang = np.ascontiguousarray(normalize_gangs(batch.gang_id), dtype=np.int32)
-    rc = lib.sbt_greedy_place(
-        n,
-        r,
-        _ptr(free_io, ctypes.c_float),
-        _ptr(node_part, ctypes.c_int32),
-        _ptr(node_feat, ctypes.c_uint32),
-        p,
-        _ptr(dem, ctypes.c_float),
-        _ptr(job_part, ctypes.c_int32),
-        _ptr(req_feat, ctypes.c_uint32),
-        _ptr(prio, ctypes.c_float),
-        _ptr(gang, ctypes.c_int32),
-        1 if best_fit else 0,
-        _ptr(assign, ctypes.c_int32),
-    )
-    if rc < 0:
-        raise ValueError("native greedy rejected gang ids (out of [0, p) range)")
-    return Placement(node_of=assign, placed=assign >= 0, free_after=free_io)
+        return greedy_place(snapshot, batch, best_fit=best_fit)
+    try:
+        fn = load_symbol(
+            _SRC, _LIB, "sbt_greedy_place", place_argtypes(with_best_fit=True)
+        )
+    except NativeBuildError as exc:
+        _build_failed = True
+        log.warning("%s — falling back to the pure-Python packer", exc)
+        from slurm_bridge_tpu.solver.greedy import greedy_place
+
+        return greedy_place(snapshot, batch, best_fit=best_fit)
+    return call_place(fn, snapshot, batch, best_fit=best_fit)

@@ -1,0 +1,64 @@
+"""ctypes binding for the indexed native packer (solver/native/indexed.cpp).
+
+Same contract and placement semantics as :func:`greedy_native.greedy_place_native`
+(bit-identical results — asserted by tests/test_solver.py), but
+O((P+N)·log N) via per-(partition, feature) ordered buckets instead of the
+baseline's O(P·N) scan. This is the CPU fast path the scheduler and bench
+route to when no accelerator is available or the solve is below the device
+dispatch floor (solver/routing.py); greedy.cpp stays untouched as the
+measured baseline.
+
+Degradation chain if indexed.cpp won't build: the native greedy baseline
+(same placements, ~20× slower at the headline shape), which itself falls
+back to the pure-Python oracle when no toolchain exists at all.
+"""
+
+from __future__ import annotations
+
+import logging
+import pathlib
+
+from slurm_bridge_tpu.solver.nativelib import (
+    NativeBuildError,
+    call_place,
+    load_symbol,
+    place_argtypes,
+)
+from slurm_bridge_tpu.solver.snapshot import ClusterSnapshot, JobBatch, Placement
+
+log = logging.getLogger("sbt.solver")
+
+_SRC = pathlib.Path(__file__).parent / "native" / "indexed.cpp"
+_LIB = pathlib.Path(__file__).parent / "native" / "libsbtindexed.so"
+
+_build_failed = False
+
+
+def indexed_place_native(
+    snapshot: ClusterSnapshot,
+    batch: JobBatch,
+    *,
+    best_fit: bool = True,
+) -> Placement:
+    """Drop-in replacement for :func:`greedy.greedy_place`, index-accelerated.
+
+    First-fit parity (lowest node index that fits) cannot ride the
+    free-cpu-ordered index, so ``best_fit=False`` delegates to the baseline
+    native packer — the fast path is best-fit, the production default.
+    """
+    global _build_failed
+    from slurm_bridge_tpu.solver.greedy_native import greedy_place_native
+
+    if not best_fit or _build_failed:
+        return greedy_place_native(snapshot, batch, best_fit=best_fit)
+    try:
+        fn = load_symbol(
+            _SRC, _LIB, "sbt_indexed_place", place_argtypes(with_best_fit=False)
+        )
+    except NativeBuildError as exc:
+        # degrade, don't crash the tick: the native greedy places
+        # identically (and has its own oracle fallback for no-toolchain)
+        _build_failed = True
+        log.warning("%s — falling back to the native greedy packer", exc)
+        return greedy_place_native(snapshot, batch)
+    return call_place(fn, snapshot, batch)

@@ -1,0 +1,195 @@
+// Indexed native packer — the CPU fast path.
+//
+// Same placement semantics as greedy.cpp (priority-ordered best-fit,
+// all-or-nothing distinct-node gangs — the reference-parity algorithm,
+// SURVEY.md §6 "Scheduling algorithm") but O((P+N)·log N) instead of the
+// baseline's O(P·N) full-inventory scan: nodes live in per-
+// (partition, feature-mask) buckets ordered by (free_cpu, node index), and
+// best-fit is a lower_bound + forward scan — the first node in ascending
+// free-cpu order that satisfies every resource dimension IS the exact
+// best-fit choice (minimal cpu leftover, lowest index on ties), so results
+// are bit-identical to greedy.cpp / the Python oracle, which the test
+// suite asserts.
+//
+// This is what the product scheduler and bench route to when no
+// accelerator is present (or the solve is smaller than the device dispatch
+// floor): greedy-parity quality at a small fraction of the baseline's
+// latency on a single core. greedy.cpp itself stays untouched — it is the
+// measured baseline (BASELINE.md) and must not inherit this speedup.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <set>
+#include <utility>
+#include <vector>
+
+namespace {
+
+using Key = std::pair<float, int32_t>;  // (free_cpu, node index)
+
+struct Bucket {
+  int32_t part;
+  uint32_t feat;
+  std::multiset<Key> nodes;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Identical contract to sbt_greedy_place (greedy.cpp) in best-fit mode:
+// returns the number of placed shards, -1 on out-of-range gang ids.
+// free_io is n*r floats updated in place; out_assign[p] = node index or -1.
+// First-fit (lowest node INDEX that fits) cannot ride a free-cpu-ordered
+// index, so the Python wrapper delegates best_fit=False to the baseline.
+int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
+                      const uint32_t* node_feat, int p, const float* dem,
+                      const int32_t* job_part, const uint32_t* req_feat,
+                      const float* prio, const int32_t* gang,
+                      int32_t* out_assign) {
+  if (p <= 0) return 0;
+  for (int i = 0; i < p; ++i) {
+    if (gang[i] < 0 || gang[i] >= p) return -1;
+  }
+
+  // ---- build the index: bucket per distinct (partition, feature mask) ----
+  std::vector<Bucket> buckets;
+  std::vector<int32_t> node_bucket(n, -1);
+  std::vector<std::multiset<Key>::iterator> node_it(n);
+  {
+    // bucket discovery via a tiny open-addressed probe over the (part,
+    // feat) pairs; real clusters have a handful of combinations
+    for (int nd = 0; nd < n; ++nd) {
+      int32_t b = -1;
+      for (size_t i = 0; i < buckets.size(); ++i) {
+        if (buckets[i].part == node_part[nd] && buckets[i].feat == node_feat[nd]) {
+          b = static_cast<int32_t>(i);
+          break;
+        }
+      }
+      if (b < 0) {
+        b = static_cast<int32_t>(buckets.size());
+        buckets.push_back(Bucket{node_part[nd], node_feat[nd], {}});
+      }
+      node_bucket[nd] = b;
+      node_it[nd] = buckets[b].nodes.insert(
+          Key{free_io[static_cast<size_t>(nd) * r], nd});
+    }
+  }
+
+  // stable order by priority descending, gangs grouped by first appearance
+  std::vector<int32_t> order(p);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+    return prio[a] > prio[b];
+  });
+  std::vector<std::vector<int32_t>> gangs;
+  {
+    std::vector<int32_t> gang_slot(p, -1);
+    for (int32_t idx : order) {
+      int32_t g = gang[idx];
+      if (gang_slot[g] < 0) {
+        gang_slot[g] = static_cast<int32_t>(gangs.size());
+        gangs.emplace_back();
+      }
+      gangs[gang_slot[g]].push_back(idx);
+    }
+  }
+
+  std::fill(out_assign, out_assign + p, -1);
+  std::vector<char> gang_used(n, 0);
+  std::vector<int32_t> gang_used_list;
+  // undo log for multi-shard gangs: (node, old free row) so a failed gang
+  // rolls back both the matrix and the index without copying either
+  std::vector<int32_t> touched_node;
+  std::vector<float> touched_free;
+  std::vector<int32_t> chosen_shard, chosen_node;
+  int placed = 0;
+
+  auto reindex = [&](int32_t nd) {
+    Bucket& bk = buckets[node_bucket[nd]];
+    bk.nodes.erase(node_it[nd]);
+    node_it[nd] = bk.nodes.insert(Key{free_io[static_cast<size_t>(nd) * r], nd});
+  };
+
+  for (const auto& shards : gangs) {
+    const bool multi = shards.size() > 1;
+    chosen_shard.clear();
+    chosen_node.clear();
+    touched_node.clear();
+    touched_free.clear();
+    for (int32_t nd : gang_used_list) gang_used[nd] = 0;
+    gang_used_list.clear();
+    bool ok = true;
+
+    for (int32_t s : shards) {
+      const float* d = dem + static_cast<size_t>(s) * r;
+      const int32_t jp = job_part[s];
+      const uint32_t rf = req_feat[s];
+      // best across matching buckets by (free_cpu, node index) — exactly
+      // the baseline's min-leftover / lowest-index tie-break
+      int32_t best_node = -1;
+      Key best_key{0.f, 0};
+      for (Bucket& bk : buckets) {
+        if (jp >= 0 && bk.part != jp) continue;
+        if ((bk.feat & rf) != rf) continue;
+        auto it = bk.nodes.lower_bound(Key{d[0], INT32_MIN});
+        for (; it != bk.nodes.end(); ++it) {
+          if (best_node >= 0 && *it >= best_key) break;  // can't improve
+          const int32_t nd = it->second;
+          if (multi && gang_used[nd]) continue;
+          const float* f = free_io + static_cast<size_t>(nd) * r;
+          bool fits = true;
+          for (int k = 1; k < r; ++k) {
+            if (f[k] < d[k]) {
+              fits = false;
+              break;
+            }
+          }
+          if (!fits) continue;
+          best_node = nd;
+          best_key = *it;
+          break;  // first fit in ascending (free_cpu, idx) = best fit
+        }
+      }
+      if (best_node < 0) {
+        ok = false;
+        break;
+      }
+      float* f = free_io + static_cast<size_t>(best_node) * r;
+      if (multi) {
+        touched_node.push_back(best_node);
+        touched_free.insert(touched_free.end(), f, f + r);
+      }
+      for (int k = 0; k < r; ++k) f[k] -= d[k];
+      reindex(best_node);
+      chosen_shard.push_back(s);
+      chosen_node.push_back(best_node);
+      if (multi) {
+        gang_used[best_node] = 1;
+        gang_used_list.push_back(best_node);
+      }
+    }
+
+    if (ok) {
+      for (size_t i = 0; i < chosen_shard.size(); ++i) {
+        out_assign[chosen_shard[i]] = chosen_node[i];
+        ++placed;
+      }
+    } else if (multi) {
+      // roll back in reverse so a node touched twice restores correctly
+      for (size_t i = touched_node.size(); i-- > 0;) {
+        const int32_t nd = touched_node[i];
+        std::memcpy(free_io + static_cast<size_t>(nd) * r,
+                    touched_free.data() + i * r, sizeof(float) * r);
+        reindex(nd);
+      }
+    }
+    // single-shard failure touched nothing
+  }
+  return placed;
+}
+
+}  // extern "C"

@@ -1,0 +1,141 @@
+"""Shared ctypes loader for the native solver libraries.
+
+Both native packers (greedy.cpp — the measured baseline — and indexed.cpp —
+the CPU fast path) are plain C-ABI shared objects compiled on first use
+with g++ -O3 and cached next to their source; a rebuild happens whenever
+the source is newer than the binary. One loader serves both so build
+flags, rebuild logic, and error surfacing cannot drift apart.
+
+No pybind11 (environment constraint) — plain ctypes. A host without a
+C++ toolchain raises :class:`NativeBuildError` with the compiler's stderr;
+callers degrade to the pure-Python oracle rather than crashing the
+scheduler tick.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_loaded: dict[str, ctypes.CDLL] = {}
+
+
+class NativeBuildError(RuntimeError):
+    """g++ missing or the compile failed; message carries the stderr."""
+
+
+def _build(src: pathlib.Path, lib: pathlib.Path) -> None:
+    cmd = [
+        "g++",
+        "-O3",
+        "-march=native",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        str(src),
+        "-o",
+        str(lib),
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+    except OSError as exc:  # g++ not on PATH
+        raise NativeBuildError(
+            f"cannot build {lib.name}: g++ unavailable ({exc})"
+        ) from exc
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"g++ failed building {lib.name} (rc={proc.returncode}):\n"
+            f"{proc.stderr.strip()}"
+        )
+
+
+def load_symbol(
+    src: pathlib.Path,
+    lib: pathlib.Path,
+    symbol: str,
+    argtypes: list,
+    restype=ctypes.c_int,
+):
+    """Return the bound function ``symbol`` from ``lib``, building it from
+    ``src`` first when missing or stale. Thread-safe; cached per path."""
+    key = str(lib)
+    with _lock:
+        cdll = _loaded.get(key)
+        if cdll is None:
+            if not lib.exists() or lib.stat().st_mtime < src.stat().st_mtime:
+                _build(src, lib)
+            cdll = ctypes.CDLL(key)
+            _loaded[key] = cdll
+    fn = getattr(cdll, symbol)
+    fn.restype = restype
+    fn.argtypes = argtypes
+    return fn
+
+
+def ptr(a, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def place_argtypes(*, with_best_fit: bool) -> list:
+    """The shared C ABI of both packers (greedy.cpp carries a best_fit
+    flag before the output pointer; indexed.cpp is best-fit only)."""
+    argtypes = [
+        ctypes.c_int,  # n
+        ctypes.c_int,  # r
+        ctypes.POINTER(ctypes.c_float),  # free_io
+        ctypes.POINTER(ctypes.c_int32),  # node_part
+        ctypes.POINTER(ctypes.c_uint32),  # node_feat
+        ctypes.c_int,  # p
+        ctypes.POINTER(ctypes.c_float),  # dem
+        ctypes.POINTER(ctypes.c_int32),  # job_part
+        ctypes.POINTER(ctypes.c_uint32),  # req_feat
+        ctypes.POINTER(ctypes.c_float),  # prio
+        ctypes.POINTER(ctypes.c_int32),  # gang
+    ]
+    if with_best_fit:
+        argtypes.append(ctypes.c_int)
+    argtypes.append(ctypes.POINTER(ctypes.c_int32))  # out_assign
+    return argtypes
+
+
+def call_place(fn, snapshot, batch, *, best_fit: bool | None = None):
+    """Marshal a (snapshot, batch) pair into the shared packer ABI, call
+    ``fn``, and lift the result back into a Placement.
+
+    ``best_fit=None`` omits the flag argument (for indexed.cpp); both
+    bindings share this marshalling so the array contract cannot drift.
+    """
+    import numpy as np
+
+    from slurm_bridge_tpu.solver.auction import normalize_gangs
+    from slurm_bridge_tpu.solver.snapshot import Placement
+
+    n, r = snapshot.free.shape
+    p = batch.num_shards
+    free_io = np.ascontiguousarray(snapshot.free, dtype=np.float32).copy()
+    assign = np.full(p, -1, dtype=np.int32)
+    # gang ids index a p-sized table in C++ — remap arbitrary ids into [0, p)
+    gang = np.ascontiguousarray(normalize_gangs(batch.gang_id), dtype=np.int32)
+    args = [
+        n,
+        r,
+        ptr(free_io, ctypes.c_float),
+        ptr(np.ascontiguousarray(snapshot.partition_of, np.int32), ctypes.c_int32),
+        ptr(np.ascontiguousarray(snapshot.features, np.uint32), ctypes.c_uint32),
+        p,
+        ptr(np.ascontiguousarray(batch.demand, np.float32), ctypes.c_float),
+        ptr(np.ascontiguousarray(batch.partition_of, np.int32), ctypes.c_int32),
+        ptr(np.ascontiguousarray(batch.req_features, np.uint32), ctypes.c_uint32),
+        ptr(np.ascontiguousarray(batch.priority, np.float32), ctypes.c_float),
+        ptr(gang, ctypes.c_int32),
+    ]
+    if best_fit is not None:
+        args.append(1 if best_fit else 0)
+    args.append(ptr(assign, ctypes.c_int32))
+    rc = fn(*args)
+    if rc < 0:
+        raise ValueError("native packer rejected gang ids (out of [0, p) range)")
+    return Placement(node_of=assign, placed=assign >= 0, free_after=free_io)

@@ -239,6 +239,123 @@ def test_native_greedy_empty():
     assert pl.node_of.shape == (0,)
 
 
+# ---------------------------------------------------------------- indexed native
+
+
+@pytest.mark.parametrize("seed", [0, 3, 5, 9, 13])
+def test_indexed_native_matches_python(seed):
+    """Bit-exact parity with the oracle: same nodes, same free matrix."""
+    from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+
+    snap, batch = random_scenario(48, 300, seed=seed, load=0.9,
+                                  gpu_fraction=0.2, gang_fraction=0.15)
+    py = greedy_place(snap, batch)
+    idx = indexed_place_native(snap, batch)
+    assert np.array_equal(py.node_of, idx.node_of)
+    assert np.allclose(py.free_after, idx.free_after, atol=1e-3)
+
+
+def test_indexed_native_first_fit_delegates():
+    """best_fit=False can't ride the free-cpu index — must match the oracle
+    via the baseline delegation."""
+    from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+
+    snap, batch = random_scenario(32, 120, seed=4, load=0.8, gang_fraction=0.1)
+    py = greedy_place(snap, batch, best_fit=False)
+    idx = indexed_place_native(snap, batch, best_fit=False)
+    assert np.array_equal(py.node_of, idx.node_of)
+
+
+def test_indexed_native_any_partition_and_unknown_features():
+    """partition -1 (any) searches every bucket; an unsatisfiable feature
+    mask places nothing — same answers as the oracle."""
+    from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+    from slurm_bridge_tpu.solver.snapshot import JobBatch
+
+    snap, base = random_scenario(24, 60, seed=6, load=0.6, gpu_fraction=0.3)
+    part = base.partition_of.copy()
+    part[::3] = -1  # every third shard: any partition
+    feat = base.req_features.copy()
+    feat[1] = np.uint32(1 << 31)  # reserved impossible bit
+    batch = JobBatch(
+        demand=base.demand, partition_of=part, req_features=feat,
+        priority=base.priority, gang_id=base.gang_id, job_of=base.job_of,
+    )
+    py = greedy_place(snap, batch)
+    idx = indexed_place_native(snap, batch)
+    assert np.array_equal(py.node_of, idx.node_of)
+    assert not idx.placed[1]
+
+
+def test_indexed_native_gang_rollback_restores_index():
+    """A failed gang must roll back the free matrix AND the ordered index —
+    later shards have to see pre-gang capacity (parity catches both)."""
+    from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+
+    # tight cluster, big gangs: some gangs fail after partial placement
+    snap, batch = random_scenario(12, 40, seed=2, load=1.5,
+                                  gang_fraction=0.8, gang_size=6)
+    py = greedy_place(snap, batch)
+    idx = indexed_place_native(snap, batch)
+    assert np.array_equal(py.node_of, idx.node_of)
+    assert np.allclose(py.free_after, idx.free_after, atol=1e-3)
+
+
+def test_indexed_native_empty():
+    from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+    from slurm_bridge_tpu.solver.snapshot import JobBatch
+
+    snap, _ = random_scenario(8, 10, seed=0)
+    empty = JobBatch(
+        demand=np.zeros((0, 3), np.float32),
+        partition_of=np.zeros(0, np.int32),
+        req_features=np.zeros(0, np.uint32),
+        priority=np.zeros(0, np.float32),
+        gang_id=np.zeros(0, np.int32),
+        job_of=np.zeros(0, np.int32),
+    )
+    pl = indexed_place_native(snap, empty)
+    assert pl.node_of.shape == (0,)
+
+
+def test_indexed_native_build_failure_falls_back(monkeypatch):
+    """No C++ toolchain must degrade to the oracle, not crash the tick."""
+    import slurm_bridge_tpu.solver.indexed_native as inat
+    from slurm_bridge_tpu.solver.nativelib import NativeBuildError
+
+    def boom(*a, **k):
+        raise NativeBuildError("g++ unavailable (simulated)")
+
+    monkeypatch.setattr(inat, "load_symbol", boom)
+    monkeypatch.setattr(inat, "_build_failed", False)
+    snap, batch = random_scenario(16, 40, seed=1, gang_fraction=0.2)
+    pl = inat.indexed_place_native(snap, batch)
+    ref = greedy_place(snap, batch)
+    assert np.array_equal(pl.node_of, ref.node_of)
+    assert inat._build_failed  # probe not repeated every tick
+
+
+# ---------------------------------------------------------------- routing
+
+
+def test_choose_path_rules(monkeypatch):
+    from slurm_bridge_tpu.solver.routing import DISPATCH_FLOOR_CELLS, choose_path
+
+    # no accelerator: always native, size notwithstanding
+    assert choose_path(50_000, 10_000, backend_name="cpu") == "native"
+    assert choose_path(10, 10, backend_name="cpu") == "native"
+    # accelerator: device above the floor, native below it
+    assert choose_path(50_000, 10_000, backend_name="tpu") == "device"
+    assert choose_path(5_000, 512, backend_name="tpu") == "native"
+    assert 5_000 * 512 < DISPATCH_FLOOR_CELLS <= 50_000 * 10_000
+    # env override wins
+    monkeypatch.setenv("SBT_ROUTE_FLOOR_CELLS", "100")
+    assert choose_path(5_000, 512, backend_name="tpu") == "device"
+    monkeypatch.setenv("SBT_ROUTE_FLOOR_CELLS", "bogus")
+    with pytest.raises(ValueError, match="SBT_ROUTE_FLOOR_CELLS"):
+        choose_path(5_000, 512, backend_name="tpu")
+
+
 # ---------------------------------------------------------------- sharded
 
 
