@@ -111,11 +111,16 @@ def main(argv: list[str] | None = None) -> int:
     fatal: list[BaseException] = []
 
     kube_adapter = [None]
+    kube_mirror = [None]
 
     def start_kube_adapter() -> None:
         if not args.kube_api:
             return
-        from slurm_bridge_tpu.bridge.kubeapi import KubeApiAdapter, KubeConfig
+        from slurm_bridge_tpu.bridge.kubeapi import (
+            KubeApiAdapter,
+            KubeConfig,
+            NodePodMirror,
+        )
 
         if args.kube_api == "in-cluster":
             cfg = KubeConfig.in_cluster()
@@ -131,6 +136,8 @@ def main(argv: list[str] | None = None) -> int:
                 ca_file=args.kube_ca_file,
             )
         kube_adapter[0] = KubeApiAdapter(bridge, cfg).start()
+        # kubectl visibility: one Node per partition + worker display pods
+        kube_mirror[0] = NodePodMirror(bridge, cfg).start()
         log.info("watching SlurmBridgeJob CRs on %s", cfg.base_url)
 
     def start_components() -> None:
@@ -164,6 +171,8 @@ def main(argv: list[str] | None = None) -> int:
     stop.wait()
     log.info("shutting down")
     ready.clear()
+    if kube_mirror[0] is not None:
+        kube_mirror[0].stop()
     if kube_adapter[0] is not None:
         kube_adapter[0].stop()
     bridge.stop()

