@@ -177,3 +177,182 @@ class LeaderElector:
         if self._thread is not None:
             self._thread.join(5.0)
         self.release()
+
+
+# ---------------------------------------------------------------- K8s Lease
+
+
+def _micro_time(t: float) -> str:
+    """K8s MicroTime rendering (2026-07-30T12:00:00.000000Z). Truncates the
+    fraction — rounding could carry to a 7-digit fraction, which RFC3339Micro
+    rejects."""
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + (
+        ".%06dZ" % min(int((t % 1.0) * 1e6), 999_999)
+    )
+
+
+def _parse_k8s_time(s: str) -> float | None:
+    """Parse RFC3339 with or without fractional seconds; None on garbage."""
+    if not s:
+        return None
+    base, frac = s.rstrip("Z"), 0.0
+    if "." in base:
+        base, frac_s = base.split(".", 1)
+        try:
+            frac = float("0." + frac_s)
+        except ValueError:
+            frac = 0.0
+    try:
+        import calendar
+
+        return calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S")) + frac
+    except ValueError:
+        return None
+
+
+class KubeLeaseElector(LeaderElector):
+    """The file elector's state machine over a coordination.k8s.io/v1 Lease.
+
+    This is the reference's actual election primitive (controller-runtime's
+    Lease election, cmd/bridge-operator/bridge-operator.go:59-61,75-76) and
+    — unlike the file lease — arbitrates replicas on *different hosts*: two
+    ``sbt-bridge --kube-api`` instances race on one named Lease object, the
+    holder renews ``renewTime``, and a candidate takes over once
+    ``renewTime + leaseDurationSeconds`` passes. Optimistic concurrency via
+    ``metadata.resourceVersion`` (a lost PUT race returns 409 ⇒ not
+    leader); ``release()`` clears ``holderIdentity`` so a clean shutdown
+    hands over immediately instead of waiting out the lease.
+    """
+
+    def __init__(self, config, lease_name: str = "slurm-bridge-operator", **kwargs):
+        super().__init__(
+            lock_path=f"lease:{config.namespace}/{lease_name}", **kwargs
+        )
+        self.config = config
+        self.lease_name = lease_name
+
+    # -- REST primitives --
+
+    def _path(self, name: bool = True) -> str:
+        p = (
+            "/apis/coordination.k8s.io/v1/namespaces/"
+            f"{self.config.namespace}/leases"
+        )
+        return f"{p}/{self.lease_name}" if name else p
+
+    def _get(self) -> dict | None:
+        """The Lease object, or None on 404. Other failures raise OSError
+        (run() treats them as retryable)."""
+        import json as _json
+        import urllib.error
+
+        try:
+            with self.config.open(self._path()) as resp:
+                return _json.load(resp)
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            raise
+        except _json.JSONDecodeError as exc:
+            raise OSError(f"malformed Lease body: {exc}") from exc
+
+    def _send(self, method: str, path: str, body: dict) -> bool:
+        """POST/PUT the lease; False on a lost 409 race, True on success."""
+        import json as _json
+        import urllib.error
+
+        try:
+            with self.config.open(
+                path,
+                method=method,
+                body=_json.dumps(body).encode(),
+                content_type="application/json",
+            ):
+                return True
+        except urllib.error.HTTPError as exc:
+            if exc.code == 409:
+                return False
+            raise
+
+    # -- the two primitives the state machine needs --
+
+    def try_acquire(self) -> bool:
+        now = time.time()
+        obj = self._get()
+        if obj is None:
+            return self._send(
+                "POST",
+                self._path(name=False),
+                {
+                    "apiVersion": "coordination.k8s.io/v1",
+                    "kind": "Lease",
+                    "metadata": {"name": self.lease_name},
+                    "spec": self._spec(now, acquire=True, transitions=0),
+                },
+            )
+        spec = obj.get("spec") or {}
+        holder = spec.get("holderIdentity") or ""
+        transitions = int(spec.get("leaseTransitions") or 0)
+        taking_over = False
+        if holder and holder != self.identity:
+            raw_duration = spec.get("leaseDurationSeconds")
+            duration = (
+                float(raw_duration)
+                if raw_duration is not None
+                else self.lease_duration
+            )
+            renewed = _parse_k8s_time(
+                spec.get("renewTime") or spec.get("acquireTime") or ""
+            )
+            if renewed is not None and now < renewed + duration:
+                return False  # live holder elsewhere
+            log.info(
+                "lease %s expired (holder=%s); taking over",
+                self.lease_name, holder,
+            )
+            taking_over = True
+        elif not holder:
+            taking_over = True  # released lease: adopt without waiting
+        obj["spec"] = self._spec(
+            now,
+            acquire=taking_over,
+            transitions=transitions + (1 if taking_over else 0),
+            acquired=spec.get("acquireTime"),
+        )
+        return self._send("PUT", self._path(), obj)
+
+    def _spec(
+        self,
+        now: float,
+        *,
+        acquire: bool,
+        transitions: int,
+        acquired: str | None = None,
+    ) -> dict:
+        return {
+            "holderIdentity": self.identity,
+            # at least 1: a serialized 0 would read back as "instantly
+            # expired" for rivals (sub-second durations exist only in tests)
+            "leaseDurationSeconds": max(1, int(self.lease_duration)),
+            "acquireTime": _micro_time(now) if acquire or not acquired else acquired,
+            "renewTime": _micro_time(now),
+            "leaseTransitions": transitions,
+        }
+
+    def release(self) -> None:
+        """Clear holderIdentity so a standby takes over immediately."""
+        try:
+            obj = self._get()
+        except OSError:
+            return
+        if obj is None:
+            return
+        spec = obj.get("spec") or {}
+        if spec.get("holderIdentity") != self.identity:
+            return
+        spec["holderIdentity"] = ""
+        obj["spec"] = spec
+        try:
+            self._send("PUT", self._path(), obj)
+        except OSError:
+            pass  # best-effort: the lease simply expires instead

@@ -44,6 +44,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--configurator-interval", type=float, default=30.0)
     parser.add_argument("--leader-lock", default="",
                         help="lease file enabling leader election; empty = no election")
+    parser.add_argument("--leader-lease", default="",
+                        help="coordination.k8s.io Lease name enabling leader "
+                             "election across hosts (requires --kube-api); "
+                             "takes precedence over --leader-lock")
     parser.add_argument("--state-file", default="",
                         help="durable store snapshot enabling restart resume "
                              "(the in-process stand-in for the K8s API's etcd)")
@@ -113,28 +117,28 @@ def main(argv: list[str] | None = None) -> int:
     kube_adapter = [None]
     kube_mirror = [None]
 
+    def kube_config():
+        from slurm_bridge_tpu.bridge.kubeapi import KubeConfig
+
+        if args.kube_api == "in-cluster":
+            return KubeConfig.in_cluster()
+        token = ""
+        if args.kube_token_file:
+            with open(args.kube_token_file) as f:
+                token = f.read().strip()
+        return KubeConfig(
+            base_url=args.kube_api,
+            namespace=args.kube_namespace,
+            token=token,
+            ca_file=args.kube_ca_file,
+        )
+
     def start_kube_adapter() -> None:
         if not args.kube_api:
             return
-        from slurm_bridge_tpu.bridge.kubeapi import (
-            KubeApiAdapter,
-            KubeConfig,
-            NodePodMirror,
-        )
+        from slurm_bridge_tpu.bridge.kubeapi import KubeApiAdapter, NodePodMirror
 
-        if args.kube_api == "in-cluster":
-            cfg = KubeConfig.in_cluster()
-        else:
-            token = ""
-            if args.kube_token_file:
-                with open(args.kube_token_file) as f:
-                    token = f.read().strip()
-            cfg = KubeConfig(
-                base_url=args.kube_api,
-                namespace=args.kube_namespace,
-                token=token,
-                ca_file=args.kube_ca_file,
-            )
+        cfg = kube_config()
         kube_adapter[0] = KubeApiAdapter(bridge, cfg).start()
         # kubectl visibility: one Node per partition + worker display pods
         kube_mirror[0] = NodePodMirror(bridge, cfg).start()
@@ -156,11 +160,35 @@ def main(argv: list[str] | None = None) -> int:
         log.info("bridge running against %s (scheduler=%s)", args.endpoint, args.scheduler)
 
     elector = None
-    if args.leader_lock:
+    lost_lease: list[bool] = []
+
+    def on_lost_leadership() -> None:
+        # lost the lease ⇒ exit NON-ZERO (manager semantics) so an
+        # on-failure supervisor restarts the replica as a standby; a
+        # shutdown we initiated ourselves is not a loss
+        if not stop.is_set():
+            lost_lease.append(True)
+            stop.set()
+
+    if args.leader_lease:
+        # the reference's actual primitive: a coordination.k8s.io Lease —
+        # arbitrates replicas across hosts, not just one filesystem
+        if not args.kube_api:
+            parser.error("--leader-lease requires --kube-api")
+        from slurm_bridge_tpu.bridge.leader import KubeLeaseElector
+
+        elector = KubeLeaseElector(
+            kube_config(),
+            args.leader_lease,
+            on_started=start_components,
+            on_stopped=on_lost_leadership,
+        ).start()
+        log.info("waiting for leadership on Lease %s", args.leader_lease)
+    elif args.leader_lock:
         elector = LeaderElector(
             args.leader_lock,
             on_started=start_components,
-            on_stopped=stop.set,  # lost the lease ⇒ exit (manager semantics)
+            on_stopped=on_lost_leadership,
         ).start()
         log.info("waiting for leadership on %s", args.leader_lock)
     else:
@@ -180,7 +208,7 @@ def main(argv: list[str] | None = None) -> int:
         elector.stop()
     if httpd is not None:
         httpd.shutdown()
-    return 1 if fatal else 0
+    return 1 if (fatal or lost_lease) else 0
 
 
 if __name__ == "__main__":

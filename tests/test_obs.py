@@ -314,3 +314,158 @@ class TestLeaderElection:
         assert not a.is_leader
         a._stop.set()
         a._thread.join(2)
+
+
+class _FakeLeaseServer:
+    """coordination.k8s.io/v1 Lease with optimistic concurrency: PUT must
+    carry the stored resourceVersion or it 409s — the property the
+    KubeLeaseElector's no-split-brain guarantee rides on."""
+
+    def __init__(self):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.leases: dict[str, dict] = {}
+        self.lock = threading.Lock()
+        self.rv = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _j(self, code, body):
+                data = _json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                return _json.loads(self.rfile.read(n)) if n else {}
+
+            def do_GET(self):
+                name = self.path.rstrip("/").rsplit("/", 1)[-1]
+                with outer.lock:
+                    obj = outer.leases.get(name)
+                    if obj is None:
+                        return self._j(404, {})
+                    return self._j(200, obj)
+
+            def do_POST(self):
+                obj = self._body()
+                name = obj["metadata"]["name"]
+                with outer.lock:
+                    if name in outer.leases:
+                        return self._j(409, {})
+                    outer.rv += 1
+                    obj["metadata"]["resourceVersion"] = str(outer.rv)
+                    outer.leases[name] = obj
+                    return self._j(201, obj)
+
+            def do_PUT(self):
+                obj = self._body()
+                name = self.path.rstrip("/").rsplit("/", 1)[-1]
+                with outer.lock:
+                    cur = outer.leases.get(name)
+                    if cur is None:
+                        return self._j(404, {})
+                    if (obj.get("metadata") or {}).get("resourceVersion") != \
+                            cur["metadata"]["resourceVersion"]:
+                        return self._j(409, {})
+                    outer.rv += 1
+                    obj["metadata"]["resourceVersion"] = str(outer.rv)
+                    outer.leases[name] = obj
+                    return self._j(200, obj)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+class TestKubeLeaseElection:
+    """VERDICT r3 #4: Lease-based election through the urllib adapter —
+    the reference's cross-host primitive (bridge-operator.go:59-61,75-76)."""
+
+    def _elector(self, srv, ident, **kw):
+        from slurm_bridge_tpu.bridge.kubeapi import KubeConfig
+        from slurm_bridge_tpu.bridge.leader import KubeLeaseElector
+
+        kw.setdefault("lease_duration", 0.8)
+        kw.setdefault("renew_interval", 0.1)
+        kw.setdefault("retry_interval", 0.05)
+        return KubeLeaseElector(
+            KubeConfig(base_url=srv.url), "sbt-bridge", identity=ident, **kw
+        )
+
+    def test_exactly_one_active_and_failover(self):
+        srv = _FakeLeaseServer()
+        try:
+            a_started, b_started = threading.Event(), threading.Event()
+            a = self._elector(srv, "a", on_started=a_started.set)
+            b = self._elector(srv, "b", on_started=b_started.set)
+            a.start()
+            assert a_started.wait(3)
+            b.start()
+            time.sleep(0.3)
+            assert a.is_leader and not b.is_leader  # exactly one active
+            holder = srv.leases["sbt-bridge"]["spec"]["holderIdentity"]
+            assert holder == "a"
+            # holder dies WITHOUT releasing (crash): renewals just stop
+            a._stop.set()
+            a._thread.join(2)
+            # failover within the lease duration
+            assert b_started.wait(3)
+            assert b.is_leader
+            assert srv.leases["sbt-bridge"]["spec"]["holderIdentity"] == "b"
+            assert int(srv.leases["sbt-bridge"]["spec"]["leaseTransitions"]) >= 1
+            b.stop()
+        finally:
+            srv.stop()
+
+    def test_clean_release_hands_over_immediately(self):
+        srv = _FakeLeaseServer()
+        try:
+            a = self._elector(srv, "a", lease_duration=30.0)
+            a.start()
+            assert a.wait_until_leader(3)
+            a.stop()  # clears holderIdentity — no 30 s wait for b
+            assert srv.leases["sbt-bridge"]["spec"]["holderIdentity"] == ""
+            b = self._elector(srv, "b", lease_duration=30.0)
+            b.start()
+            assert b.wait_until_leader(3)
+            b.stop()
+        finally:
+            srv.stop()
+
+    def test_stolen_lease_steps_down(self):
+        srv = _FakeLeaseServer()
+        try:
+            lost = threading.Event()
+            a = self._elector(srv, "a", on_stopped=lost.set)
+            a.start()
+            assert a.wait_until_leader(3)
+            with srv.lock:
+                cur = srv.leases["sbt-bridge"]
+                cur["spec"]["holderIdentity"] = "rival"
+                cur["spec"]["renewTime"] = None
+                cur["spec"]["leaseDurationSeconds"] = 3600
+                # rival renewed "now" — render as the elector would
+                from slurm_bridge_tpu.bridge.leader import _micro_time
+
+                cur["spec"]["renewTime"] = _micro_time(time.time())
+                srv.rv += 1
+                cur["metadata"]["resourceVersion"] = str(srv.rv)
+            assert lost.wait(3)
+            assert not a.is_leader
+            a._stop.set()
+            a._thread.join(2)
+        finally:
+            srv.stop()
