@@ -196,6 +196,15 @@ def profile_stages(snap, batch, cfg: AuctionConfig, *, iters: int = 10) -> dict:
     use_pallas = k == 0 and backend == "tpu"
 
     def full(rounds):
+        # mirror auction_place's static args exactly (ADVICE r3): without
+        # has_gangs/check_feats the profiler times dedup/revoke/feature
+        # work the shipped kernel compiles away on no-gang or single-bit
+        # batches, skewing round_ms vs stage_sum_ms
+        from slurm_bridge_tpu.solver.auction import (
+            batch_has_gangs,
+            batch_needs_feat_check,
+        )
+
         a, _ = _auction_kernel(
             free0, node_part, node_feat, dem, job_part, req_feat, prio, gang,
             dscale, incumbent, order_a, start_a, count_a,
@@ -204,6 +213,8 @@ def profile_stages(snap, batch, cfg: AuctionConfig, *, iters: int = 10) -> dict:
             use_pallas=use_pallas, interpret=False,
             gang_salvage_rounds=cfg.gang_salvage_rounds,
             gang_first=cfg.gang_first, candidates=k,
+            has_gangs=batch_has_gangs(np.asarray(gang)),
+            check_feats=k > 0 and batch_needs_feat_check(batch.req_features),
         )
         return a
     t1 = _t(full, 1, iters=max(3, iters // 2))

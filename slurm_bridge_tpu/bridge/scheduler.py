@@ -327,6 +327,7 @@ class PlacementScheduler:
         which the local path treats as "drop the shards, keep the pod".
         """
         from slurm_bridge_tpu.wire.convert import (
+            auction_config_to_proto,
             demand_to_place,
             node_to_proto,
             partition_to_proto,
@@ -347,6 +348,9 @@ class PlacementScheduler:
                     # greedy stays greedy; auction lets the sidecar auto-pick
                     # its best device path (single-device vs sharded)
                     solver=self.backend if self.backend == "greedy" else "",
+                    # the bridge's tuned knobs ride along — the sidecar must
+                    # not silently solve with its own defaults (ADVICE r3)
+                    config=auction_config_to_proto(self.auction_config),
                 ),
                 timeout=self.place_timeout,
             )

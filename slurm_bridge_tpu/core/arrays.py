@@ -74,21 +74,52 @@ def parse_array_spec(spec: str) -> list[int]:
     return sorted(ids)
 
 
+def _merged_count(chunks: list[tuple[int, int, int]]) -> int:
+    """Count the union without materializing: chunks sharing (step, phase)
+    are interval-merged exactly; only cross-step overlap (rare: mixed
+    ":N" steps hitting the same ids) can still overcount."""
+    from collections import defaultdict
+
+    groups: dict[tuple[int, int], list[tuple[int, int]]] = defaultdict(list)
+    for lo, hi, step in chunks:
+        groups[(step, lo % step)].append((lo, hi))
+    total = 0
+    for (step, _), ranges in groups.items():
+        ranges.sort()
+        cur_lo, cur_hi = ranges[0]
+        for lo, hi in ranges[1:]:
+            if lo <= cur_hi + step:  # touching progressions merge
+                cur_hi = max(cur_hi, hi)
+            else:
+                total += (cur_hi - cur_lo) // step + 1
+                cur_lo, cur_hi = lo, hi
+        total += (cur_hi - cur_lo) // step + 1
+    return total
+
+
 def array_len(spec: str) -> int:
     """Number of array tasks; 1 for the empty spec (non-array job).
 
-    Counted arithmetically per chunk — the sizecar sizing hot path never
-    materializes task ids for large legal specs. Multi-chunk specs whose
-    arithmetic total stays small are counted exactly (duplicates between
-    overlapping chunks collapse, matching :func:`parse_array_spec`);
-    larger ones use the per-chunk sum, a conservative upper bound."""
+    Counted arithmetically — the sizecar sizing hot path never
+    materializes task ids for large legal specs. Same-step overlapping
+    chunks are interval-merged exactly at any size (ADVICE r3:
+    "0-70000,0-70000" must not double demand); small multi-chunk specs are
+    counted exactly via set union (collapsing even cross-step duplicates,
+    matching :func:`parse_array_spec`); only large specs with duplicate
+    ids across *different* steps keep a conservative upper bound."""
     chunks = list(_iter_chunks(spec))
     if not chunks:
         return 1
-    total = sum((hi - lo) // step + 1 for lo, hi, step in chunks)
-    if len(chunks) > 1 and total <= _EXACT_COUNT_LIMIT:
+    if len(chunks) == 1:
+        lo, hi, step = chunks[0]
+        return max(1, (hi - lo) // step + 1)
+    # gate the set-union path on the ARITHMETIC sum — it equals the number
+    # of range inserts the union performs, so duplicated chunks can't push
+    # materialization work past the cap (the merged total undercounts it)
+    raw_sum = sum((hi - lo) // step + 1 for lo, hi, step in chunks)
+    if raw_sum <= _EXACT_COUNT_LIMIT:
         ids: set[int] = set()
         for lo, hi, step in chunks:
             ids.update(range(lo, hi + 1, step))
-        total = len(ids)
-    return max(1, total)
+        return max(1, len(ids))
+    return max(1, _merged_count(chunks))

@@ -48,8 +48,29 @@ import os
 #: Defaults are the measured v5e optimum: sweeping BN 512→2048 cut the
 #: 57k×10k solve p50 ~18% (250→206 ms at rounds=8); wider than 4096 and
 #: larger BP plateau within noise.
-BP = int(os.environ.get("SBT_PALLAS_BP", "512"))
-BN = int(os.environ.get("SBT_PALLAS_BN", "2048"))
+
+
+def _tile_env(var: str, default: int, multiple: int) -> int:
+    """Validate a tile-size env override at import (ADVICE r3): a typo'd
+    or misaligned value must name the variable and the constraint, not
+    surface later as an opaque Mosaic compile error."""
+    raw = os.environ.get(var, "")
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(f"{var}={raw!r} is not an integer") from None
+    if val <= 0 or val % multiple:
+        raise ValueError(
+            f"{var}={val} must be a positive multiple of {multiple} "
+            f"(TPU {'sublane' if multiple == 8 else 'lane'} alignment)"
+        )
+    return val
+
+
+BP = _tile_env("SBT_PALLAS_BP", 512, 8)
+BN = _tile_env("SBT_PALLAS_BN", 2048, 128)
 
 _NEG_INF = float("-inf")  # python literal: jnp scalars become captured consts
 

@@ -102,6 +102,7 @@ class PlacementSolverServicer:
         #: handful of shapes
         self.bucket = bucket
         self._session: DeviceSolver | None = None
+        self._session_cfg: AuctionConfig | None = None
         self._lock = threading.Lock()
 
     # ---- RPCs ----
@@ -126,9 +127,20 @@ class PlacementSolverServicer:
         snapshot = encode_cluster(nodes, partitions)
         batch, incumbent = self._encode(request.jobs, snapshot)
 
+        # a request-borne config (the bridge's tuned AuctionConfig) beats
+        # the launch-time default — without this the sidecar silently
+        # solved with different knobs than the operator set (ADVICE r3)
+        cfg = self.config
+        if request.HasField("config"):
+            from slurm_bridge_tpu.wire.convert import auction_config_from_proto
+
+            # overlay: wire knobs win, launch-time tuning of the non-wire
+            # knobs (candidates/dtype/use_pallas) survives
+            cfg = auction_config_from_proto(request.config, base=self.config)
+
         t0 = time.perf_counter()
         with self._lock:
-            placement = self._solve(solver, snapshot, batch, incumbent)
+            placement = self._solve(solver, snapshot, batch, incumbent, cfg)
         solve_ms = (time.perf_counter() - t0) * 1e3
         _solve_seconds.observe(solve_ms / 1e3)
         _place_total.inc()
@@ -225,7 +237,8 @@ class PlacementSolverServicer:
         )
         return batch, np.asarray(rows_inc, dtype=np.int32)
 
-    def _solve(self, solver, snapshot, batch, incumbent):
+    def _solve(self, solver, snapshot, batch, incumbent, cfg=None):
+        cfg = cfg or self.config
         if batch.num_shards == 0:
             from slurm_bridge_tpu.solver.snapshot import Placement
 
@@ -248,12 +261,14 @@ class PlacementSolverServicer:
         if solver == "sharded":
             from slurm_bridge_tpu.solver.sharded import sharded_place
 
-            placement = sharded_place(
-                snapshot, batch, self.config, incumbent=incumbent
-            )
+            placement = sharded_place(snapshot, batch, cfg, incumbent=incumbent)
         else:
-            if self._session is None:
-                self._session = DeviceSolver(snapshot, self.config)
+            if self._session is None or self._session_cfg != cfg:
+                # config is hashed into the jitted kernel's static args, so
+                # a changed config needs a fresh session (compiles once per
+                # distinct config; callers send a stable one per bridge)
+                self._session = DeviceSolver(snapshot, cfg)
+                self._session_cfg = cfg
             else:
                 self._session.update_snapshot(snapshot)
             placement = self._session.solve(batch, incumbent=incumbent)

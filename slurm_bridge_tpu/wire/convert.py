@@ -214,18 +214,20 @@ def demand_to_place(d: JobDemand, *, job_id: str = "") -> pb.PlaceJob:
 
     PlaceJob quantities are PER-NODE: the sizecar sizing rule
     (solver/snapshot.py encode_jobs; pkg/slurm-bridge-operator/pod.go:143-162)
-    spreads cpu evenly across ``nodes`` shards — fractional per-shard cpu is
-    rounded UP so the wire form never understates the demand. gres is a
-    per-node quantity in Slurm and is not divided; the gres *type* rides
-    along as a required feature the solver matches against node features.
+    spreads cpu evenly across ``nodes`` shards — sent as the EXACT
+    fractional value (the wire fields are doubles) so a sidecar solve
+    places identically to the in-process path; rounding up made a job
+    whose cpus don't divide evenly by nodes unschedulable on an
+    exactly-full cluster only when the sidecar was enabled (ADVICE r3).
+    gres is a per-node quantity in Slurm and is not divided; the gres
+    *type* rides along as a required feature the solver matches against
+    node features.
     """
-    import math
-
     from slurm_bridge_tpu.core.arrays import array_len
 
     arr = array_len(d.array)
     nshards = max(1, d.nodes)
-    cpu = math.ceil(d.total_cpus(arr) / nshards)
+    cpu = d.total_cpus(arr) / nshards
     mem_per_cpu = d.mem_per_cpu_mb or 1024
     gres_parts = d.gres.split(":") if d.gres else []
     gpus = 0
@@ -249,4 +251,38 @@ def demand_to_place(d: JobDemand, *, job_id: str = "") -> pb.PlaceJob:
         req_features=features,
         nodes=nshards,
         priority=float(d.priority),
+    )
+
+
+def auction_config_to_proto(cfg) -> pb.SolverConfig:
+    """AuctionConfig → SolverConfig so a bridge's tuned knobs ride each
+    Place RPC instead of being silently replaced by the sidecar's
+    launch-time defaults (ADVICE r3)."""
+    return pb.SolverConfig(
+        rounds=cfg.rounds,
+        eta=cfg.eta,
+        jitter=cfg.jitter,
+        gang_salvage_rounds=cfg.gang_salvage_rounds,
+        gang_first=cfg.gang_first,
+        affinity_weight=cfg.affinity_weight,
+    )
+
+
+def auction_config_from_proto(msg: pb.SolverConfig, base=None):
+    """SolverConfig → AuctionConfig by OVERLAYING the six wire fields onto
+    ``base`` (the sidecar's launch-time config): knobs that don't ride the
+    wire — candidates, dtype, use_pallas — keep the solver-side tuning
+    instead of resetting to dataclass defaults."""
+    import dataclasses
+
+    from slurm_bridge_tpu.solver.auction import AuctionConfig
+
+    return dataclasses.replace(
+        base or AuctionConfig(),
+        rounds=int(msg.rounds),
+        eta=float(msg.eta),
+        jitter=float(msg.jitter),
+        gang_salvage_rounds=int(msg.gang_salvage_rounds),
+        gang_first=bool(msg.gang_first),
+        affinity_weight=float(msg.affinity_weight),
     )

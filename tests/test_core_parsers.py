@@ -94,6 +94,20 @@ def test_array_len():
     assert array_len("1-7:2") == 4
 
 
+def test_array_len_overlapping_chunks_not_double_counted():
+    """ADVICE r3: same-step overlap merges exactly even past the
+    set-union size cutoff — demand must not be overstated."""
+    assert array_len("0-70000,0-70000") == 70_001
+    assert array_len("0-70000,35000-105000") == 105_001
+    assert array_len("0-99999:2,1-99999:2") == 100_000  # phases disjoint
+    # touching same-phase progressions merge across the chunk boundary
+    assert array_len("0-99998:2,100000-200000:2") == 100_001
+    # small cross-step overlap stays exact via the set path
+    assert array_len("0-100:2,0-100:5") == len(
+        set(range(0, 101, 2)) | set(range(0, 101, 5))
+    )
+
+
 @pytest.mark.parametrize("spec", ["a-b", "3-1", "1-7:0", "1,,2"])
 def test_bad_array_spec(spec):
     with pytest.raises(ValueError):
@@ -354,7 +368,9 @@ def test_array_len_no_materialization_and_exact_overlap():
 
     t0 = time.perf_counter()
     assert array_len("0-3999999") == 4_000_000
-    assert array_len("0-3999999,0") == 4_000_001  # conservative upper bound
+    # same-step overlap merges exactly even past the set-union cutoff
+    # (ADVICE r3 — this used to be a 4_000_001 conservative upper bound)
+    assert array_len("0-3999999,0") == 4_000_000
     assert (time.perf_counter() - t0) < 0.1, "large count must not expand"
     assert array_len("0-10,5-15") == 16  # small overlap counted exactly
     assert array_len("0-15%4") == 16

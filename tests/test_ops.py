@@ -144,3 +144,29 @@ def test_bid_argmax_compiled_on_tpu_matches_reference():
     np.testing.assert_allclose(
         np.asarray(best)[feas], ref_best[feas], rtol=0, atol=1e-6
     )
+
+
+def test_pallas_tile_env_validation():
+    """ADVICE r3: a typo'd SBT_PALLAS_BP/BN must fail with a message naming
+    the variable and alignment, not an opaque Mosaic error later."""
+    import pytest
+
+    from slurm_bridge_tpu.ops.bid_argmax import _tile_env
+
+    assert _tile_env("SBT_TEST_UNSET_TILE", 512, 8) == 512
+    import os
+
+    os.environ["SBT_TEST_TILE"] = "bogus"
+    try:
+        with pytest.raises(ValueError, match="SBT_TEST_TILE"):
+            _tile_env("SBT_TEST_TILE", 512, 8)
+        os.environ["SBT_TEST_TILE"] = "100"  # not a multiple of 8
+        with pytest.raises(ValueError, match="multiple of 8"):
+            _tile_env("SBT_TEST_TILE", 512, 8)
+        os.environ["SBT_TEST_TILE"] = "-8"
+        with pytest.raises(ValueError, match="positive"):
+            _tile_env("SBT_TEST_TILE", 512, 8)
+        os.environ["SBT_TEST_TILE"] = "1024"
+        assert _tile_env("SBT_TEST_TILE", 512, 8) == 1024
+    finally:
+        del os.environ["SBT_TEST_TILE"]

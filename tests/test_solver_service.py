@@ -335,3 +335,42 @@ def test_bridge_survives_solver_sidecar_restart(tmp_path, monkeypatch):
             assert job.status.state == JobState.SUCCEEDED
         finally:
             solver2.stop(None)
+
+
+def test_place_request_config_overrides_sidecar_default():
+    """ADVICE r3 (medium): the bridge's AuctionConfig rides PlaceRequest —
+    the sidecar must solve with the caller's knobs, not its launch-time
+    defaults, and must fall back to those defaults when no config is sent."""
+    from slurm_bridge_tpu.solver import AuctionConfig
+    from slurm_bridge_tpu.solver.snapshot import random_scenario
+    from slurm_bridge_tpu.wire.convert import (
+        auction_config_to_proto,
+        node_to_proto,
+    )
+    from slurm_bridge_tpu.core.types import NodeInfo
+
+    servicer = PlacementSolverServicer(AuctionConfig(rounds=2, candidates=16))
+    nodes = [node_to_proto(NodeInfo(name="n1", cpus=8, memory_mb=8192,
+                                    state="IDLE"))]
+    tuned = AuctionConfig(rounds=4, gang_first=True, affinity_weight=0.05)
+    req = pb.PlaceRequest(
+        jobs=[pb.PlaceJob(id="0", cpus=1, mem_mb=1024, nodes=1, priority=1.0)],
+        inventory=nodes,
+        solver="auction",
+        config=auction_config_to_proto(tuned),
+    )
+    resp = servicer.Place(req, None)
+    assert resp.placed == 1
+    assert servicer._session_cfg.rounds == 4
+    assert servicer._session_cfg.gang_first is True
+    # non-wire knobs OVERLAY the launch-time config, not dataclass defaults
+    assert servicer._session_cfg.candidates == 16
+
+    # no config on the wire => launch-time default
+    req2 = pb.PlaceRequest(
+        jobs=[pb.PlaceJob(id="0", cpus=1, mem_mb=1024, nodes=1, priority=1.0)],
+        inventory=nodes,
+        solver="auction",
+    )
+    servicer.Place(req2, None)
+    assert servicer._session_cfg.rounds == 2

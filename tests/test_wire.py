@@ -186,3 +186,30 @@ def test_unimplemented_method_clean_status(tmp_path):
             client.JobState(pb.JobStateRequest(job_id=1))
         assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
     server.stop(None)
+
+
+def test_place_job_fractional_cpu_exact():
+    """ADVICE r3: per-shard cpu rides the wire as the exact fraction so
+    sidecar placements match in-process ones on exactly-full clusters."""
+    from slurm_bridge_tpu.core.types import JobDemand
+    from slurm_bridge_tpu.wire.convert import demand_to_place
+
+    d = JobDemand(partition="p", cpus_per_task=10, nodes=3)
+    job = demand_to_place(d, job_id="j")
+    assert abs(job.cpus - 10 / 3) < 1e-9
+    assert abs(job.mem_mb - (10 / 3) * 1024) < 1e-6
+
+
+def test_auction_config_roundtrip():
+    from slurm_bridge_tpu.solver.auction import AuctionConfig
+    from slurm_bridge_tpu.wire.convert import (
+        auction_config_from_proto,
+        auction_config_to_proto,
+    )
+
+    cfg = AuctionConfig(rounds=5, eta=0.3, jitter=2.0, gang_salvage_rounds=1,
+                        gang_first=True, affinity_weight=0.05)
+    back = auction_config_from_proto(auction_config_to_proto(cfg))
+    assert back == AuctionConfig(rounds=5, eta=0.3, jitter=2.0,
+                                 gang_salvage_rounds=1, gang_first=True,
+                                 affinity_weight=0.05)
