@@ -295,10 +295,13 @@ def main() -> None:
     # ~500 above the greedy baseline, for a 27% lower p50); without one,
     # the indexed native packer (greedy-parity quality, no JAX-CPU
     # auction: 1-core hosts can't amortise its round loop — VERDICT r3 #1)
-    from slurm_bridge_tpu.solver.routing import choose_path
+    from slurm_bridge_tpu.solver.routing import choose_path, gang_shard_fraction
 
     cfg = AuctionConfig(rounds=8)
-    route = choose_path(p, snap.num_nodes, backend_name=backend)
+    route = choose_path(
+        p, snap.num_nodes, backend_name=backend,
+        gang_fraction=gang_shard_fraction(batch.gang_id),
+    )
     if route == "native":
         from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
 

@@ -104,6 +104,10 @@ class PlacementScheduler:
         self.store = store
         self.client = client
         self.backend = backend
+        #: whether the operator tuned this bridge's config explicitly —
+        #: only then does it ride Place RPCs; otherwise the sidecar's own
+        #: launch-time tuning must win (both directions of ADVICE r3)
+        self._explicit_config = auction_config is not None
         self.auction_config = auction_config or AuctionConfig()
         self.events = events or EventRecorder()
         self.preemption = preemption
@@ -348,9 +352,15 @@ class PlacementScheduler:
                     # greedy stays greedy; auction lets the sidecar auto-pick
                     # its best device path (single-device vs sharded)
                     solver=self.backend if self.backend == "greedy" else "",
-                    # the bridge's tuned knobs ride along — the sidecar must
-                    # not silently solve with its own defaults (ADVICE r3)
-                    config=auction_config_to_proto(self.auction_config),
+                    # an explicitly tuned config rides along — the sidecar
+                    # must not silently solve with its own defaults; an
+                    # UNtuned bridge sends none, so a tuned sidecar keeps
+                    # its launch-time knobs (ADVICE r3, both directions)
+                    config=(
+                        auction_config_to_proto(self.auction_config)
+                        if self._explicit_config
+                        else None
+                    ),
                 ),
                 timeout=self.place_timeout,
             )
@@ -395,9 +405,17 @@ class PlacementScheduler:
         # Pinned incumbents force the auction kernel: only it honours them,
         # and routing them to the packer would spuriously preempt everyone.
         if self.backend == "auto" and not (incumbent >= 0).any():
-            from slurm_bridge_tpu.solver.routing import choose_path
+            from slurm_bridge_tpu.solver.routing import (
+                choose_path,
+                gang_shard_fraction,
+            )
 
-            if choose_path(batch.num_shards, snapshot.num_nodes) == "native":
+            route = choose_path(
+                batch.num_shards,
+                snapshot.num_nodes,
+                gang_fraction=gang_shard_fraction(batch.gang_id),
+            )
+            if route == "native":
                 from slurm_bridge_tpu.solver.indexed_native import (
                     indexed_place_native,
                 )

@@ -92,6 +92,15 @@ class AuctionConfig:
     #: sampled K=64 elsewhere once P·N ≥ 2**25); ``0`` = force full;
     #: ``K>0`` = force sampled with K candidates.
     candidates: int | None = None
+    #: host-side post-solve repair (VERDICT r3 #6): after the kernel's
+    #: final revocation, re-admit whatever stayed unplaced — typically
+    #: gangs the salvage rounds revoked — against the surviving free
+    #: matrix with the exact indexed packer. Placements are only ADDED,
+    #: never moved, so kernel assignments, incumbent pins, and determinism
+    #: are untouched; cost is O(U log N) host work for U unplaced shards,
+    #: no extra device round-trip. Closed the gang scenario's last gap:
+    #: 11,991 → ≥ greedy's 12,000 (BASELINE config #4).
+    repair: bool = True
     dtype: str = "float32"  # score matrix dtype ("bfloat16" halves HBM traffic)
     #: score/choose via the fused pallas kernel (ops/bid_argmax.py) instead
     #: of the jnp [P,N] form. None = auto: on for the TPU backend. The
@@ -652,6 +661,57 @@ def batch_has_gangs(gang_norm: np.ndarray) -> bool:
     return bool(np.bincount(gang_norm).max() > 1)
 
 
+def repair_unplaced(
+    snapshot: ClusterSnapshot,
+    batch: JobBatch,
+    placement: Placement,
+    *,
+    incumbent: np.ndarray | None = None,
+) -> Placement:
+    """One host-side repair pass over a kernel result (AuctionConfig.repair).
+
+    Jobs the auction left wholly unplaced (gang all-or-nothing guarantees
+    revoked gangs are whole) are re-admitted against ``free_after`` with
+    the exact indexed packer. Gangs containing an incumbent-pinned shard
+    are skipped: their keep-or-preempt verdict belongs to the kernel, and
+    a partial re-place would break all-or-nothing.
+    """
+    unplaced = ~placement.placed & (batch.job_of >= 0)  # pad rows never place
+    if incumbent is not None and (incumbent >= 0).any():
+        pinned_gangs = np.unique(batch.gang_id[incumbent >= 0])
+        unplaced &= ~np.isin(batch.gang_id, pinned_gangs)
+    if not unplaced.any():
+        return placement
+    rows = np.nonzero(unplaced)[0]
+    sub = JobBatch(
+        demand=batch.demand[rows],
+        partition_of=batch.partition_of[rows],
+        req_features=batch.req_features[rows],
+        priority=batch.priority[rows],
+        gang_id=batch.gang_id[rows],
+        job_of=batch.job_of[rows],
+    )
+    residual = ClusterSnapshot(
+        node_names=snapshot.node_names,
+        capacity=snapshot.capacity,
+        free=placement.free_after,
+        partition_of=snapshot.partition_of,
+        features=snapshot.features,
+        partition_codes=snapshot.partition_codes,
+        feature_codes=snapshot.feature_codes,
+    )
+    from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+
+    rp = indexed_place_native(residual, sub)
+    if not rp.placed.any():
+        return placement
+    node_of = placement.node_of.copy()
+    node_of[rows] = np.where(rp.placed, rp.node_of, node_of[rows])
+    return Placement(
+        node_of=node_of, placed=node_of >= 0, free_after=rp.free_after
+    )
+
+
 def auction_place(
     snapshot: ClusterSnapshot,
     batch: JobBatch,
@@ -728,8 +788,13 @@ def auction_place(
         check_feats=k > 0 and batch_needs_feat_check(batch.req_features),
     )
     assign_np = np.asarray(assign)
-    return Placement(
+    placement = Placement(
         node_of=assign_np,
         placed=assign_np >= 0,
         free_after=np.asarray(free_after),
     )
+    if cfg.repair:
+        placement = repair_unplaced(
+            snapshot, batch, placement, incumbent=incumbent
+        )
+    return placement

@@ -52,22 +52,54 @@ def floor_cells() -> int:
     return val
 
 
+#: Above this share of multi-node-gang shards the indexed native packer
+#: dominates the device auction on BOTH axes — measured at BASELINE
+#: scenario #4 (12k shards × 10k nodes, 89% gang shards): native 110.8 ms
+#: placing 12,000/12,000 vs the on-chip auction's 319.8 ms placing 11,991
+#: (round 3). The auction's jitter-spread fragments the cluster for
+#: many-node gangs structurally (a post-solve repair pass recovered 0 jobs
+#: on the full path — measured round 4); sequential best-fit packing is
+#: the right algorithm there. The mixed headline (scenario #3, 17% gang
+#: shards) stays on-device, where the auction places +1% MORE than greedy.
+GANG_DOMINANCE = 0.5
+
+
+def gang_shard_fraction(gang_id) -> float:
+    """Share of shards belonging to multi-shard gangs. O(P) host work."""
+    import numpy as np
+
+    gang_id = np.asarray(gang_id)
+    if gang_id.size == 0:
+        return 0.0
+    from slurm_bridge_tpu.solver.auction import normalize_gangs
+
+    norm = normalize_gangs(gang_id)
+    counts = np.bincount(norm)
+    return float((counts[norm] > 1).mean())
+
+
 def choose_path(
     num_shards: int,
     num_nodes: int,
     *,
     backend_name: str | None = None,
+    gang_fraction: float = 0.0,
 ) -> str:
     """Return ``"native"`` or ``"device"`` for a solve of this shape.
 
     ``backend_name`` is the JAX backend platform name; ``None`` asks
     :func:`~slurm_bridge_tpu.parallel.backend.ensure_backend` (hang-proof —
     a wedged accelerator resolves to ``"cpu"``, which routes native).
+    ``gang_fraction`` is the share of multi-node-gang shards
+    (:func:`gang_shard_fraction`) — gang-dominated batches route native
+    regardless of size (see ``GANG_DOMINANCE``).
     """
     if backend_name is None:
         from slurm_bridge_tpu.parallel.backend import ensure_backend
 
         backend_name = ensure_backend()
     if backend_name == "cpu":
+        return "native"
+    if gang_fraction > GANG_DOMINANCE:
         return "native"
     return "device" if num_shards * num_nodes >= floor_cells() else "native"

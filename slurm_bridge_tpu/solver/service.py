@@ -101,8 +101,10 @@ class PlacementSolverServicer:
         #: compile per Place — pad to the bucket so the kernel sees a
         #: handful of shapes
         self.bucket = bucket
-        self._session: DeviceSolver | None = None
-        self._session_cfg: AuctionConfig | None = None
+        #: one DeviceSolver per distinct effective config — alternating
+        #: clients (tuned + untuned bridges sharing a sidecar) must hit
+        #: one XLA compile per config, not one per Place
+        self._sessions: dict[tuple, DeviceSolver] = {}
         self._lock = threading.Lock()
 
     # ---- RPCs ----
@@ -263,15 +265,17 @@ class PlacementSolverServicer:
 
             placement = sharded_place(snapshot, batch, cfg, incumbent=incumbent)
         else:
-            if self._session is None or self._session_cfg != cfg:
-                # config is hashed into the jitted kernel's static args, so
-                # a changed config needs a fresh session (compiles once per
-                # distinct config; callers send a stable one per bridge)
-                self._session = DeviceSolver(snapshot, cfg)
-                self._session_cfg = cfg
+            import dataclasses
+
+            key = dataclasses.astuple(cfg)
+            session = self._sessions.get(key)
+            if session is None:
+                if len(self._sessions) >= 8:  # distinct configs are few;
+                    self._sessions.clear()  # a churning client can't leak
+                session = self._sessions[key] = DeviceSolver(snapshot, cfg)
             else:
-                self._session.update_snapshot(snapshot)
-            placement = self._session.solve(batch, incumbent=incumbent)
+                session.update_snapshot(snapshot)
+            placement = session.solve(batch, incumbent=incumbent)
         if placement.node_of.shape[0] != p_real:
             from slurm_bridge_tpu.solver.snapshot import Placement
 

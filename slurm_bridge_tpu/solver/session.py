@@ -43,6 +43,8 @@ class PendingSolve:
     _assign: jax.Array
     _snapshot: ClusterSnapshot
     _batch: JobBatch
+    _incumbent: np.ndarray | None = None
+    _repair: bool = False
 
     def result(self) -> Placement:
         assign = np.asarray(self._assign)
@@ -57,7 +59,15 @@ class PendingSolve:
                 free_after[:, r] -= np.bincount(
                     nodes, weights=dem[:, r], minlength=free_after.shape[0]
                 )
-        return Placement(node_of=assign, placed=placed, free_after=free_after)
+        placement = Placement(node_of=assign, placed=placed, free_after=free_after)
+        if self._repair:
+            from slurm_bridge_tpu.solver.auction import repair_unplaced
+
+            placement = repair_unplaced(
+                self._snapshot, self._batch, placement,
+                incumbent=self._incumbent,
+            )
+        return placement
 
 
 class DeviceSolver:
@@ -185,7 +195,10 @@ class DeviceSolver:
             assign.copy_to_host_async()
         except AttributeError:  # not all backends expose it
             pass
-        return PendingSolve(_assign=assign, _snapshot=self.snapshot, _batch=batch)
+        return PendingSolve(
+            _assign=assign, _snapshot=self.snapshot, _batch=batch,
+            _incumbent=incumbent, _repair=cfg.repair,
+        )
 
     def solve(
         self, batch: JobBatch, incumbent: np.ndarray | None = None

@@ -275,8 +275,15 @@ def sharded_place(
     assign_np = np.asarray(assign)[:p_real]
     # padded shards can never place (impossible partition), padded nodes can
     # never be chosen (negative free); strip rows and we are done
-    return Placement(
+    placement = Placement(
         node_of=assign_np,
         placed=assign_np >= 0,
         free_after=np.asarray(free_after)[:n_real],
     )
+    if cfg.repair:
+        from slurm_bridge_tpu.solver.auction import repair_unplaced
+
+        placement = repair_unplaced(
+            snapshot, batch, placement, incumbent=incumbent
+        )
+    return placement

@@ -356,6 +356,77 @@ def test_choose_path_rules(monkeypatch):
         choose_path(5_000, 512, backend_name="tpu")
 
 
+def test_choose_path_gang_dominance():
+    """Gang-dominated batches route native even on the accelerator: the
+    sequential packer beats the auction on BOTH latency and placed jobs
+    there (measured, BASELINE scenario #4 — see routing.GANG_DOMINANCE)."""
+    from slurm_bridge_tpu.solver.routing import choose_path, gang_shard_fraction
+
+    assert choose_path(12_000, 10_000, backend_name="tpu",
+                       gang_fraction=0.89) == "native"
+    assert choose_path(50_000, 10_000, backend_name="tpu",
+                       gang_fraction=0.17) == "device"
+    # the fraction helper: 8-shard gangs on half the jobs ≈ 89%
+    snap, batch = random_scenario(64, 600, seed=4, gang_fraction=0.5,
+                                  gang_size=8)
+    assert 0.85 < gang_shard_fraction(batch.gang_id) < 0.95
+    assert gang_shard_fraction(np.zeros(0, np.int32)) == 0.0
+
+
+# ---------------------------------------------------------------- repair
+
+
+def test_repair_only_adds_and_respects_capacity():
+    """The post-solve repair pass (AuctionConfig.repair): never moves a
+    kernel assignment, never overcommits, keeps gangs all-or-nothing on
+    distinct nodes, and places at least as many jobs as no-repair."""
+    from slurm_bridge_tpu.solver.auction import AuctionConfig, auction_place
+
+    snap, batch = random_scenario(96, 700, seed=17, load=1.2,
+                                  gang_fraction=0.5, gang_size=4)
+    base = auction_place(snap, batch, AuctionConfig(rounds=4, repair=False))
+    fixed = auction_place(snap, batch, AuctionConfig(rounds=4, repair=True))
+    # kernel assignments are untouched; repair only fills -1 rows
+    kernel_rows = base.placed
+    assert np.array_equal(base.node_of[kernel_rows], fixed.node_of[kernel_rows])
+    assert fixed.placed.sum() >= base.placed.sum()
+    # feasibility of the combined placement
+    free = snap.free.copy()
+    for s in np.nonzero(fixed.placed)[0]:
+        free[fixed.node_of[s]] -= batch.demand[s]
+    assert (free >= -1e-3).all()
+    # gangs stay all-or-nothing on distinct nodes
+    for gid in np.unique(batch.gang_id):
+        rows = np.nonzero(batch.gang_id == gid)[0]
+        st = fixed.placed[rows]
+        assert st.all() or not st.any()
+        if len(rows) > 1 and st.all():
+            assert len(set(fixed.node_of[rows].tolist())) == len(rows)
+
+
+def test_repair_skips_incumbent_pinned_gangs():
+    """Gangs holding an incumbent pin belong to the kernel's keep-or-
+    preempt verdict — repair must not re-place them."""
+    from slurm_bridge_tpu.solver.auction import repair_unplaced
+    from slurm_bridge_tpu.solver.snapshot import Placement
+
+    snap, batch = random_scenario(16, 12, seed=3, gang_fraction=1.0,
+                                  gang_size=2)
+    p = batch.num_shards
+    placement = Placement(
+        node_of=np.full(p, -1, np.int32),
+        placed=np.zeros(p, bool),
+        free_after=snap.free.copy(),
+    )
+    incumbent = np.full(p, -1, np.int32)
+    incumbent[0] = 0  # first gang is pinned
+    out = repair_unplaced(snap, batch, placement, incumbent=incumbent)
+    pinned_gang = batch.gang_id[0]
+    assert not out.placed[batch.gang_id == pinned_gang].any()
+    # everything else was free to repair
+    assert out.placed[batch.gang_id != pinned_gang].any()
+
+
 # ---------------------------------------------------------------- sharded
 
 

@@ -361,16 +361,20 @@ def test_place_request_config_overrides_sidecar_default():
     )
     resp = servicer.Place(req, None)
     assert resp.placed == 1
-    assert servicer._session_cfg.rounds == 4
-    assert servicer._session_cfg.gang_first is True
+    tuned_sessions = [s for s in servicer._sessions.values()
+                      if s.config.rounds == 4]
+    assert tuned_sessions and tuned_sessions[0].config.gang_first is True
     # non-wire knobs OVERLAY the launch-time config, not dataclass defaults
-    assert servicer._session_cfg.candidates == 16
+    assert tuned_sessions[0].config.candidates == 16
 
-    # no config on the wire => launch-time default
+    # no config on the wire => launch-time default; alternating clients get
+    # one session per distinct config (no per-Place recompile)
     req2 = pb.PlaceRequest(
         jobs=[pb.PlaceJob(id="0", cpus=1, mem_mb=1024, nodes=1, priority=1.0)],
         inventory=nodes,
         solver="auction",
     )
     servicer.Place(req2, None)
-    assert servicer._session_cfg.rounds == 2
+    assert any(s.config.rounds == 2 for s in servicer._sessions.values())
+    servicer.Place(req, None)
+    assert len(servicer._sessions) == 2  # both sessions retained
