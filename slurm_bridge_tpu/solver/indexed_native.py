@@ -49,7 +49,10 @@ def indexed_place_native(
     global _build_failed
     from slurm_bridge_tpu.solver.greedy_native import greedy_place_native
 
-    if not best_fit or _build_failed:
+    # the treap index supports 1..4 resource dims (cpu + up to 3 augmented);
+    # RESOURCE_DIMS ships 3 — an exotic wider snapshot takes the baseline,
+    # which handles any arity
+    if not best_fit or _build_failed or not 1 <= snapshot.free.shape[1] <= 4:
         return greedy_place_native(snapshot, batch, best_fit=best_fit)
     try:
         fn = load_symbol(
