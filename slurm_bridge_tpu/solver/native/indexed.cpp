@@ -292,7 +292,7 @@ int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
         for (int32_t nd : touched_node) {
           Bucket& bk = buckets[node_bucket[nd]];
           bk.root = forest.insert(bk.root, nd,
-                                  free_io + static_cast<size_t>(nd) * r, r);
+                                  free_io + static_cast<size_t>(nd) * r);
         }
       }
     } else if (multi) {
@@ -303,7 +303,7 @@ int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
                     touched_free.data() + i * r, sizeof(float) * r);
         Bucket& bk = buckets[node_bucket[nd]];
         bk.root = forest.insert(bk.root, nd,
-                                free_io + static_cast<size_t>(nd) * r, r);
+                                free_io + static_cast<size_t>(nd) * r);
       }
     }
     // single-shard failure touched nothing
