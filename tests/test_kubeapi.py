@@ -504,3 +504,56 @@ def test_node_recreated_on_404(fake_slurm, tmp_path):
         with api.lock:
             del api.nodes["slurm-partition-debug"]
         assert _wait(lambda: "slurm-partition-debug" in api.nodes)
+
+
+def test_worker_pod_recreated_when_container_set_changes():
+    """Array fan-out discovered after submit grows the sub-job set; pod
+    spec containers are immutable, so the mirror must delete + recreate
+    the display pod with the new container count."""
+    from slurm_bridge_tpu.bridge.objects import (
+        ContainerStatus,
+        Meta,
+        Pod,
+        PodRole,
+        PodSpec,
+        PodStatus,
+    )
+    from slurm_bridge_tpu.bridge.store import ObjectStore
+
+    class _BridgeStub:
+        def __init__(self):
+            self.store = ObjectStore()
+
+    api = _FakeApiServer([])
+    stub = _BridgeStub()
+    stub.store.create(Pod(
+        meta=Meta(name="arr-worker"),
+        spec=PodSpec(role=PodRole.WORKER, partition="debug",
+                     node_name="slurm-partition-debug"),
+        status=PodStatus(phase="Running",
+                         containers=[ContainerStatus(name="subjob-0",
+                                                     state="running")]),
+    ))
+    mirror = NodePodMirror(
+        stub, KubeConfig(base_url=api.url, token="test-token"), resync=0.2
+    ).start()
+    try:
+        assert _wait(lambda: "arr-worker" in api.pods)
+        assert len(api.pods["arr-worker"]["spec"]["containers"]) == 1
+
+        def grow(p: Pod):
+            p.status.containers = [
+                ContainerStatus(name=f"subjob-{i}", state="running")
+                for i in range(4)
+            ]
+
+        stub.store.mutate(Pod.KIND, "arr-worker", grow)
+        assert _wait(
+            lambda: len((api.pods.get("arr-worker") or {})
+                        .get("spec", {}).get("containers", [])) == 4
+        )
+        sts = api.pods["arr-worker"]["status"]["containerStatuses"]
+        assert [c["name"] for c in sts] == [f"subjob-{i}" for i in range(4)]
+    finally:
+        mirror.stop()
+        api.stop()

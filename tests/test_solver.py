@@ -318,6 +318,22 @@ def test_indexed_native_empty():
     assert pl.node_of.shape == (0,)
 
 
+def test_indexed_native_actually_built():
+    """Guard against shipping a broken indexed.cpp: the graceful fallback
+    is bit-identical to greedy, so every parity test stays green through
+    it — this is the one test that FAILS when the fast path didn't build
+    (a compile regression shipped exactly this way once)."""
+    import shutil
+
+    import slurm_bridge_tpu.solver.indexed_native as inat
+
+    if shutil.which("g++") is None:
+        pytest.skip("no toolchain: fallback is the intended behavior")
+    snap, batch = random_scenario(8, 20, seed=0)
+    inat.indexed_place_native(snap, batch)
+    assert not inat._build_failed, "indexed.cpp failed to build — fast path lost"
+
+
 def test_indexed_native_build_failure_falls_back(monkeypatch):
     """No C++ toolchain must degrade to the oracle, not crash the tick."""
     import slurm_bridge_tpu.solver.indexed_native as inat
