@@ -69,6 +69,10 @@ _pods_unplaced = REGISTRY.gauge(
 _pods_preempted = REGISTRY.counter(
     "sbt_scheduler_pods_preempted_total", "pods preempted for higher priority work"
 )
+_route_total = REGISTRY.counter(
+    "sbt_scheduler_route_total",
+    "solve ticks per engine chosen by the routing rule",
+)
 
 #: Job ids whose preemption-cancel failed (agent unreachable); retried every
 #: tick until they land — a dropped cancel would orphan the Slurm job while
@@ -367,6 +371,10 @@ class PlacementScheduler:
         except grpc.RpcError as e:
             log.warning("remote Place failed (%s); skipping tick", e.code())
             return None  # tick() skips binding/preemption entirely
+        # the sidecar reports which engine it ran — count the tick under it
+        # so the route metric covers sidecar deployments too
+        self.last_route = f"remote-{resp.solver}"
+        _route_total.inc(engine=self.last_route)
         by_job_names = {
             int(a.job_id): list(a.node_names)
             for a in resp.assignments
@@ -398,6 +406,7 @@ class PlacementScheduler:
     def _solve(self, snapshot, batch, incumbent):
         if self.backend == "greedy":
             self.last_route = "greedy"
+            _route_total.inc(engine="greedy")
             return greedy_place(snapshot, batch)
         # auto routing (VERDICT r3 #5): a solve below the device dispatch
         # floor — or any solve without an accelerator — goes to the indexed
@@ -421,6 +430,7 @@ class PlacementScheduler:
                 )
 
                 self.last_route = "native"
+                _route_total.inc(engine="native")
                 return indexed_place_native(snapshot, batch)
         p_real = batch.num_shards
         if self.bucket:
@@ -449,6 +459,7 @@ class PlacementScheduler:
                 placed=placement.placed[:p_real],
                 free_after=placement.free_after,
             )
+        _route_total.inc(engine=self.last_route)
         return placement
 
     def _preempt(self, pod: Pod) -> bool:

@@ -299,3 +299,21 @@ def test_multiprocess_distributed_sharded_solve(tmp_path):
     # across the process boundary
     assert outs[0]["digest"] == outs[1]["digest"], outs
     assert outs[0]["placed"] == outs[1]["placed"]
+
+
+def test_scheduler_route_metric_counts_engines():
+    """The routing decision is operator-visible: one counter tick per
+    solve, labeled by engine."""
+    import numpy as np
+
+    from slurm_bridge_tpu.bridge import scheduler as sched_mod
+    from slurm_bridge_tpu.bridge.scheduler import PlacementScheduler
+    from slurm_bridge_tpu.bridge.store import ObjectStore
+    from slurm_bridge_tpu.solver.snapshot import random_scenario
+
+    before = dict(sched_mod._route_total._values)
+    s = PlacementScheduler(ObjectStore(), client=None)  # auto
+    snap, batch = random_scenario(16, 40, seed=1)
+    s._solve(snap, batch, np.full(batch.num_shards, -1, np.int32))
+    key = (("engine", "native"),)
+    assert sched_mod._route_total._values.get(key, 0) == before.get(key, 0) + 1
