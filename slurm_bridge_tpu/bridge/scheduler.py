@@ -353,9 +353,15 @@ class PlacementScheduler:
                     jobs=jobs,
                     inventory=[node_to_proto(n) for n in nodes],
                     partitions=[partition_to_proto(p) for p in partitions],
-                    # greedy stays greedy; auction lets the sidecar auto-pick
-                    # its best device path (single-device vs sharded)
-                    solver=self.backend if self.backend == "greedy" else "",
+                    # greedy stays greedy; "auto" gets the full routing rule
+                    # (indexed packer included); an explicit auction pin
+                    # sends "" = device-family auto (auction vs sharded
+                    # only), preserving the operator's quality choice
+                    solver=(
+                        self.backend if self.backend == "greedy"
+                        else "auto" if self.backend == "auto"
+                        else ""
+                    ),
                     # an explicitly tuned config rides along — the sidecar
                     # must not silently solve with its own defaults; an
                     # UNtuned bridge sends none, so a tuned sidecar keeps
@@ -395,13 +401,15 @@ class PlacementScheduler:
         if self.sharded is not None:
             return self.sharded
         from slurm_bridge_tpu.parallel.backend import ensure_backend
+        from slurm_bridge_tpu.solver.routing import use_sharded
 
         ensure_backend()
         import jax
 
-        if len(jax.devices()) < 2:
-            return False
-        return batch.num_shards * snapshot.num_nodes >= self.sharded_threshold
+        return use_sharded(
+            batch.num_shards, snapshot.num_nodes, len(jax.devices()),
+            self.sharded_threshold,
+        )
 
     def _solve(self, snapshot, batch, incumbent):
         if self.backend == "greedy":

@@ -60,20 +60,7 @@ _placed_total = REGISTRY.counter(
     "sbt_solver_jobs_placed_total", "jobs placed across all Place RPCs"
 )
 
-SOLVERS = ("auction", "greedy", "sharded")
-
-
-def auto_solver() -> str:
-    """Pick the best solver for this process: the sharded multi-device sweep
-    whenever a mesh is available, the single-device auction otherwise (the
-    same rule bench.py uses; reference analogue: one VK process per
-    partition, /root/reference/pkg/configurator/configurator.go:151-171)."""
-    from slurm_bridge_tpu.parallel.backend import ensure_backend
-
-    ensure_backend()  # hang-proof: never let a wedged accelerator block this
-    import jax
-
-    return "sharded" if len(jax.devices()) > 1 else "auction"
+SOLVERS = ("auction", "greedy", "sharded", "indexed")
 
 
 class PlacementSolverServicer:
@@ -110,13 +97,19 @@ class PlacementSolverServicer:
     # ---- RPCs ----
 
     def Place(self, request: pb.PlaceRequest, context) -> pb.PlaceResponse:
-        solver = request.solver or self.default_solver or auto_solver()
-        if solver not in SOLVERS:
+        # request.solver semantics: "auto" = the full routing rule (indexed
+        # packer included — what backend="auto" bridges send); "" = the
+        # sidecar's launch default, else device-family auto (auction vs
+        # sharded only — an explicitly auction-pinned bridge must keep the
+        # auction's quality edge); a named solver = exactly that engine.
+        requested = request.solver
+        solver = "" if requested == "auto" else (requested or self.default_solver)
+        if solver and solver not in SOLVERS:
             import grpc
 
             context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
-                f"unknown solver {solver!r} (want one of {SOLVERS})",
+                f"unknown solver {solver!r} (want one of {SOLVERS} or 'auto')",
             )
         nodes = [node_from_proto(m) for m in request.inventory]
         partitions = [partition_from_proto(m) for m in request.partitions]
@@ -128,6 +121,29 @@ class PlacementSolverServicer:
             partitions = [PartitionInfo(name="", nodes=tuple(n.name for n in nodes))]
         snapshot = encode_cluster(nodes, partitions)
         batch, incumbent = self._encode(request.jobs, snapshot)
+        has_pins = bool((incumbent >= 0).any())
+        if solver == "indexed" and has_pins:
+            if requested == "indexed":
+                # the CALLER insisted: reject rather than silently ignore pins
+                import grpc
+
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "solver 'indexed' does not honour incumbent pins — "
+                    "streaming requests need the auction kernel",
+                )
+            # launch-config default: degrade to the device family instead of
+            # permanently failing every streaming tick
+            log.warning(
+                "default solver 'indexed' cannot honour incumbent pins; "
+                "using the auction family for this request"
+            )
+            solver = ""
+        if not solver:
+            solver = self._auto_route(
+                snapshot, batch, has_pins,
+                allow_indexed=requested == "auto",
+            )
 
         # a request-borne config (the bridge's tuned AuctionConfig) beats
         # the launch-time default — without this the sidecar silently
@@ -239,6 +255,39 @@ class PlacementSolverServicer:
         )
         return batch, np.asarray(rows_inc, dtype=np.int32)
 
+    def _auto_route(
+        self, snapshot, batch, has_pins: bool, *, allow_indexed: bool
+    ) -> str:
+        """The same routing rules the in-process scheduler applies
+        (solver/routing.py — one shared module, so the two deployment
+        modes cannot drift): with ``allow_indexed`` (the caller sent
+        "auto"), small or gang-dominated pin-free batches run the native
+        packer; otherwise the device family, sharded only when the mesh
+        AND the solve size warrant it."""
+        from slurm_bridge_tpu.parallel.backend import ensure_backend
+        from slurm_bridge_tpu.solver.routing import (
+            choose_path,
+            gang_shard_fraction,
+            use_sharded,
+        )
+
+        backend = ensure_backend()  # hang-proof
+        if allow_indexed and not has_pins and choose_path(
+            batch.num_shards,
+            snapshot.num_nodes,
+            backend_name=backend,
+            gang_fraction=gang_shard_fraction(batch.gang_id),
+        ) == "native":
+            return "indexed"
+        import jax
+
+        return (
+            "sharded"
+            if use_sharded(batch.num_shards, snapshot.num_nodes,
+                           len(jax.devices()))
+            else "auction"
+        )
+
     def _solve(self, solver, snapshot, batch, incumbent, cfg=None):
         cfg = cfg or self.config
         if batch.num_shards == 0:
@@ -251,6 +300,12 @@ class PlacementSolverServicer:
             )
         if solver == "greedy":
             return greedy_place(snapshot, batch)
+        if solver == "indexed":
+            from slurm_bridge_tpu.solver.indexed_native import (
+                indexed_place_native,
+            )
+
+            return indexed_place_native(snapshot, batch)
         p_real = batch.num_shards
         if self.bucket:
             from slurm_bridge_tpu.solver.snapshot import pad_batch
@@ -322,7 +377,11 @@ def main(argv: list[str] | None = None) -> int:
     add_observability_flags(parser)
     parser.add_argument("--solver", default="", choices=["", *SOLVERS],
                         help="default solver when requests don't name one "
-                             "(empty = auto: sharded on a multi-device mesh)")
+                             "(empty = auto: the device auction — sharded "
+                             "when the mesh and solve size warrant it — or "
+                             "the indexed native packer for small or gang-"
+                             "dominated pin-free batches when the request "
+                             "opted into full routing with solver='auto')")
     parser.add_argument("--rounds", type=int, default=0,
                         help="auction rounds override (0 = config default)")
     parser.add_argument("--distributed", action="store_true",

@@ -197,7 +197,7 @@ def test_solver_info(solver_client):
     info = solver_client.SolverInfo(pb.SolverInfoRequest())
     assert info.backend == "cpu"  # conftest pins JAX_PLATFORMS=cpu
     assert info.devices >= 1
-    assert set(info.solvers) == {"auction", "greedy", "sharded"}
+    assert set(info.solvers) == {"auction", "greedy", "sharded", "indexed"}
     if info.devices > 1:
         assert "dp=" in info.mesh
 
@@ -378,3 +378,75 @@ def test_place_request_config_overrides_sidecar_default():
     assert any(s.config.rounds == 2 for s in servicer._sessions.values())
     servicer.Place(req, None)
     assert len(servicer._sessions) == 2  # both sessions retained
+
+
+def test_sidecar_auto_routes_like_in_process():
+    """solver="auto" (what backend="auto" bridges send) applies the full
+    routing rule: a small pin-free batch runs the indexed packer
+    (PlaceResponse names it). solver="" keeps the device family — an
+    auction-pinned bridge must not silently lose the auction's quality
+    edge. Pins always stay on the auction; explicitly asking for
+    'indexed' WITH pins is rejected."""
+    from slurm_bridge_tpu.core.types import NodeInfo
+    from slurm_bridge_tpu.wire.convert import node_to_proto
+
+    servicer = PlacementSolverServicer()
+    nodes = [node_to_proto(NodeInfo(name=f"n{i}", cpus=8, memory_mb=8192,
+                                    state="IDLE")) for i in range(3)]
+    small = pb.PlaceRequest(
+        jobs=[pb.PlaceJob(id="0", cpus=1, mem_mb=1024, nodes=1, priority=1.0)],
+        inventory=nodes,
+        solver="auto",
+    )
+    resp = servicer.Place(small, None)
+    assert resp.solver == "indexed"
+    assert resp.placed == 1
+
+    # "" = device family (auction-pinned bridges): never the indexed packer
+    small_plain = pb.PlaceRequest(
+        jobs=[pb.PlaceJob(id="0", cpus=1, mem_mb=1024, nodes=1, priority=1.0)],
+        inventory=nodes,
+    )
+    resp = servicer.Place(small_plain, None)
+    assert resp.solver in ("auction", "sharded")
+
+    pinned = pb.PlaceRequest(
+        jobs=[pb.PlaceJob(id="0", cpus=1, mem_mb=1024, nodes=1, priority=1.0,
+                          incumbent_node_names=["n1"])],
+        inventory=nodes,
+        solver="auto",
+    )
+    resp = servicer.Place(pinned, None)
+    assert resp.solver in ("auction", "sharded")
+
+    class _Ctx:
+        def abort(self, code, details):
+            raise RuntimeError(f"{code}: {details}")
+
+    bad = pb.PlaceRequest(
+        jobs=[pb.PlaceJob(id="0", cpus=1, mem_mb=1024, nodes=1, priority=1.0,
+                          incumbent_node_names=["n1"])],
+        inventory=nodes,
+        solver="indexed",
+    )
+    with pytest.raises(RuntimeError, match="incumbent"):
+        servicer.Place(bad, _Ctx())
+
+
+def test_default_indexed_solver_degrades_for_pinned_requests():
+    """A sidecar LAUNCHED with --solver indexed must not permanently fail
+    streaming ticks: pinned requests degrade to the device family."""
+    from slurm_bridge_tpu.core.types import NodeInfo
+    from slurm_bridge_tpu.wire.convert import node_to_proto
+
+    servicer = PlacementSolverServicer(solver="indexed")
+    nodes = [node_to_proto(NodeInfo(name="n0", cpus=8, memory_mb=8192,
+                                    state="IDLE"))]
+    pinned = pb.PlaceRequest(
+        jobs=[pb.PlaceJob(id="0", cpus=1, mem_mb=1024, nodes=1, priority=1.0,
+                          incumbent_node_names=["n0"])],
+        inventory=nodes,
+    )
+    resp = servicer.Place(pinned, None)
+    assert resp.solver in ("auction", "sharded")
+    assert resp.placed == 1
