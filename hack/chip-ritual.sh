@@ -1,0 +1,19 @@
+#!/bin/sh
+# The on-chip measurement ritual (run FIRST whenever the TPU is alive —
+# availability is intermittent on a multi-hour scale, so front-load):
+#   1. compiled-pallas parity (Mosaic, not interpret mode)
+#   2. headline bench (the driver-contract JSON line)
+#   3. the five BASELINE scenarios
+#   4. the per-stage auction round profile
+# Results land on stdout; redirect into diagnostics/ and fold the numbers
+# into BASELINE.md.
+set -eu
+cd "$(dirname "$0")/.."
+echo "== compiled-pallas parity (SBT_TEST_TPU=1 tests/test_ops.py) =="
+SBT_TEST_TPU=1 python -m pytest tests/test_ops.py -q
+echo "== headline (bench.py) =="
+python bench.py
+echo "== five scenarios =="
+python -m benchmarks.scenarios --json
+echo "== per-stage profile =="
+python -m benchmarks.scenarios --stages --json
