@@ -256,10 +256,12 @@ def _sidecar_stack(tmp_path, monkeypatch, **bridge_kwargs):
         agent_sock,
     )
     solver_sock = str(tmp_path / "solver.sock")
-    solver = serve_solver(solver_sock, solver="auction")
+    solver = serve_solver(
+        solver_sock, solver=bridge_kwargs.pop("sidecar_default", "auction")
+    )
+    bridge_kwargs.setdefault("scheduler_backend", "auction")
     bridge = Bridge(
         agent_sock,
-        scheduler_backend="auction",
         solver_endpoint=solver_sock,
         scheduler_interval=0.05,
         configurator_interval=5.0,
@@ -450,3 +452,25 @@ def test_default_indexed_solver_degrades_for_pinned_requests():
     resp = servicer.Place(pinned, None)
     assert resp.solver in ("auction", "sharded")
     assert resp.placed == 1
+
+
+def test_auto_bridge_routes_through_sidecar_to_indexed(tmp_path, monkeypatch):
+    """The whole product path with backend="auto" over the sidecar: the
+    bridge sends solver="auto", the sidecar's shared routing rule picks
+    the indexed packer for this tiny pin-free tick, and the route metric
+    records remote-indexed."""
+    from slurm_bridge_tpu.bridge import BridgeJobSpec, JobState
+
+    with _sidecar_stack(
+        tmp_path, monkeypatch,
+        scheduler_backend="auto", sidecar_default="",
+    ) as (bridge, solver, _sock, _state):
+        assert bridge.scheduler._remote is not None
+        bridge.submit(
+            "auto-remote",
+            BridgeJobSpec(partition="tiny", cpus_per_task=2,
+                          sbatch_script="#!/bin/sh\necho hi\n"),
+        )
+        job = bridge.wait("auto-remote", timeout=20.0)
+        assert job.status.state == JobState.SUCCEEDED
+        assert bridge.scheduler.last_route == "remote-indexed"
