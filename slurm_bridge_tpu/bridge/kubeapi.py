@@ -416,11 +416,14 @@ def _iso_now() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
-def node_manifest(vn) -> dict:
+def node_manifest(vn, kubelet_endpoint: tuple[str, int] | None = None) -> dict:
     """VirtualNode → core/v1 Node (NewNodeOrDie,
     /root/reference/pkg/slurm-virtual-kubelet/node.go:18-52: taints mirror
     the default tolerations, capacity is the live partition inventory,
-    fake NodeInfo so kubectl columns render)."""
+    fake NodeInfo so kubectl columns render). ``kubelet_endpoint`` is the
+    vkhttp server's (address, port): advertised via status.addresses +
+    daemonEndpoints so the apiserver can proxy ``kubectl logs`` to it
+    (the reference's node addresses, node.go:84-111)."""
     from slurm_bridge_tpu import __version__
 
     cap = vn.capacity or {}
@@ -448,13 +451,20 @@ def node_manifest(vn) -> dict:
             },
         },
         "spec": {"taints": [dict(PROVIDER_TAINT)]},
-        "status": node_status(vn, _rl(cap), _rl(alloc), __version__),
+        "status": node_status(vn, _rl(cap), _rl(alloc), __version__,
+                              kubelet_endpoint),
     }
 
 
-def node_status(vn, cap_rl: dict, alloc_rl: dict, version: str) -> dict:
+def node_status(
+    vn,
+    cap_rl: dict,
+    alloc_rl: dict,
+    version: str,
+    kubelet_endpoint: tuple[str, int] | None = None,
+) -> dict:
     now = _iso_now()
-    return {
+    status = {
         "capacity": cap_rl,
         "allocatable": alloc_rl,
         "conditions": [
@@ -472,6 +482,20 @@ def node_status(vn, cap_rl: dict, alloc_rl: dict, version: str) -> dict:
             "kubeletVersion": f"slurm-bridge-tpu/{version}",
         },
     }
+    if kubelet_endpoint and kubelet_endpoint[1] > 0:
+        addr, port = kubelet_endpoint
+        status["addresses"] = [
+            {"type": "InternalIP", "address": addr},
+            {"type": "Hostname", "address": vn.meta.name},
+        ]
+        status["daemonEndpoints"] = {"kubeletEndpoint": {"Port": port}}
+    else:
+        # explicit nulls: merge-patch leaves omitted keys untouched, so a
+        # bridge restarted WITHOUT the logs API must actively clear the
+        # stale advertisement or kubectl logs dials a dead endpoint forever
+        status["addresses"] = None
+        status["daemonEndpoints"] = None
+    return status
 
 
 #: Display-only image for worker pod containers — never pulled or run, the
@@ -552,10 +576,20 @@ class NodePodMirror:
     recreates anything an administrator deleted.
     """
 
-    def __init__(self, bridge, config: KubeConfig, *, resync: float = 15.0):
+    def __init__(
+        self,
+        bridge,
+        config: KubeConfig,
+        *,
+        resync: float = 15.0,
+        kubelet_endpoint: tuple[str, int] | None = None,
+    ):
         self.bridge = bridge
         self.config = config
         self.resync = resync
+        #: (address, port) of the vkhttp logs API, advertised on mirrored
+        #: Nodes so the apiserver can proxy `kubectl logs` to it
+        self.kubelet_endpoint = kubelet_endpoint
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         #: worker pods we created, name → container count (a changed count
@@ -614,7 +648,7 @@ class NodePodMirror:
     # -- node mirroring --
 
     def _assert_node(self, vn) -> None:
-        manifest = node_manifest(vn)
+        manifest = node_manifest(vn, self.kubelet_endpoint)
         path = self.config.core_path("nodes", vn.meta.name, namespaced=False,
                                      subresource="status")
         code = self._request(path, method="PATCH", body={"status": manifest["status"]})

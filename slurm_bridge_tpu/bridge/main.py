@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import sys
 import threading
@@ -140,8 +141,29 @@ def main(argv: list[str] | None = None) -> int:
 
         cfg = kube_config()
         kube_adapter[0] = KubeApiAdapter(bridge, cfg).start()
-        # kubectl visibility: one Node per partition + worker display pods
-        kube_mirror[0] = NodePodMirror(bridge, cfg).start()
+        # kubectl visibility: one Node per partition + worker display pods;
+        # advertise the vkhttp endpoint so the apiserver can proxy
+        # `kubectl logs` to it (SBT_POD_IP = downward-API pod IP, like the
+        # reference's VK_POD_IP env — configurator.go:188-293)
+        kubelet_ep = None
+        if bridge.kubelet_server is not None:
+            import socket as _socket
+
+            # precedence: downward-API env, then a CONCRETE configured bind
+            # address (0.0.0.0 is not routable), then hostname resolution
+            addr = os.environ.get("SBT_POD_IP", "")
+            bind = getattr(bridge.kubelet_server, "address", "")
+            if not addr and bind not in ("", "0.0.0.0", "::"):
+                addr = bind
+            if not addr:
+                try:
+                    addr = _socket.gethostbyname(_socket.gethostname())
+                except OSError:
+                    addr = "127.0.0.1"
+            kubelet_ep = (addr, bridge.kubelet_server.port)
+        kube_mirror[0] = NodePodMirror(
+            bridge, cfg, kubelet_endpoint=kubelet_ep
+        ).start()
         log.info("watching SlurmBridgeJob CRs on %s", cfg.base_url)
 
     def start_components() -> None:

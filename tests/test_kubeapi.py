@@ -557,3 +557,42 @@ def test_worker_pod_recreated_when_container_set_changes():
     finally:
         mirror.stop()
         api.stop()
+
+
+def test_node_advertises_kubelet_endpoint(fake_slurm, tmp_path):
+    """kubectl logs reaches the vkhttp API through the apiserver proxy,
+    which needs the Node's addresses + daemonEndpoints (the reference's
+    node addresses, node.go:84-111)."""
+    from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
+    from slurm_bridge_tpu.bridge import Bridge
+    from slurm_bridge_tpu.bridge.kubeapi import NodePodMirror
+    from slurm_bridge_tpu.wire import serve
+
+    api = _FakeApiServer([])
+    sock = str(tmp_path / "agent.sock")
+    agent = serve(
+        {"WorkloadManager": WorkloadServicer(SlurmClient(), tail_poll_interval=0.02)},
+        sock,
+    )
+    bridge = Bridge(sock, scheduler_interval=0.5, configurator_interval=5.0,
+                    node_sync_interval=0.05).start()
+    mirror = NodePodMirror(
+        bridge, KubeConfig(base_url=api.url, token="test-token"),
+        resync=0.3, kubelet_endpoint=("10.1.2.3", 10250),
+    ).start()
+    try:
+        assert _wait(lambda: "slurm-partition-debug" in api.nodes)
+        assert _wait(
+            lambda: (api.nodes.get("slurm-partition-debug", {}).get("status", {})
+                     .get("daemonEndpoints", {}).get("kubeletEndpoint", {})
+                     .get("Port")) == 10250
+        )
+        status = api.nodes["slurm-partition-debug"]["status"]
+        addrs = {a["type"]: a["address"] for a in status["addresses"]}
+        assert addrs["InternalIP"] == "10.1.2.3"
+        assert addrs["Hostname"] == "slurm-partition-debug"
+    finally:
+        mirror.stop()
+        bridge.stop()
+        agent.stop(None)
+        api.stop()
