@@ -148,6 +148,11 @@ class LeaderElector:
             self.on_started()
         deadline = time.time() + self.lease_duration
         while not self._stop.wait(self.renew_interval):
+            if time.time() > deadline:
+                # check BEFORE attempting: a slow failing attempt must not
+                # extend how long a stale holder keeps acting past expiry
+                log.error("lease expired before renewal could complete")
+                break
             try:
                 if self.try_acquire():
                     deadline = time.time() + self.lease_duration
@@ -230,6 +235,15 @@ class KubeLeaseElector(LeaderElector):
         )
         self.config = config
         self.lease_name = lease_name
+        #: per-request deadline MUST be well under the lease duration: with
+        #: the default 30 s HTTP timeout, a hung apiserver stalls a renewal
+        #: past expiry and the stale holder keeps acting while a rival on
+        #: the healthy side takes over — a split-brain window. /6 because a
+        #: renewal attempt issues up to TWO sequential requests (GET + PUT)
+        #: and run() also gates each attempt on the expiry deadline, so the
+        #: worst-case overrun is bounded by one attempt (~lease/3), not a
+        #: full extra lease duration
+        self.request_timeout = max(0.5, min(self.lease_duration / 6.0, 10.0))
 
     # -- REST primitives --
 
@@ -247,7 +261,9 @@ class KubeLeaseElector(LeaderElector):
         import urllib.error
 
         try:
-            with self.config.open(self._path()) as resp:
+            with self.config.open(
+                self._path(), timeout=self.request_timeout
+            ) as resp:
                 return _json.load(resp)
         except urllib.error.HTTPError as exc:
             if exc.code == 404:
@@ -267,6 +283,7 @@ class KubeLeaseElector(LeaderElector):
                 method=method,
                 body=_json.dumps(body).encode(),
                 content_type="application/json",
+                timeout=self.request_timeout,
             ):
                 return True
         except urllib.error.HTTPError as exc:

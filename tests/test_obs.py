@@ -469,3 +469,23 @@ class TestKubeLeaseElection:
             a._thread.join(2)
         finally:
             srv.stop()
+
+    def test_apiserver_outage_steps_down_within_lease(self):
+        """Renewals failing with network errors must step the leader down
+        once the lease duration passes without a successful renew — a
+        partitioned ex-leader cannot keep acting while a rival on the
+        healthy side takes over."""
+        srv = _FakeLeaseServer()
+        lost = threading.Event()
+        a = self._elector(srv, "a", lease_duration=1.2, renew_interval=0.1,
+                          on_stopped=lost.set)
+        a.start()
+        try:
+            assert a.wait_until_leader(3)
+            srv.stop()  # apiserver gone: every renewal now errors
+            assert lost.wait(6), "leader kept running past the lease"
+            assert not a.is_leader
+        finally:
+            a._stop.set()
+            a._thread.join(2)
+            srv.stop()  # idempotent; covers an early assert failure
