@@ -14,7 +14,7 @@ version the judge's BASELINE table is filled from.
 | 2 | 5k mixed cpu/mem pods → 512 nodes          | single-host JAX    |
 | 3 | 50k pods w/ gres → 10k nodes               | auction (+pallas)  |
 | 4 | gang MPI jobsets → fragmented 10k nodes    | masked auction     |
-| 5 | 50k pods + 1k/s churn streaming reschedule | warm-start auction |
+| 5 | 50k pods + 1k/s churn streaming reschedule | routed: auction / native |
 """
 
 from __future__ import annotations

@@ -39,29 +39,54 @@ def indexed_place_native(
     batch: JobBatch,
     *,
     best_fit: bool = True,
+    incumbent=None,
 ) -> Placement:
     """Drop-in replacement for :func:`greedy.greedy_place`, index-accelerated.
 
     First-fit parity (lowest node index that fits) cannot ride the
     free-cpu-ordered index, so ``best_fit=False`` delegates to the baseline
     native packer — the fast path is best-fit, the production default.
+
+    ``incumbent`` ([P] int32, -1 = free agent) pins streaming incumbents to
+    their held nodes (greedy.py semantics) — the CPU-fast engine for
+    incumbent-bearing ticks (VERDICT r4 #1). greedy.cpp is the measured
+    baseline and stays pin-free, so a pinned solve that cannot use the
+    indexed library degrades to the pure-Python oracle instead.
     """
     global _build_failed
+    import numpy as np
+
     from slurm_bridge_tpu.solver.greedy_native import greedy_place_native
+
+    pinned = incumbent is not None and bool((np.asarray(incumbent) >= 0).any())
+
+    def _fallback() -> Placement:
+        if pinned:
+            from slurm_bridge_tpu.solver.greedy import greedy_place
+
+            return greedy_place(
+                snapshot, batch, best_fit=best_fit, incumbent=incumbent
+            )
+        return greedy_place_native(snapshot, batch, best_fit=best_fit)
 
     # the treap index supports 1..4 resource dims (cpu + up to 3 augmented);
     # RESOURCE_DIMS ships 3 — an exotic wider snapshot takes the baseline,
     # which handles any arity
     if not best_fit or _build_failed or not 1 <= snapshot.free.shape[1] <= 4:
-        return greedy_place_native(snapshot, batch, best_fit=best_fit)
+        return _fallback()
     try:
         fn = load_symbol(
-            _SRC, _LIB, "sbt_indexed_place", place_argtypes(with_best_fit=False)
+            _SRC,
+            _LIB,
+            "sbt_indexed_place",
+            place_argtypes(with_best_fit=False, with_pin=True),
         )
     except NativeBuildError as exc:
         # degrade, don't crash the tick: the native greedy places
         # identically (and has its own oracle fallback for no-toolchain)
         _build_failed = True
         log.warning("%s — falling back to the native greedy packer", exc)
-        return greedy_place_native(snapshot, batch)
-    return call_place(fn, snapshot, batch)
+        return _fallback()
+    return call_place(
+        fn, snapshot, batch, incumbent=incumbent if pinned else None, with_pin=True
+    )

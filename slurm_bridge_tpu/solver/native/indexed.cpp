@@ -163,21 +163,95 @@ struct Bucket {
 
 extern "C" {
 
-// Identical contract to sbt_greedy_place (greedy.cpp) in best-fit mode:
-// returns the number of placed shards, -1 on out-of-range gang ids or an
-// unsupported resource arity (r must be 1..4; snapshot.py ships r=3).
+// Identical contract to sbt_greedy_place (greedy.cpp) in best-fit mode,
+// plus incumbent pins: returns the number of placed shards, -1 on
+// out-of-range gang ids, an out-of-range pin, or an unsupported resource
+// arity (r must be 1..4; snapshot.py ships r=3).
 // free_io is n*r floats updated in place; out_assign[p] = node index or -1.
+//
+// pin may be NULL (no incumbents) or p int32s: pin[s] >= 0 marks shard s a
+// streaming incumbent on that node (a running Slurm job cannot migrate).
+// Incumbents are handled reserve-first, preempt-only-when-necessary — the
+// greedy.py oracle defines the semantics and this file must place
+// bit-identically: a reservation pass (admission order) re-validates each
+// pinned shard's node and subtracts its demand up front; in the gang loop
+// a reserved shard converts its reservation into a placement, and a free
+// agent that fits NOWHERE may evict strictly-lower-priority uncommitted
+// reservations (last-admitted first, never its own gang-mates) on the
+// node with the least potential capacity that suffices. A failed gang
+// rolls back its placements and evictions and releases its own members'
+// reservations (those incumbents are preempted as a unit).
+//
 // First-fit (lowest node INDEX that fits) cannot ride a cpu-ordered
 // index, so the Python wrapper delegates best_fit=False to the baseline.
 int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
                       const uint32_t* node_feat, int p, const float* dem,
                       const int32_t* job_part, const uint32_t* req_feat,
                       const float* prio, const int32_t* gang,
-                      int32_t* out_assign) {
+                      const int32_t* pin, int32_t* out_assign) {
   if (p <= 0) return 0;
   if (r < 1 || r > kMaxAug + 1) return -1;
   for (int i = 0; i < p; ++i) {
     if (gang[i] < 0 || gang[i] >= p) return -1;
+    if (pin != nullptr && pin[i] >= n) return -1;
+  }
+
+  // stable order by priority descending, gangs grouped by first appearance
+  std::vector<int32_t> order(p);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+    return prio[a] > prio[b];
+  });
+  std::vector<std::vector<int32_t>> gangs;
+  {
+    std::vector<int32_t> gang_slot(p, -1);
+    for (int32_t idx : order) {
+      int32_t g = gang[idx];
+      if (gang_slot[g] < 0) {
+        gang_slot[g] = static_cast<int32_t>(gangs.size());
+        gangs.emplace_back();
+      }
+      gangs[gang_slot[g]].push_back(idx);
+    }
+  }
+
+  // ---- reservation pass (admission order): pinned shards re-validate
+  // their node (partition/feature/capacity) and reserve their demand up
+  // front, so free agents best-fit around running work instead of through
+  // it. state: 0 = none/lost, 1 = reservation alive, 2 = committed.
+  // Runs BEFORE the index is built so the ~P reservations cost matrix
+  // subtractions, not treap reindexes.
+  std::vector<uint8_t> state(p, 0);
+  std::vector<std::vector<int32_t>> pernode;  // reserved shards per node,
+  int reserved_alive = 0;                     // admission-rank order
+  // per-node sum of ALIVE reserved demand — an upper bound on what a
+  // tier-2 eviction can recover there, so the common "fits nowhere even
+  // with evictions" scan is O(n·r) instead of O(total reservations)
+  std::vector<float> rsum;
+  auto rsum_add = [&](int32_t nd, const float* d, float sign) {
+    float* row = rsum.data() + static_cast<size_t>(nd) * r;
+    for (int k = 0; k < r; ++k) row[k] += sign * d[k];
+  };
+  if (pin != nullptr) {
+    pernode.assign(n, {});
+    rsum.assign(static_cast<size_t>(n) * r, 0.f);
+    for (int32_t s : order) {
+      const int32_t pn = pin[s];
+      if (pn < 0) continue;
+      const float* d = dem + static_cast<size_t>(s) * r;
+      const int32_t jp = job_part[s];
+      const uint32_t rf = req_feat[s];
+      bool ok_pin = (jp < 0 || node_part[pn] == jp) &&
+                    ((node_feat[pn] & rf) == rf);
+      float* f = free_io + static_cast<size_t>(pn) * r;
+      for (int k = 0; ok_pin && k < r; ++k) ok_pin = f[k] >= d[k];
+      if (!ok_pin) continue;
+      for (int k = 0; k < r; ++k) f[k] -= d[k];
+      state[s] = 1;
+      pernode[pn].push_back(s);
+      rsum_add(pn, d, 1.f);
+      ++reserved_alive;
+    }
   }
 
   // ---- build the index: bucket per distinct (partition, feature mask) ----
@@ -201,33 +275,7 @@ int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
         forest.insert(buckets[b].root, nd, free_io + static_cast<size_t>(nd) * r);
   }
 
-  // stable order by priority descending, gangs grouped by first appearance
-  std::vector<int32_t> order(p);
-  std::iota(order.begin(), order.end(), 0);
-  std::stable_sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
-    return prio[a] > prio[b];
-  });
-  std::vector<std::vector<int32_t>> gangs;
-  {
-    std::vector<int32_t> gang_slot(p, -1);
-    for (int32_t idx : order) {
-      int32_t g = gang[idx];
-      if (gang_slot[g] < 0) {
-        gang_slot[g] = static_cast<int32_t>(gangs.size());
-        gangs.emplace_back();
-      }
-      gangs[gang_slot[g]].push_back(idx);
-    }
-  }
-
   std::fill(out_assign, out_assign + p, -1);
-  // multi-shard gang bookkeeping: a chosen node is ERASED from its treap
-  // (enforcing the distinct-node rule by construction) and the pre-gang
-  // free row is logged so a failed gang restores matrix + index exactly
-  std::vector<int32_t> touched_node;
-  std::vector<float> touched_free;
-  std::vector<int32_t> chosen_shard, chosen_node;
-  int placed = 0;
 
   auto reindex = [&](int32_t nd) {
     Bucket& bk = buckets[node_bucket[nd]];
@@ -235,31 +283,146 @@ int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
     bk.root = forest.insert(bk.root, nd, free_io + static_cast<size_t>(nd) * r);
   };
 
+  // multi-shard gang bookkeeping: a chosen node is ERASED from its treap
+  // (enforcing the distinct-node rule by construction) and the pre-gang
+  // free row is logged so a failed gang restores matrix + index exactly
+  std::vector<int32_t> touched_node;
+  std::vector<float> touched_free;
+  std::vector<int32_t> chosen_shard, chosen_node;
+  std::vector<int32_t> evicted_this;
+  int placed = 0;
+
   for (const auto& shards : gangs) {
     const bool multi = shards.size() > 1;
+    const int32_t gcur = gang[shards[0]];
     chosen_shard.clear();
     chosen_node.clear();
     touched_node.clear();
     touched_free.clear();
+    evicted_this.clear();
     bool ok = true;
+
+    auto in_touched = [&](int32_t nd) {
+      for (int32_t t : touched_node) {
+        if (t == nd) return true;
+      }
+      return false;
+    };
 
     for (int32_t s : shards) {
       const float* d = dem + static_cast<size_t>(s) * r;
       const int32_t jp = job_part[s];
       const uint32_t rf = req_feat[s];
-      // best across matching buckets by (free_cpu, node index) — exactly
-      // the baseline's min-leftover / lowest-index tie-break
       int best_node = kNil;
-      for (Bucket& bk : buckets) {
-        if (jp >= 0 && bk.part != jp) continue;
-        if ((bk.feat & rf) != rf) continue;
-        int cand = forest.query(bk.root, d[0], d);
-        if (cand == kNil) continue;
-        if (best_node == kNil ||
-            forest.key_cpu[cand] < forest.key_cpu[best_node] ||
-            (forest.key_cpu[cand] == forest.key_cpu[best_node] &&
-             cand < best_node)) {
-          best_node = cand;
+      const int32_t pn = pin != nullptr ? pin[s] : -1;
+      bool was_reserved = false;
+      if (pn >= 0 && state[s] == 1) {
+        // the reservation converts into the placement — nothing more to
+        // subtract, but gang distinctness still applies
+        if (multi && in_touched(pn)) {
+          ok = false;
+          break;
+        }
+        best_node = pn;
+        was_reserved = true;
+      } else if (pn >= 0) {
+        // lost (or never got) its reservation: one last chance on what
+        // its node has left — pinned shards never evict
+        bool ok_pin = (jp < 0 || node_part[pn] == jp) &&
+                      ((node_feat[pn] & rf) == rf);
+        const float* f = free_io + static_cast<size_t>(pn) * r;
+        for (int k = 0; ok_pin && k < r; ++k) ok_pin = f[k] >= d[k];
+        if (ok_pin && multi && in_touched(pn)) ok_pin = false;
+        if (!ok_pin) {
+          ok = false;
+          break;
+        }
+        best_node = pn;
+      } else {
+        // best across matching buckets by (free_cpu, node index) — exactly
+        // the baseline's min-leftover / lowest-index tie-break
+        for (Bucket& bk : buckets) {
+          if (jp >= 0 && bk.part != jp) continue;
+          if ((bk.feat & rf) != rf) continue;
+          int cand = forest.query(bk.root, d[0], d);
+          if (cand == kNil) continue;
+          if (best_node == kNil ||
+              forest.key_cpu[cand] < forest.key_cpu[best_node] ||
+              (forest.key_cpu[cand] == forest.key_cpu[best_node] &&
+               cand < best_node)) {
+            best_node = cand;
+          }
+        }
+        if (best_node == kNil && reserved_alive > 0) {
+          // tier-2, preempt-only-when-necessary: the node with the least
+          // potential capacity (own free + strictly-lower-priority
+          // uncommitted reservations, never this gang's own) that fits
+          const float prio_s = prio[s];
+          float best_cpu = 0.f;
+          float pot[kMaxAug + 1];
+          for (int32_t nd = 0; nd < n; ++nd) {
+            if (jp >= 0 && node_part[nd] != jp) continue;
+            if ((node_feat[nd] & rf) != rf) continue;
+            const float* f = free_io + static_cast<size_t>(nd) * r;
+            {
+              // prune on free + ALL alive reservations — an upper bound
+              // on the filtered potential below, so hopeless nodes cost
+              // O(r), not a walk of their reservation list
+              const float* rs = rsum.data() + static_cast<size_t>(nd) * r;
+              bool maybe = true;
+              for (int k = 0; maybe && k < r; ++k) maybe = f[k] + rs[k] >= d[k];
+              if (!maybe) continue;
+            }
+            if (multi && in_touched(nd)) continue;
+            for (int k = 0; k < r; ++k) pot[k] = f[k];
+            bool any = false;
+            for (int32_t e : pernode[nd]) {  // admission-rank order —
+              if (state[e] != 1) continue;   // float-add order is part of
+              if (prio[e] >= prio_s) continue;  // the oracle contract
+              if (gang[e] == gcur) continue;
+              any = true;
+              const float* de = dem + static_cast<size_t>(e) * r;
+              for (int k = 0; k < r; ++k) pot[k] += de[k];
+            }
+            if (!any) continue;
+            bool fits = true;
+            for (int k = 0; fits && k < r; ++k) fits = pot[k] >= d[k];
+            if (!fits) continue;
+            if (best_node == kNil || pot[0] < best_cpu) {
+              best_node = nd;
+              best_cpu = pot[0];
+            }
+          }
+          if (best_node != kNil) {
+            // make room: evict last-admitted first until the shard fits
+            float* f = free_io + static_cast<size_t>(best_node) * r;
+            if (multi) {
+              touched_node.push_back(best_node);
+              touched_free.insert(touched_free.end(), f, f + r);
+              Bucket& bk = buckets[node_bucket[best_node]];
+              bk.root = forest.erase(bk.root, best_node);
+            }
+            const auto& lst = pernode[best_node];
+            for (size_t i = lst.size(); i-- > 0;) {
+              bool fits = true;
+              for (int k = 0; fits && k < r; ++k) fits = f[k] >= d[k];
+              if (fits) break;
+              const int32_t e = lst[i];
+              if (state[e] != 1 || prio[e] >= prio_s || gang[e] == gcur)
+                continue;
+              const float* de = dem + static_cast<size_t>(e) * r;
+              for (int k = 0; k < r; ++k) f[k] += de[k];
+              state[e] = 0;
+              rsum_add(best_node, de, -1.f);
+              --reserved_alive;
+              evicted_this.push_back(e);
+            }
+            for (int k = 0; k < r; ++k) f[k] -= d[k];
+            if (!multi) reindex(best_node);
+            chosen_shard.push_back(s);
+            chosen_node.push_back(best_node);
+            continue;  // placement fully applied above
+          }
         }
       }
       if (best_node == kNil) {
@@ -274,8 +437,10 @@ int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
         // nodes, and commit/rollback reinserts it with the right values
         Bucket& bk = buckets[node_bucket[best_node]];
         bk.root = forest.erase(bk.root, best_node);
-        for (int k = 0; k < r; ++k) f[k] -= d[k];
-      } else {
+        if (!was_reserved) {
+          for (int k = 0; k < r; ++k) f[k] -= d[k];
+        }
+      } else if (!was_reserved) {
         for (int k = 0; k < r; ++k) f[k] -= d[k];
         reindex(best_node);
       }
@@ -285,7 +450,13 @@ int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
 
     if (ok) {
       for (size_t i = 0; i < chosen_shard.size(); ++i) {
-        out_assign[chosen_shard[i]] = chosen_node[i];
+        const int32_t s = chosen_shard[i];
+        out_assign[s] = chosen_node[i];
+        if (state[s] == 1) {
+          state[s] = 2;  // committed — no longer evictable
+          rsum_add(pin[s], dem + static_cast<size_t>(s) * r, -1.f);
+          --reserved_alive;
+        }
         ++placed;
       }
       if (multi) {
@@ -295,18 +466,40 @@ int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
                                   free_io + static_cast<size_t>(nd) * r);
         }
       }
-    } else if (multi) {
-      // roll back in reverse; nodes were erased, so restore + reinsert
-      for (size_t i = touched_node.size(); i-- > 0;) {
-        const int32_t nd = touched_node[i];
-        std::memcpy(free_io + static_cast<size_t>(nd) * r,
-                    touched_free.data() + i * r, sizeof(float) * r);
-        Bucket& bk = buckets[node_bucket[nd]];
-        bk.root = forest.insert(bk.root, nd,
-                                free_io + static_cast<size_t>(nd) * r);
+    } else {
+      if (multi) {
+        // roll back in reverse; nodes were erased, so restore + reinsert
+        for (size_t i = touched_node.size(); i-- > 0;) {
+          const int32_t nd = touched_node[i];
+          std::memcpy(free_io + static_cast<size_t>(nd) * r,
+                      touched_free.data() + i * r, sizeof(float) * r);
+          Bucket& bk = buckets[node_bucket[nd]];
+          bk.root = forest.insert(bk.root, nd,
+                                  free_io + static_cast<size_t>(nd) * r);
+        }
+      }
+      // un-evict (their capacity lives only in the rolled-back rows),
+      // then release THIS gang's own reservations — its incumbents are
+      // preempted as a unit
+      for (int32_t e : evicted_this) {
+        state[e] = 1;
+        rsum_add(pin[e], dem + static_cast<size_t>(e) * r, 1.f);
+        ++reserved_alive;
+      }
+      for (int32_t s : shards) {
+        if (state[s] == 1) {
+          const int32_t pn = pin[s];
+          float* f = free_io + static_cast<size_t>(pn) * r;
+          const float* d = dem + static_cast<size_t>(s) * r;
+          for (int k = 0; k < r; ++k) f[k] += d[k];
+          state[s] = 0;
+          rsum_add(pn, d, -1.f);
+          --reserved_alive;
+          reindex(pn);
+        }
       }
     }
-    // single-shard failure touched nothing
+    // single-shard failure on the non-evicting paths touched nothing
   }
   return placed;
 }

@@ -15,6 +15,7 @@ scheduler tick.
 from __future__ import annotations
 
 import ctypes
+import os
 import pathlib
 import subprocess
 import threading
@@ -28,6 +29,24 @@ class NativeBuildError(RuntimeError):
 
 
 def _build(src: pathlib.Path, lib: pathlib.Path) -> None:
+    # Compile to a process-unique temp path and os.replace() it in: the
+    # per-process lock below cannot stop a SECOND process (bridge + sidecar
+    # share a host) from dlopening a half-written .so mid-compile, and
+    # runtime builds are the norm now that no binary is checked in
+    # (ADVICE r4). rename(2) is atomic on one filesystem, so a concurrent
+    # loader sees either the old complete library or the new complete one.
+    # sweep orphans first: a process killed mid-compile (OOM, pod
+    # eviction) leaves its pid-unique temp behind forever otherwise.
+    # Age-gated so a live concurrent builder's in-flight temp survives.
+    import time
+
+    for stale in lib.parent.glob(f".{lib.name}.*.tmp"):
+        try:
+            if time.time() - stale.stat().st_mtime > 600:
+                stale.unlink()
+        except OSError:
+            pass  # racing builder finished/cleaned it first
+    tmp = lib.with_name(f".{lib.name}.{os.getpid()}.tmp")
     cmd = [
         "g++",
         "-O3",
@@ -37,7 +56,7 @@ def _build(src: pathlib.Path, lib: pathlib.Path) -> None:
         "-std=c++17",
         str(src),
         "-o",
-        str(lib),
+        str(tmp),
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True)
@@ -46,10 +65,16 @@ def _build(src: pathlib.Path, lib: pathlib.Path) -> None:
             f"cannot build {lib.name}: g++ unavailable ({exc})"
         ) from exc
     if proc.returncode != 0:
+        tmp.unlink(missing_ok=True)
         raise NativeBuildError(
             f"g++ failed building {lib.name} (rc={proc.returncode}):\n"
             f"{proc.stderr.strip()}"
         )
+    try:
+        os.replace(tmp, lib)
+    except OSError as exc:
+        tmp.unlink(missing_ok=True)
+        raise NativeBuildError(f"cannot install {lib.name}: {exc}") from exc
 
 
 def load_symbol(
@@ -67,7 +92,13 @@ def load_symbol(
         if cdll is None:
             if not lib.exists() or lib.stat().st_mtime < src.stat().st_mtime:
                 _build(src, lib)
-            cdll = ctypes.CDLL(key)
+            try:
+                cdll = ctypes.CDLL(key)
+            except OSError as exc:
+                # a corrupt/truncated cached .so (e.g. left by a crashed
+                # build before installs were atomic) must degrade like a
+                # failed build, not crash the scheduler tick (ADVICE r4)
+                raise NativeBuildError(f"cannot load {lib.name}: {exc}") from exc
             _loaded[key] = cdll
     fn = getattr(cdll, symbol)
     fn.restype = restype
@@ -79,9 +110,10 @@ def ptr(a, ctype):
     return a.ctypes.data_as(ctypes.POINTER(ctype))
 
 
-def place_argtypes(*, with_best_fit: bool) -> list:
+def place_argtypes(*, with_best_fit: bool, with_pin: bool = False) -> list:
     """The shared C ABI of both packers (greedy.cpp carries a best_fit
-    flag before the output pointer; indexed.cpp is best-fit only)."""
+    flag before the output pointer; indexed.cpp is best-fit only and
+    carries a nullable incumbent-pin array instead)."""
     argtypes = [
         ctypes.c_int,  # n
         ctypes.c_int,  # r
@@ -97,16 +129,28 @@ def place_argtypes(*, with_best_fit: bool) -> list:
     ]
     if with_best_fit:
         argtypes.append(ctypes.c_int)
+    if with_pin:
+        argtypes.append(ctypes.POINTER(ctypes.c_int32))  # pin (nullable)
     argtypes.append(ctypes.POINTER(ctypes.c_int32))  # out_assign
     return argtypes
 
 
-def call_place(fn, snapshot, batch, *, best_fit: bool | None = None):
+def call_place(
+    fn,
+    snapshot,
+    batch,
+    *,
+    best_fit: bool | None = None,
+    incumbent=None,
+    with_pin: bool = False,
+):
     """Marshal a (snapshot, batch) pair into the shared packer ABI, call
     ``fn``, and lift the result back into a Placement.
 
     ``best_fit=None`` omits the flag argument (for indexed.cpp); both
     bindings share this marshalling so the array contract cannot drift.
+    ``with_pin`` appends the incumbent array (NULL when ``incumbent`` is
+    None — the no-incumbent fast call).
     """
     import numpy as np
 
@@ -134,8 +178,18 @@ def call_place(fn, snapshot, batch, *, best_fit: bool | None = None):
     ]
     if best_fit is not None:
         args.append(1 if best_fit else 0)
+    if with_pin:
+        if incumbent is None:
+            args.append(None)
+        else:
+            args.append(
+                ptr(np.ascontiguousarray(incumbent, np.int32), ctypes.c_int32)
+            )
     args.append(ptr(assign, ctypes.c_int32))
     rc = fn(*args)
     if rc < 0:
-        raise ValueError("native packer rejected gang ids (out of [0, p) range)")
+        raise ValueError(
+            "native packer rejected its inputs (gang id or incumbent pin "
+            "out of range)"
+        )
     return Placement(node_of=assign, placed=assign >= 0, free_after=free_io)

@@ -121,27 +121,9 @@ class PlacementSolverServicer:
             partitions = [PartitionInfo(name="", nodes=tuple(n.name for n in nodes))]
         snapshot = encode_cluster(nodes, partitions)
         batch, incumbent = self._encode(request.jobs, snapshot)
-        has_pins = bool((incumbent >= 0).any())
-        if solver == "indexed" and has_pins:
-            if requested == "indexed":
-                # the CALLER insisted: reject rather than silently ignore pins
-                import grpc
-
-                context.abort(
-                    grpc.StatusCode.INVALID_ARGUMENT,
-                    "solver 'indexed' does not honour incumbent pins — "
-                    "streaming requests need the auction kernel",
-                )
-            # launch-config default: degrade to the device family instead of
-            # permanently failing every streaming tick
-            log.warning(
-                "default solver 'indexed' cannot honour incumbent pins; "
-                "using the auction family for this request"
-            )
-            solver = ""
         if not solver:
             solver = self._auto_route(
-                snapshot, batch, has_pins,
+                snapshot, batch,
                 allow_indexed=requested == "auto",
             )
 
@@ -255,15 +237,13 @@ class PlacementSolverServicer:
         )
         return batch, np.asarray(rows_inc, dtype=np.int32)
 
-    def _auto_route(
-        self, snapshot, batch, has_pins: bool, *, allow_indexed: bool
-    ) -> str:
+    def _auto_route(self, snapshot, batch, *, allow_indexed: bool) -> str:
         """The same routing rules the in-process scheduler applies
         (solver/routing.py — one shared module, so the two deployment
         modes cannot drift): with ``allow_indexed`` (the caller sent
-        "auto"), small or gang-dominated pin-free batches run the native
-        packer; otherwise the device family, sharded only when the mesh
-        AND the solve size warrant it."""
+        "auto"), small or gang-dominated batches run the native packer
+        (which honours incumbent pins since round 5); otherwise the device
+        family, sharded only when the mesh AND the solve size warrant it."""
         from slurm_bridge_tpu.parallel.backend import ensure_backend
         from slurm_bridge_tpu.solver.routing import (
             choose_path,
@@ -272,7 +252,7 @@ class PlacementSolverServicer:
         )
 
         backend = ensure_backend()  # hang-proof
-        if allow_indexed and not has_pins and choose_path(
+        if allow_indexed and choose_path(
             batch.num_shards,
             snapshot.num_nodes,
             backend_name=backend,
@@ -299,13 +279,13 @@ class PlacementSolverServicer:
                 free_after=snapshot.free.copy(),
             )
         if solver == "greedy":
-            return greedy_place(snapshot, batch)
+            return greedy_place(snapshot, batch, incumbent=incumbent)
         if solver == "indexed":
             from slurm_bridge_tpu.solver.indexed_native import (
                 indexed_place_native,
             )
 
-            return indexed_place_native(snapshot, batch)
+            return indexed_place_native(snapshot, batch, incumbent=incumbent)
         p_real = batch.num_shards
         if self.bucket:
             from slurm_bridge_tpu.solver.snapshot import pad_batch

@@ -758,3 +758,68 @@ def test_sampled_incumbent_revalidated_against_node_changes():
         assert not sampled.placed[0], (
             f"{label}: sampled kept an infeasible incumbent node"
         )
+
+
+# ---------------------------------------- incumbent pins (VERDICT r4 #1)
+
+
+def _pinned_case(n_nodes, n_jobs, *, seed, load, keep=0.7):
+    """A (snapshot, batch, incumbent) triple with realistic pins: place
+    once, pin a subset of placed shards, then shuffle priorities so
+    newcomers outrank many incumbents (tier-2 evictions fire)."""
+    from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+    from slurm_bridge_tpu.solver.snapshot import JobBatch
+
+    rng = np.random.default_rng(seed + 99)
+    snap, batch = random_scenario(n_nodes, n_jobs, seed=seed, load=load,
+                                  gpu_fraction=0.15, gang_fraction=0.12)
+    base = indexed_place_native(snap, batch)
+    inc = np.where((rng.random(batch.num_shards) < keep) & base.placed,
+                   base.node_of, -1).astype(np.int32)
+    shuffled = JobBatch(
+        demand=batch.demand, partition_of=batch.partition_of,
+        req_features=batch.req_features,
+        priority=rng.permutation(batch.priority),
+        gang_id=batch.gang_id, job_of=batch.job_of,
+    )
+    return snap, shuffled, inc
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_indexed_native_pinned_matches_python(seed):
+    """Bit-exact oracle parity for the reserve-first incumbent semantics,
+    on clusters tight enough that tier-2 evictions and gang-failure
+    reservation releases both fire."""
+    from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+
+    snap, batch, inc = _pinned_case(96, 800, seed=seed, load=0.95)
+    py = greedy_place(snap, batch, incumbent=inc)
+    idx = indexed_place_native(snap, batch, incumbent=inc)
+    assert np.array_equal(py.node_of, idx.node_of)
+    assert np.allclose(py.free_after, idx.free_after, atol=1e-3)
+    # pins honoured: a placed incumbent is on exactly its held node
+    kept = (inc >= 0) & idx.placed
+    assert np.array_equal(idx.node_of[kept], inc[kept])
+    assert kept.any()
+
+
+def test_indexed_native_pinned_rejects_out_of_range_pin():
+    from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+
+    snap, batch = random_scenario(8, 10, seed=0)
+    inc = np.full(batch.num_shards, -1, np.int32)
+    inc[0] = snap.num_nodes  # out of range
+    with pytest.raises(ValueError, match="out of range"):
+        indexed_place_native(snap, batch, incumbent=inc)
+
+
+def test_indexed_native_pinned_fallback_uses_oracle(monkeypatch):
+    """With no native library, a PINNED solve must degrade to the oracle
+    (greedy.cpp is the measured baseline and knows nothing of pins)."""
+    import slurm_bridge_tpu.solver.indexed_native as inat
+
+    snap, batch, inc = _pinned_case(24, 80, seed=3, load=0.9)
+    monkeypatch.setattr(inat, "_build_failed", True)
+    out = inat.indexed_place_native(snap, batch, incumbent=inc)
+    py = greedy_place(snap, batch, incumbent=inc)
+    assert np.array_equal(out.node_of, py.node_of)
