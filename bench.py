@@ -116,6 +116,18 @@ def _dump_stacks(attempt: int, tag: str, elapsed: float) -> str:
         return ""
 
 
+def _record_chip(ok: bool, detail: str) -> None:
+    """Feed this bench run's probe outcome into the shared chip state so
+    the watcher and later bench runs see it. Best-effort, never raises."""
+    try:
+        from slurm_bridge_tpu.utils import chipstate
+
+        chipstate.record(ok, detail, dir_override=_DIAG_DIR)
+    except Exception as exc:  # noqa: BLE001 — diagnostics must not kill us
+        print(f"# chip-state record failed: {exc!r}", file=sys.stderr,
+              flush=True)
+
+
 def _force_cpu() -> str:
     import jax
 
@@ -159,9 +171,32 @@ def _acquire_backend() -> str:
     # window is generous, but a wedge that survived it rarely clears, and
     # the total must leave room for the forced-CPU solve inside whatever
     # patience the outer harness has
-    budget = float(os.environ.get("SBT_BENCH_TPU_BUDGET", "600")) / (
-        2 ** (attempt - 1)
-    )
+    budget_env = os.environ.get("SBT_BENCH_TPU_BUDGET")
+    budget = float(budget_env or "600") / (2 ** (attempt - 1))
+    # VERDICT r4 #3: when the availability watcher (hack/chip-watch.sh →
+    # utils/chipstate.py) has the chip on record as dead — ≥2 consecutive
+    # failed probes, newest recent enough to still be evidence — don't
+    # burn ~17.5 min re-discovering the wedge: one short probe (the state
+    # could be stale-optimistic the other way), no re-exec retries, then
+    # CPU. An explicit SBT_BENCH_TPU_BUDGET overrides the short-circuit.
+    if budget_env is None:
+        try:
+            from slurm_bridge_tpu.utils import chipstate
+
+            if chipstate.chip_known_dead(dir_override=_DIAG_DIR):
+                budget = min(
+                    budget,
+                    float(os.environ.get("SBT_BENCH_TPU_SHORT_BUDGET", "60")),
+                )
+                max_attempts = 1
+                print(
+                    "# chip watcher records the chip DEAD — short probe only "
+                    "(override with SBT_BENCH_TPU_BUDGET)",
+                    file=sys.stderr, flush=True,
+                )
+        except Exception as exc:  # noqa: BLE001 — state is advisory
+            print(f"# chip-state check failed: {exc!r}",
+                  file=sys.stderr, flush=True)
     result: dict = {}
 
     def _probe() -> None:
@@ -203,6 +238,8 @@ def _acquire_backend() -> str:
     if result.get("backend"):
         print(f"# backend up after {time.perf_counter() - t0:.0f}s",
               file=sys.stderr, flush=True)
+        if result["backend"] != "cpu":
+            _record_chip(True, f"bench acquired {result['backend']}")
         return result["backend"]
     if "error" in result:
         print(f"# backend probe failed cleanly: {result['error']!r}",
@@ -218,6 +255,7 @@ def _acquire_backend() -> str:
     # Wedged inside backend init: dump, then retry in a FRESH process (the
     # init lock here is poisoned) or give up to CPU after the last attempt.
     _dump_stacks(attempt, "expired", time.perf_counter() - t0)
+    _record_chip(False, f"bench probe attempt {attempt} wedged >{budget:.0f}s")
     if attempt < max_attempts:
         print(f"# attempt {attempt} wedged — re-exec for attempt {attempt + 1}",
               file=sys.stderr, flush=True)
@@ -304,8 +342,12 @@ def main() -> None:
     )
     if route == "native":
         from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+        from slurm_bridge_tpu.solver.routing import native_fit_policy
 
-        solve = lambda: indexed_place_native(snap, batch)  # noqa: E731
+        # same fit policy the production scheduler routes with (worst-fit:
+        # the measured quality winner at this shape — BASELINE.md round 5)
+        pol = native_fit_policy()
+        solve = lambda: indexed_place_native(snap, batch, policy=pol)  # noqa: E731
     elif n_dev > 1:
         from slurm_bridge_tpu.solver.sharded import sharded_place
 

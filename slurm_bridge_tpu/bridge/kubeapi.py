@@ -732,9 +732,37 @@ class NodePodMirror:
         for vn in self.bridge.store.list(VirtualNode.KIND):
             if not vn.meta.deleted:
                 self._assert_node(vn)
+        live: set[str] = set()
         for pod in self.bridge.store.list(Pod.KIND):
             if pod.spec.role == PodRole.WORKER and not pod.meta.deleted:
                 self._assert_pod(pod)
+                live.add(pod.meta.name)
+        self._gc_stray_pods(live)
+
+    def _gc_stray_pods(self, live: set[str]) -> None:
+        """Delete mirrored display pods whose store pod no longer exists.
+
+        DELETED store events only cover pods THIS incarnation created
+        (``event.name in self._pods``): a worker pod removed while the
+        bridge was down — or created by a previous incarnation — would
+        leave its display Pod in the apiserver forever (ADVICE r4). LIST
+        by our role label and reap anything not in the live set; the
+        label keeps operator-owned pods out of reach.
+        """
+        listed = self._get_json(
+            self.config.core_path("pods")
+            + f"?labelSelector={GROUP}%2Frole%3Dworker"
+        )
+        if not listed:
+            return
+        for item in listed.get("items") or []:
+            meta = item.get("metadata") or {}
+            name = meta.get("name", "")
+            # re-check the label client-side: an apiserver stand-in that
+            # ignores selectors must not trick us into reaping foreign pods
+            role = (meta.get("labels") or {}).get(f"{GROUP}/role")
+            if name and role == "worker" and name not in live:
+                self._delete_pod(name)
 
     def _loop(self) -> None:
         import queue as _queue

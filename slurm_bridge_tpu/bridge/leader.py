@@ -132,8 +132,12 @@ class LeaderElector:
 
     def run(self) -> None:
         """Blocking acquire → renew loop (call in a thread via start())."""
+        acquired_at = 0.0
         while not self._stop.is_set():
             try:
+                # pre-request stamp, for the same reason as the renewal
+                # loop below: expiry must be measured from what rivals see
+                acquired_at = time.time()
                 if self.try_acquire():
                     break
             except OSError as exc:
@@ -146,7 +150,7 @@ class LeaderElector:
         log.info("became leader (%s) on %s", self.identity, self.lock_path)
         if self.on_started:
             self.on_started()
-        deadline = time.time() + self.lease_duration
+        deadline = acquired_at + self.lease_duration
         while not self._stop.wait(self.renew_interval):
             if time.time() > deadline:
                 # check BEFORE attempting: a slow failing attempt must not
@@ -154,8 +158,14 @@ class LeaderElector:
                 log.error("lease expired before renewal could complete")
                 break
             try:
+                # stamp from BEFORE the renewal request: rivals compute
+                # expiry from the renewTime written inside try_acquire, so
+                # a post-return stamp would let a stale holder act up to
+                # ~2×request_timeout past the takeover (ADVICE r4) —
+                # client-go's leaderelection does the same
+                t0 = time.time()
                 if self.try_acquire():
-                    deadline = time.time() + self.lease_duration
+                    deadline = t0 + self.lease_duration
                     continue
                 log.warning("lease stolen; stepping down")
                 break

@@ -416,7 +416,10 @@ class PlacementScheduler:
         if self.backend == "greedy":
             self.last_route = "greedy"
             _route_total.inc(engine="greedy")
-            return greedy_place(snapshot, batch)
+            # pins must ride along: tick() gathers incumbents for every
+            # backend now, and dropping them here would re-place running
+            # jobs wherever best-fit likes — mass preemption every tick
+            return greedy_place(snapshot, batch, incumbent=incumbent)
         # auto routing (VERDICT r3 #5): a solve below the device dispatch
         # floor — or any solve without an accelerator — goes to the indexed
         # native packer (greedy-parity quality, no dispatch round-trip).
@@ -438,10 +441,16 @@ class PlacementScheduler:
                 from slurm_bridge_tpu.solver.indexed_native import (
                     indexed_place_native,
                 )
+                from slurm_bridge_tpu.solver.routing import native_fit_policy
 
                 self.last_route = "native"
                 _route_total.inc(engine="native")
-                return indexed_place_native(snapshot, batch, incumbent=incumbent)
+                return indexed_place_native(
+                    snapshot,
+                    batch,
+                    incumbent=incumbent,
+                    policy=native_fit_policy(bool((incumbent >= 0).any())),
+                )
         p_real = batch.num_shards
         if self.bucket:
             batch = pad_batch(batch, self.bucket)

@@ -40,12 +40,17 @@ def indexed_place_native(
     *,
     best_fit: bool = True,
     incumbent=None,
+    policy: str | None = None,
 ) -> Placement:
     """Drop-in replacement for :func:`greedy.greedy_place`, index-accelerated.
 
-    First-fit parity (lowest node index that fits) cannot ride the
-    free-cpu-ordered index, so ``best_fit=False`` delegates to the baseline
-    native packer — the fast path is best-fit, the production default.
+    All three fit policies ride a treap: best-fit (the default) and
+    worst-fit on a (free_cpu, index) key with subtree maxima of the other
+    dims (worst-fit is the mirrored rightmost query), first-fit on the
+    node-index key with ALL dims augmented plus a cpu-keyed feasibility
+    twin. Worst-fit is the routed pin-free policy: the measured quality
+    winner at every BASELINE shape (45,239 jobs vs best-fit's 44,928 at
+    the 50k×10k headline) at best-fit speed (BASELINE.md round 5).
 
     ``incumbent`` ([P] int32, -1 = free agent) pins streaming incumbents to
     their held nodes (greedy.py semantics) — the CPU-fast engine for
@@ -58,28 +63,39 @@ def indexed_place_native(
 
     from slurm_bridge_tpu.solver.greedy_native import greedy_place_native
 
+    if policy is None:
+        policy = "best" if best_fit else "first"
+    mode = {"first": 0, "best": 1, "worst": 2}.get(policy)
+    if mode is None:
+        raise ValueError(f"unknown fit policy {policy!r}")
     pinned = incumbent is not None and bool((np.asarray(incumbent) >= 0).any())
 
     def _fallback() -> Placement:
         if pinned:
+            # greedy.cpp (the measured baseline) knows nothing of pins —
+            # pinned solves degrade to the pure-Python oracle (slow but
+            # semantically exact; streaming ticks are the rare case here)
             from slurm_bridge_tpu.solver.greedy import greedy_place
 
             return greedy_place(
-                snapshot, batch, best_fit=best_fit, incumbent=incumbent
+                snapshot, batch, incumbent=incumbent, policy=policy
             )
-        return greedy_place_native(snapshot, batch, best_fit=best_fit)
+        # pin-free worst-fit degrades to NATIVE best-fit, not the Python
+        # oracle: availability first — the router sends 50k×10k solves
+        # here, where the oracle takes minutes and the native packer tens
+        # of ms at −0.7% quality
+        return greedy_place_native(snapshot, batch, best_fit=policy != "first")
 
-    # the treap index supports 1..4 resource dims (cpu + up to 3 augmented);
-    # RESOURCE_DIMS ships 3 — an exotic wider snapshot takes the baseline,
-    # which handles any arity
-    if not best_fit or _build_failed or not 1 <= snapshot.free.shape[1] <= 4:
+    # the treap index supports 1..4 resource dims; RESOURCE_DIMS ships 3 —
+    # an exotic wider snapshot takes the baseline, which handles any arity
+    if _build_failed or not 1 <= snapshot.free.shape[1] <= 4:
         return _fallback()
     try:
         fn = load_symbol(
             _SRC,
             _LIB,
             "sbt_indexed_place",
-            place_argtypes(with_best_fit=False, with_pin=True),
+            place_argtypes(with_best_fit=True, with_pin=True),
         )
     except NativeBuildError as exc:
         # degrade, don't crash the tick: the native greedy places
@@ -88,5 +104,10 @@ def indexed_place_native(
         log.warning("%s — falling back to the native greedy packer", exc)
         return _fallback()
     return call_place(
-        fn, snapshot, batch, incumbent=incumbent if pinned else None, with_pin=True
+        fn,
+        snapshot,
+        batch,
+        best_fit=mode,
+        incumbent=incumbent if pinned else None,
+        with_pin=True,
     )

@@ -111,9 +111,10 @@ def ptr(a, ctype):
 
 
 def place_argtypes(*, with_best_fit: bool, with_pin: bool = False) -> list:
-    """The shared C ABI of both packers (greedy.cpp carries a best_fit
-    flag before the output pointer; indexed.cpp is best-fit only and
-    carries a nullable incumbent-pin array instead)."""
+    """The shared C ABI of both packers. greedy.cpp takes the best_fit
+    flag (0/1) before the output pointer; indexed.cpp takes BOTH the
+    fit-policy selector (0 = first, 1 = best, 2 = worst, same slot) AND a
+    nullable incumbent-pin array after it."""
     argtypes = [
         ctypes.c_int,  # n
         ctypes.c_int,  # r
@@ -177,7 +178,9 @@ def call_place(
         ptr(gang, ctypes.c_int32),
     ]
     if best_fit is not None:
-        args.append(1 if best_fit else 0)
+        # fit-policy selector, not a strict bool: 1 = best-fit, 0 =
+        # first-fit, 2 = worst-fit (indexed.cpp; greedy.cpp knows 0/1)
+        args.append(int(best_fit))
     if with_pin:
         if incumbent is None:
             args.append(None)

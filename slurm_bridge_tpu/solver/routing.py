@@ -52,6 +52,27 @@ def floor_cells() -> int:
     return val
 
 
+#: Fit policy for pin-free solves routed to the indexed native packer.
+#: Worst-fit (max free cpu) is the measured quality winner at every
+#: BASELINE shape — +0.7% placed jobs at the 50k×10k headline (45,239 vs
+#: best-fit's 44,928; the on-chip auction places 45,534) at equal-or-
+#: better latency, and never worse elsewhere (BASELINE.md round 5):
+#: spreading load preserves multi-dim balance where min-cpu packing
+#: strands memory. Pinned (streaming) ticks stay on best-fit — the
+#: tier-2 preemption machinery is defined for that policy.
+NATIVE_FIT_DEFAULT = "worst"
+
+
+def native_fit_policy(has_pins: bool = False) -> str:
+    """The fit policy the routed native engine should use."""
+    if has_pins:
+        return "best"
+    pol = os.environ.get("SBT_NATIVE_FIT", "") or NATIVE_FIT_DEFAULT
+    if pol not in ("best", "first", "worst"):
+        raise ValueError(f"SBT_NATIVE_FIT={pol!r}: want best|first|worst")
+    return pol
+
+
 #: Above this share of multi-node-gang shards the indexed native packer
 #: dominates the device auction on BOTH axes — measured at BASELINE
 #: scenario #4 (12k shards × 10k nodes, 89% gang shards): native 110.8 ms

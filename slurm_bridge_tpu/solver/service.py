@@ -81,6 +81,13 @@ class PlacementSolverServicer:
     ):
         if solver and solver not in SOLVERS:
             raise ValueError(f"unknown solver {solver!r}")
+        # fail fast on a malformed SBT_ROUTE_FLOOR_CELLS (ADVICE r4): the
+        # routing floor is read per auto-routed Place, and validating it
+        # only there would surface as UNKNOWN on every RPC instead of a
+        # refused startup — mirror PlacementScheduler's ingress check
+        from slurm_bridge_tpu.solver.routing import floor_cells
+
+        floor_cells()
         self.config = config or AuctionConfig()
         self.default_solver = solver
         #: shard-axis bucketing (scheduler.py semantics): a streaming queue
@@ -284,8 +291,14 @@ class PlacementSolverServicer:
             from slurm_bridge_tpu.solver.indexed_native import (
                 indexed_place_native,
             )
+            from slurm_bridge_tpu.solver.routing import native_fit_policy
 
-            return indexed_place_native(snapshot, batch, incumbent=incumbent)
+            return indexed_place_native(
+                snapshot,
+                batch,
+                incumbent=incumbent,
+                policy=native_fit_policy(bool((incumbent >= 0).any())),
+            )
         p_real = batch.num_shards
         if self.bucket:
             from slurm_bridge_tpu.solver.snapshot import pad_batch

@@ -115,9 +115,13 @@ def streaming_place(
     p_real = solve_batch.num_shards
     if engine == "native" and not sharded:
         from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+        from slurm_bridge_tpu.solver.routing import native_fit_policy
 
         placement = indexed_place_native(
-            snapshot, solve_batch, incumbent=incumbent
+            snapshot,
+            solve_batch,
+            incumbent=incumbent,
+            policy=native_fit_policy(bool(inc_mask.any())),
         )
         kept = inc_mask & placement.placed & (placement.node_of == incumbent)
         return TickResult(

@@ -87,29 +87,39 @@ def probe_once(timeout_s: float = 120.0) -> tuple[bool, str]:
 
 
 def record(ok: bool, detail: str, *, dir_override: str | None = None) -> dict:
-    """Append to the history log and rewrite the rolling summary."""
+    """Append to the history log and rewrite the rolling summary.
+
+    The read-modify-write of the summary is serialised with flock: the
+    watcher and a bench run write concurrently by design, and a lost
+    update here would drop a failed probe from ``consecutive_failures`` —
+    exactly the count the bench short-circuit keys off.
+    """
+    import fcntl
+
     d = diag_dir(dir_override)
     d.mkdir(parents=True, exist_ok=True)
     entry = {"ts": time.time(), "ok": bool(ok), "detail": detail}
-    with open(d / "chip_watch.jsonl", "a") as f:
-        f.write(json.dumps(entry) + "\n")
-    state = read_state(dir_override) or {"probes": []}
-    probes = (state.get("probes") or [])[-(_KEEP - 1):] + [entry]
-    fails = 0
-    for p in reversed(probes):
-        if p.get("ok"):
-            break
-        fails += 1
-    state = {
-        "probes": probes,
-        "consecutive_failures": fails,
-        "last_ok_ts": max(
-            (p["ts"] for p in probes if p.get("ok")), default=None
-        ),
-    }
-    tmp = d / "chip_state.json.tmp"
-    tmp.write_text(json.dumps(state, indent=1))
-    os.replace(tmp, d / "chip_state.json")
+    with open(d / "chip_state.lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        with open(d / "chip_watch.jsonl", "a") as f:
+            f.write(json.dumps(entry) + "\n")
+        state = read_state(dir_override) or {"probes": []}
+        probes = (state.get("probes") or [])[-(_KEEP - 1):] + [entry]
+        fails = 0
+        for p in reversed(probes):
+            if p.get("ok"):
+                break
+            fails += 1
+        state = {
+            "probes": probes,
+            "consecutive_failures": fails,
+            "last_ok_ts": max(
+                (p["ts"] for p in probes if p.get("ok")), default=None
+            ),
+        }
+        tmp = d / "chip_state.json.tmp"
+        tmp.write_text(json.dumps(state, indent=1))
+        os.replace(tmp, d / "chip_state.json")
     return state
 
 

@@ -108,3 +108,76 @@ def test_bench_wedged_backend_chain_still_emits(tmp_path):
     dumps = list(diag.glob("tpu_probe_bench_attempt*"))
     assert len(dumps) >= 2, f"expected per-attempt stack dumps, got {dumps}"
     assert "default_backend" in dumps[0].read_text(), "dump lacks the stuck frame"
+
+
+def test_bench_short_circuits_when_chip_known_dead(tmp_path):
+    """VERDICT r4 #3: with the watcher recording the chip dead, the bench
+    must spend ONE short probe (no re-exec retry ladder) before CPU —
+    and still emit its one line. SBT_BENCH_TPU_BUDGET stays the override."""
+    import time as _time
+
+    fake = tmp_path / "shadow"
+    fake.mkdir()
+    (fake / "jax.py").write_text(
+        "import time\n"
+        "class _Cfg:\n"
+        "    def update(self, *a, **k): pass\n"
+        "config = _Cfg()\n"
+        "def default_backend():\n"
+        "    time.sleep(3600)\n"
+        "def devices():\n"
+        "    return []\n"
+    )
+    diag = tmp_path / "diag"
+    diag.mkdir()
+    now = _time.time()
+    (diag / "chip_state.json").write_text(json.dumps({
+        "probes": [{"ts": now - 120, "ok": False, "detail": "wedged"},
+                   {"ts": now - 60, "ok": False, "detail": "wedged"}],
+        "consecutive_failures": 2,
+        "last_ok_ts": None,
+    }))
+    env = dict(
+        os.environ,
+        PYTHONPATH=str(fake),
+        SBT_BENCH_SHAPE="100,16",
+        SBT_BENCH_TPU_SHORT_BUDGET="3",
+        SBT_BENCH_DIAG_DIR=str(diag),
+    )
+    for k in ("SBT_BENCH_CPU", "SBT_BENCH_TPU_ATTEMPT", "SBT_BENCH_TPU_BUDGET",
+              "JAX_PLATFORMS"):
+        env.pop(k, None)
+    t0 = _time.monotonic()
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    elapsed = _time.monotonic() - t0
+    assert "chip watcher records the chip DEAD" in out.stderr
+    assert "attempt 1/1" in out.stderr          # retry ladder collapsed
+    assert "attempt 2" not in out.stderr
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1
+    assert elapsed < 90, f"short-circuit still took {elapsed:.0f}s"
+    # the wedge the bench just saw is ON the record for the next consumer
+    state = json.loads((diag / "chip_state.json").read_text())
+    assert state["consecutive_failures"] >= 3
+
+
+def test_chipstate_known_dead_rules(tmp_path):
+    """One failure isn't death; two are; stale verdicts expire; an OK
+    probe resets the count."""
+    from slurm_bridge_tpu.utils import chipstate
+
+    d = str(tmp_path)
+    st = chipstate.record(False, "x", dir_override=d)
+    assert not chipstate.chip_known_dead(st)
+    st = chipstate.record(False, "y", dir_override=d)
+    assert chipstate.chip_known_dead(st)
+    # stale: the newest probe is older than the evidence window
+    assert not chipstate.chip_known_dead(
+        st, now=st["probes"][-1]["ts"] + chipstate.STATE_MAX_AGE_S + 1
+    )
+    st = chipstate.record(True, "alive", dir_override=d)
+    assert st["consecutive_failures"] == 0
+    assert not chipstate.chip_known_dead(st)

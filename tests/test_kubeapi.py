@@ -596,3 +596,38 @@ def test_node_advertises_kubelet_endpoint(fake_slurm, tmp_path):
         bridge.stop()
         agent.stop(None)
         api.stop()
+
+
+def test_mirror_gc_reaps_stray_display_pods():
+    """ADVICE r4: a display pod left by a PREVIOUS bridge incarnation (its
+    store pod vanished while the bridge was down) must be reaped by the
+    periodic resync — DELETED store events only cover pods this
+    incarnation created. Foreign pods without our role label survive."""
+    api = _FakeApiServer([])
+    with api.lock:
+        api.pods["ghost-worker"] = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "ghost-worker",
+                         "labels": {"kubecluster.org/role": "worker"}},
+        }
+        api.pods["operator-owned"] = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "operator-owned"},
+        }
+
+    class _BridgeStub:
+        def __init__(self):
+            from slurm_bridge_tpu.bridge.store import ObjectStore
+
+            self.store = ObjectStore()
+
+    mirror = NodePodMirror(
+        _BridgeStub(), KubeConfig(base_url=api.url, token="test-token"),
+        resync=0.2,
+    ).start()
+    try:
+        assert _wait(lambda: "ghost-worker" not in api.pods)
+        assert "operator-owned" in api.pods
+    finally:
+        mirror.stop()
+        api.stop()

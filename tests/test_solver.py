@@ -823,3 +823,48 @@ def test_indexed_native_pinned_fallback_uses_oracle(monkeypatch):
     out = inat.indexed_place_native(snap, batch, incumbent=inc)
     py = greedy_place(snap, batch, incumbent=inc)
     assert np.array_equal(out.node_of, py.node_of)
+
+
+# ------------------------------------------- fit policies (round 5)
+
+
+@pytest.mark.parametrize("policy", ["best", "first", "worst"])
+@pytest.mark.parametrize("seed", [0, 3])
+def test_indexed_native_policies_match_python(policy, seed):
+    """All three fit policies are bit-exact against the oracle, with and
+    without incumbent pins."""
+    from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+
+    snap, batch, inc = _pinned_case(64, 400, seed=seed, load=0.9)
+    for pins in (None, inc):
+        py = greedy_place(snap, batch, incumbent=pins, policy=policy)
+        idx = indexed_place_native(snap, batch, incumbent=pins, policy=policy)
+        assert np.array_equal(py.node_of, idx.node_of), (policy, pins is None)
+        assert np.allclose(py.free_after, idx.free_after, atol=1e-3)
+
+
+def test_worst_fit_beats_best_fit_at_headline_like_shape():
+    """The reason worst-fit is the routed pin-free policy (routing.py
+    NATIVE_FIT_DEFAULT): it places at least as many jobs on every BASELINE
+    shape and strictly more on mixed gres workloads — min-cpu packing
+    strands memory on tight nodes; spreading preserves joint capacity."""
+    from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+
+    snap, batch = random_scenario(800, 5_000, seed=11, load=0.7,
+                                  gpu_fraction=0.15, gang_fraction=0.05)
+    best = indexed_place_native(snap, batch, policy="best")
+    worst = indexed_place_native(snap, batch, policy="worst")
+    assert len(worst.by_job(batch)) > len(best.by_job(batch))
+
+
+def test_native_fit_policy_selection(monkeypatch):
+    from slurm_bridge_tpu.solver.routing import native_fit_policy
+
+    assert native_fit_policy() == "worst"
+    assert native_fit_policy(has_pins=True) == "best"  # tier-2 is best-only
+    monkeypatch.setenv("SBT_NATIVE_FIT", "first")
+    assert native_fit_policy() == "first"
+    assert native_fit_policy(has_pins=True) == "best"
+    monkeypatch.setenv("SBT_NATIVE_FIT", "bogus")
+    with pytest.raises(ValueError, match="SBT_NATIVE_FIT"):
+        native_fit_policy()
