@@ -55,11 +55,19 @@ def start_observability(
     )
     if not getattr(args, "metrics_port", 0):
         return None
+    from slurm_bridge_tpu.obs.profiling import sample_profile
+
     httpd = REGISTRY.serve(
         args.metrics_port,
-        extra_routes={"/debug/tracez": lambda: ("text/plain", TRACER.render_tracez())},
+        extra_routes={
+            "/debug/tracez": lambda: ("text/plain", TRACER.render_tracez()),
+            # py-spy-style stack sampling (obs/profiling.py) — the
+            # reference's net/http/pprof side-effect import, rebuilt
+            "/debug/profilez": lambda: ("text/plain", sample_profile()),
+        },
         health_checks=health_checks or {"ping": lambda: None},
         ready_checks=ready_checks or {},
     )
-    log.info("%s: metrics/healthz/tracez on :%d", service, args.metrics_port)
+    log.info("%s: metrics/healthz/tracez/profilez on :%d",
+             service, args.metrics_port)
     return httpd

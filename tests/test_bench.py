@@ -11,6 +11,12 @@ import os
 import pathlib
 import subprocess
 import sys
+import pytest
+
+# Heavyweight suite: excluded from the <2-min fast lane (`pytest -m "not
+# slow"`, VERDICT r4 #7); hack/run-checks.sh always runs everything.
+pytestmark = pytest.mark.slow
+
 
 REPO = pathlib.Path(__file__).parent.parent
 

@@ -489,3 +489,44 @@ class TestKubeLeaseElection:
             a._stop.set()
             a._thread.join(2)
             srv.stop()  # idempotent; covers an early assert failure
+
+
+class TestProfilez:
+    def test_profilez_samples_live_threads(self, monkeypatch):
+        """/debug/profilez (obs/profiling.py): the py-spy-style sampler —
+        reference parity with the pprof side-effect import
+        (cmd/slurm-virtual-kubelet/app/options/options.go:30) — must catch
+        a busy thread's frames from a running server."""
+        import urllib.request
+
+        from slurm_bridge_tpu.obs.metrics import MetricsRegistry
+        from slurm_bridge_tpu.obs.profiling import sample_profile
+
+        monkeypatch.setenv("SBT_PROFILE_SECONDS", "0.3")
+        stop = threading.Event()
+
+        def busy_spinner_for_profilez():
+            while not stop.is_set():
+                sum(i * i for i in range(500))
+
+        t = threading.Thread(target=busy_spinner_for_profilez, daemon=True)
+        t.start()
+        registry = MetricsRegistry()
+        httpd = registry.serve(
+            0, host="127.0.0.1",
+            extra_routes={
+                "/debug/profilez": lambda: ("text/plain", sample_profile()),
+            },
+        )
+        port = httpd.server_address[1]
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/profilez", timeout=10
+            ) as r:
+                body = r.read().decode()
+            assert r.status == 200
+            assert "samples over" in body
+            assert "busy_spinner_for_profilez" in body, body[:800]
+        finally:
+            stop.set()
+            httpd.shutdown()

@@ -93,6 +93,7 @@ def test_bid_argmax_with_affinity():
     np.testing.assert_allclose(np.asarray(bv)[feas], ref_v[feas], atol=1e-5)
 
 
+@pytest.mark.slow
 def test_auction_pallas_path_matches_jnp_path():
     """Full solve, both paths: identical assignments end to end."""
     snap, batch = random_scenario(200, 800, seed=17, load=0.7,

@@ -12,6 +12,12 @@ from hypothesis import strategies as st
 from slurm_bridge_tpu.core.arrays import array_len, parse_array_spec
 from slurm_bridge_tpu.core.durations import format_duration, parse_duration
 from slurm_bridge_tpu.core.hostlist import compress_hostlist, expand_hostlist
+import pytest
+
+# Heavyweight suite: excluded from the <2-min fast lane (`pytest -m "not
+# slow"`, VERDICT r4 #7); hack/run-checks.sh always runs everything.
+pytestmark = pytest.mark.slow
+
 
 # ---------------------------------------------------------------- durations
 

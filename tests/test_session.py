@@ -1,6 +1,7 @@
 """DeviceSolver must be a drop-in for auction_place, minus the transfers."""
 
 import numpy as np
+import pytest
 
 from slurm_bridge_tpu.solver import AuctionConfig, auction_place
 from slurm_bridge_tpu.solver.session import DeviceSolver
@@ -10,6 +11,7 @@ from tests.test_solver import _check_feasible
 CFG = AuctionConfig(rounds=6)
 
 
+@pytest.mark.slow
 def test_matches_auction_place():
     snap, batch = random_scenario(64, 300, seed=1, load=0.7, gang_fraction=0.1)
     a = auction_place(snap, batch, CFG)

@@ -49,6 +49,7 @@ def _placed_count(placement):
 # ---------------------------------------------------------------- encoders
 
 
+@pytest.mark.slow
 def test_gang_salvage_and_gang_first_quality():
     """On a gang-heavy overloaded cluster the tuned config must land
     within 3% of the sequential greedy packer (untuned it trailed ~11%),
@@ -565,6 +566,7 @@ def test_gang_ids_arbitrary_values():
     _check_feasible(snap, batch, a)
 
 
+@pytest.mark.slow
 def test_segmented_cumsum_precision():
     """Large magnitudes must not leak across segments (float32 cumsum-minus-
     base at 50k-shard scale would be off by tens of units)."""
@@ -593,6 +595,7 @@ def test_sampled_auction_feasible(seed):
     _check_feasible(snap, batch, pl)
 
 
+@pytest.mark.slow
 def test_sampled_auction_quality_parity():
     """Sampling K=64 of 512 nodes must land within 3% of the full argmax —
     the bid is jitter-dominated, so the full argmax is itself an essentially

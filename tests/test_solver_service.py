@@ -301,6 +301,7 @@ def test_servicer_rejects_bad_default():
         PlacementSolverServicer(solver="nope")
 
 
+@pytest.mark.slow
 def test_bridge_survives_solver_sidecar_restart(tmp_path, monkeypatch):
     """Chaos: the sidecar dies mid-flight — the bridge fails OPEN (pods
     stay Pending, no false Unschedulable verdicts, no preemptions, no
@@ -339,6 +340,7 @@ def test_bridge_survives_solver_sidecar_restart(tmp_path, monkeypatch):
             solver2.stop(None)
 
 
+@pytest.mark.slow
 def test_place_request_config_overrides_sidecar_default():
     """ADVICE r3 (medium): the bridge's AuctionConfig rides PlaceRequest —
     the sidecar must solve with the caller's knobs, not its launch-time

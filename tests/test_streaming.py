@@ -132,6 +132,7 @@ def test_incumbent_on_drained_node_is_preempted():
     assert bool(res.preempted[0])  # cannot migrate to node 1
 
 
+@pytest.mark.slow
 def test_bucket_padding_changes_nothing():
     """Padding the shard axis to the compile bucket must not change any
     real shard's outcome (padded rows target an impossible partition)."""
