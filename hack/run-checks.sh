@@ -21,33 +21,4 @@ jax.jit(fn)(*args)
 g.dryrun_multichip(8)
 print("graft contracts OK")
 EOF
-# hygiene: generated artifacts must match their sources (no-diff check,
-# mirroring the reference's test-go.yml workflow). Regenerates into a temp
-# dir and compares — never mutates the working tree, and names the
-# toolchain in the error so a protoc/python version skew isn't mistaken
-# for real drift.
-tmp=$(mktemp -d)
-trap 'rm -rf "$tmp"' EXIT
-# skip (not fail) on protoc version skew — a different toolchain produces
-# cosmetic diffs that are not real drift (same guard as the apidoc check)
-stamp=$(cat slurm_bridge_tpu/wire/.protoc-version 2>/dev/null || echo unknown)
-if [ "$(protoc --version)" = "$stamp" ]; then
-  protoc --proto_path=slurm_bridge_tpu/wire --python_out="$tmp" \
-    slurm_bridge_tpu/wire/workload.proto
-  cmp -s "$tmp/workload_pb2.py" slurm_bridge_tpu/wire/workload_pb2.py || {
-    echo "workload_pb2.py out of sync with workload.proto — run hack/regen-proto.sh"
-    exit 1
-  }
-else
-  echo "# pb2 generated by '$stamp', local protoc is '$(protoc --version)' — skipping compare"
-fi
-pyver=$(python -c 'import sys; print(f"{sys.version_info.major}.{sys.version_info.minor}")')
-if head -1 docs/api.md | grep -q "on python $pyver "; then
-  JAX_PLATFORMS=cpu python hack/gen_apidoc.py > "$tmp/api.md"
-  cmp -s "$tmp/api.md" docs/api.md || {
-    echo "docs/api.md stale — run hack/generate-apidoc.sh"; exit 1
-  }
-else
-  echo "# docs/api.md generated under a different python minor — skipping compare"
-fi
-echo "hygiene OK"
+exec "$(dirname "$0")/check-hygiene.sh"

@@ -374,6 +374,72 @@ int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
     if (ff) bk.root2 = forest2->insert(bk.root2, nd, row);
   };
 
+  // Tier-2 failure certificates — an EXACT scan-skipping cache. The
+  // potential capacity a tier-2 eviction can reach (free + alive
+  // uncommitted reservations) is non-increasing across the solve: every
+  // state transition either lowers it (placements, commits) or moves
+  // value between the two terms (evict/release add to free what they
+  // subtract from rsum), and a failed gang's rollback restores exactly
+  // its start state. Likewise the set of strictly-lower-priority
+  // reservations only shrinks. So once a FULL scan fails for demand d at
+  // priority p (recorded only from single-shard gangs — no tentative
+  // mid-gang state), any later shard with demand >= d per dim and
+  // priority <= p must fail too and its O(n) scan can be skipped.
+  // Two events break that monotonicity by converting priority-GATED
+  // capacity into ungated free capacity — applying an eviction and
+  // releasing a failed gang's reservations (a shard whose priority was
+  // too low to count that reservation can use it once it lands in free) —
+  // so the cache is cleared whenever either occurs; both are rare.
+  // Placements are bit-identical with the cache on or off; without it the
+  // steady-state backlog (thousands of unplaceable low-priority jobs
+  // re-tried every streaming tick) pays ~n*r work per job per tick.
+  struct FailCert {
+    float dem[kMaxAug];
+    float prio;
+    int32_t part;   // recorder's partition constraint (-1 = any)
+    uint32_t feat;  // recorder's required-feature mask
+  };
+  std::vector<FailCert> certs;
+  // a cert covers a shard only when the shard's feasible-node domain is a
+  // SUBSET of the recorder's: same-or-narrower partition (a -1 recorder
+  // scanned everything) and a feature mask that contains the recorder's
+  auto cert_covers = [&](const float* d, float prio_s, int32_t jp,
+                         uint32_t rf) {
+    for (const FailCert& c : certs) {
+      if (prio_s > c.prio) continue;
+      if (c.part >= 0 && jp != c.part) continue;
+      if ((rf & c.feat) != c.feat) continue;
+      bool dom = true;
+      for (int k = 0; dom && k < r; ++k) dom = d[k] >= c.dem[k];
+      if (dom) return true;
+    }
+    return false;
+  };
+  auto cert_record = [&](const float* d, float prio_s, int32_t jp,
+                         uint32_t rf) {
+    // keep a Pareto front per constraint class: smaller demand + higher
+    // priority + wider domain = stronger
+    for (size_t i = certs.size(); i-- > 0;) {
+      const FailCert& c = certs[i];
+      bool newer_stronger =
+          prio_s >= c.prio && (jp < 0 || jp == c.part) &&
+          (c.feat & rf) == rf;
+      for (int k = 0; newer_stronger && k < r; ++k)
+        newer_stronger = d[k] <= c.dem[k];
+      if (newer_stronger) {
+        certs[i] = certs.back();
+        certs.pop_back();
+      }
+    }
+    if (certs.size() >= 64) return;
+    FailCert c;
+    for (int k = 0; k < r; ++k) c.dem[k] = d[k];
+    c.prio = prio_s;
+    c.part = jp;
+    c.feat = rf;
+    certs.push_back(c);
+  };
+
   // multi-shard gang bookkeeping: a chosen node is ERASED from its treap
   // (enforcing the distinct-node rule by construction) and the pre-gang
   // free row is logged so a failed gang restores matrix + index exactly
@@ -469,7 +535,8 @@ int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
             best_node = cand;
           }
         }
-        if (best_fit == 1 && best_node == kNil && reserved_alive > 0) {
+        if (best_fit == 1 && best_node == kNil && reserved_alive > 0 &&
+            !cert_covers(d, prio[s], jp, rf)) {
           // tier-2, preempt-only-when-necessary: the node with the least
           // potential capacity (own free + strictly-lower-priority
           // uncommitted reservations, never this gang's own) that fits
@@ -531,6 +598,7 @@ int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
               rsum_add(best_node, de, -1.f);
               --reserved_alive;
               evicted_this.push_back(e);
+              certs.clear();  // gated capacity became free capacity
             }
             for (int k = 0; k < r; ++k) f[k] -= d[k];
             if (!multi) reindex(best_node);
@@ -538,6 +606,7 @@ int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
             chosen_node.push_back(best_node);
             continue;  // placement fully applied above
           }
+          if (!multi) cert_record(d, prio_s, jp, rf);  // full scan failed
         }
       }
       if (best_node == kNil) {
@@ -606,6 +675,7 @@ int sbt_indexed_place(int n, int r, float* free_io, const int32_t* node_part,
           rsum_add(pn, d, -1.f);
           --reserved_alive;
           reindex(pn);
+          certs.clear();  // gated capacity became free capacity
         }
       }
     }

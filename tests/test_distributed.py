@@ -7,10 +7,6 @@ import pytest
 from slurm_bridge_tpu.parallel import distributed as dist
 from slurm_bridge_tpu.parallel.mesh import solver_mesh
 
-# Heavyweight suite: excluded from the <2-min fast lane (`pytest -m "not
-# slow"`, VERDICT r4 #7); hack/run-checks.sh always runs everything.
-pytestmark = pytest.mark.slow
-
 
 
 def test_slurm_process_env(monkeypatch):
@@ -64,6 +60,7 @@ def test_hybrid_mesh_single_process():
     assert mesh.shape == ref.shape
 
 
+@pytest.mark.slow
 def test_hybrid_mesh_runs_sharded_solve():
     from slurm_bridge_tpu.solver import AuctionConfig
     from slurm_bridge_tpu.solver.sharded import sharded_place
@@ -79,6 +76,7 @@ def test_hybrid_mesh_runs_sharded_solve():
     _check_feasible(snap, batch, placement)
 
 
+@pytest.mark.slow
 def test_sharded_quality_parity_at_scale():
     """VERDICT r2 #8: exercise the sharded kernel's collective pattern at a
     size where the replicated O(P) admission and the two per-round
@@ -108,6 +106,7 @@ def test_sharded_quality_parity_at_scale():
     assert n_sharded >= 0.98 * n_single, (n_sharded, n_single)
 
 
+@pytest.mark.slow
 def test_scheduler_product_path_sharded(tmp_path, monkeypatch):
     """VERDICT r2 #4: the PlacementScheduler itself driving sharded_place —
     the multi-device path reachable from the product control plane, not
@@ -217,6 +216,7 @@ def test_scheduler_auto_routes_native_vs_auction():
     assert pinned.last_route in ("auction", "auction-sharded")
 
 
+@pytest.mark.slow
 def test_sharded_pallas_block_path_matches_jnp():
     """The sharded kernel's per-block pallas score/choose (used on TPU)
     must place identically to its jnp block path: the kernel receives the
@@ -234,6 +234,7 @@ def test_sharded_pallas_block_path_matches_jnp():
     np.testing.assert_array_equal(jnp_path.node_of, pallas_path.node_of)
 
 
+@pytest.mark.slow
 def test_multiprocess_distributed_sharded_solve(tmp_path):
     """REAL multi-host evidence: two OS processes, four CPU devices each,
     joined by jax.distributed into one 8-device global mesh — the sharded

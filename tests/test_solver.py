@@ -871,3 +871,32 @@ def test_native_fit_policy_selection(monkeypatch):
     monkeypatch.setenv("SBT_NATIVE_FIT", "bogus")
     with pytest.raises(ValueError, match="SBT_NATIVE_FIT"):
         native_fit_policy()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_pinned_parity_with_mixed_partitions_and_features(seed):
+    """Regression for the tier-2 failure-certificate cache: a cert recorded
+    by a shard in one (partition, feature) domain must not cover shards
+    whose feasible-node domain differs — the first cut skipped scans for
+    OTHER partitions and silently unplaced jobs the oracle places. High
+    partition/feature diversity + tight load makes that path hot."""
+    from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+
+    rng = np.random.default_rng(seed + 7)
+    snap, batch = random_scenario(100, 800, seed=seed, load=0.95,
+                                  gpu_fraction=0.2, gang_fraction=0.12)
+    base = indexed_place_native(snap, batch)
+    inc = np.where((rng.random(batch.num_shards) < 0.7) & base.placed,
+                   base.node_of, -1).astype(np.int32)
+    from slurm_bridge_tpu.solver.snapshot import JobBatch
+
+    shuffled = JobBatch(
+        demand=batch.demand, partition_of=batch.partition_of,
+        req_features=batch.req_features,
+        priority=rng.permutation(batch.priority),
+        gang_id=batch.gang_id, job_of=batch.job_of,
+    )
+    py = greedy_place(snap, shuffled, incumbent=inc)
+    idx = indexed_place_native(snap, shuffled, incumbent=inc)
+    assert np.array_equal(py.node_of, idx.node_of)
+    assert np.allclose(py.free_after, idx.free_after, atol=1e-3)
