@@ -430,12 +430,14 @@ class PlacementScheduler:
             from slurm_bridge_tpu.solver.routing import (
                 choose_path,
                 gang_shard_fraction,
+                incumbent_fraction,
             )
 
             route = choose_path(
                 batch.num_shards,
                 snapshot.num_nodes,
                 gang_fraction=gang_shard_fraction(batch.gang_id),
+                inc_fraction=incumbent_fraction(incumbent),
             )
             if route == "native":
                 from slurm_bridge_tpu.solver.indexed_native import (

@@ -99,6 +99,27 @@ def gang_shard_fraction(gang_id) -> float:
     return float((counts[norm] > 1).mean())
 
 
+#: Above this share of incumbent-pinned shards a tick is steady-state
+#: rescheduling, where the native packer beats the on-chip auction on BOTH
+#: axes (round 5, BASELINE.md scenario #5: 60.9 ms/tick at stability
+#: 0.9978 on one CPU core vs the round-3 on-chip auction's 218.0 ms at
+#: 0.985) — reservations + preempt-only-when-necessary keep placements
+#: still, and certificates make the backlog cheap, while the auction
+#: re-fights contention every tick and pays the device round-trip.
+#: Mostly-pending ticks keep the auction's placement-quality edge.
+INCUMBENT_DOMINANCE = 0.5
+
+
+def incumbent_fraction(incumbent) -> float:
+    """Share of shards pinned to a node they already hold. O(P) host work."""
+    import numpy as np
+
+    inc = np.asarray(incumbent)
+    if inc.size == 0:
+        return 0.0
+    return float((inc >= 0).mean())
+
+
 #: Below this many P×N cells a multi-device shard_map sweep can't amortise
 #: its collectives — the sharded auto-select floor (scheduler and sidecar
 #: share this one rule so the two deployment modes route identically).
@@ -121,6 +142,7 @@ def choose_path(
     *,
     backend_name: str | None = None,
     gang_fraction: float = 0.0,
+    inc_fraction: float = 0.0,
 ) -> str:
     """Return ``"native"`` or ``"device"`` for a solve of this shape.
 
@@ -129,7 +151,10 @@ def choose_path(
     a wedged accelerator resolves to ``"cpu"``, which routes native).
     ``gang_fraction`` is the share of multi-node-gang shards
     (:func:`gang_shard_fraction`) — gang-dominated batches route native
-    regardless of size (see ``GANG_DOMINANCE``).
+    regardless of size (``GANG_DOMINANCE``). ``inc_fraction`` is the share
+    of incumbent-pinned shards (:func:`incumbent_fraction`) —
+    incumbent-dominated (steady-state) ticks route native regardless of
+    backend (``INCUMBENT_DOMINANCE``).
     """
     if backend_name is None:
         from slurm_bridge_tpu.parallel.backend import ensure_backend
@@ -138,5 +163,7 @@ def choose_path(
     if backend_name == "cpu":
         return "native"
     if gang_fraction > GANG_DOMINANCE:
+        return "native"
+    if inc_fraction > INCUMBENT_DOMINANCE:
         return "native"
     return "device" if num_shards * num_nodes >= floor_cells() else "native"

@@ -130,7 +130,7 @@ class PlacementSolverServicer:
         batch, incumbent = self._encode(request.jobs, snapshot)
         if not solver:
             solver = self._auto_route(
-                snapshot, batch,
+                snapshot, batch, incumbent,
                 allow_indexed=requested == "auto",
             )
 
@@ -244,17 +244,21 @@ class PlacementSolverServicer:
         )
         return batch, np.asarray(rows_inc, dtype=np.int32)
 
-    def _auto_route(self, snapshot, batch, *, allow_indexed: bool) -> str:
+    def _auto_route(
+        self, snapshot, batch, incumbent, *, allow_indexed: bool
+    ) -> str:
         """The same routing rules the in-process scheduler applies
         (solver/routing.py — one shared module, so the two deployment
         modes cannot drift): with ``allow_indexed`` (the caller sent
-        "auto"), small or gang-dominated batches run the native packer
-        (which honours incumbent pins since round 5); otherwise the device
-        family, sharded only when the mesh AND the solve size warrant it."""
+        "auto"), small, gang-dominated, or incumbent-dominated batches run
+        the native packer (which honours incumbent pins since round 5);
+        otherwise the device family, sharded only when the mesh AND the
+        solve size warrant it."""
         from slurm_bridge_tpu.parallel.backend import ensure_backend
         from slurm_bridge_tpu.solver.routing import (
             choose_path,
             gang_shard_fraction,
+            incumbent_fraction,
             use_sharded,
         )
 
@@ -264,6 +268,7 @@ class PlacementSolverServicer:
             snapshot.num_nodes,
             backend_name=backend,
             gang_fraction=gang_shard_fraction(batch.gang_id),
+            inc_fraction=incumbent_fraction(incumbent),
         ) == "native":
             return "indexed"
         import jax

@@ -249,12 +249,14 @@ class StreamingSim:
             from slurm_bridge_tpu.solver.routing import (
                 choose_path,
                 gang_shard_fraction,
+                incumbent_fraction,
             )
 
             route = choose_path(
                 self.batch.num_shards,
                 self.snapshot.num_nodes,
                 gang_fraction=gang_shard_fraction(self.batch.gang_id),
+                inc_fraction=incumbent_fraction(self.assign),
             )
             engine = "native" if route == "native" and not self.sharded else "device"
         if engine != "native" and not self.sharded:

@@ -900,3 +900,19 @@ def test_pinned_parity_with_mixed_partitions_and_features(seed):
     idx = indexed_place_native(snap, shuffled, incumbent=inc)
     assert np.array_equal(py.node_of, idx.node_of)
     assert np.allclose(py.free_after, idx.free_after, atol=1e-3)
+
+
+def test_choose_path_incumbent_dominance():
+    """Round 5: incumbent-dominated (steady-state) ticks route native even
+    with an accelerator up — the packer beats the on-chip auction on both
+    latency and stability there (BASELINE.md scenario #5); mostly-pending
+    ticks keep the auction's quality edge."""
+    from slurm_bridge_tpu.solver.routing import choose_path, incumbent_fraction
+
+    assert choose_path(50_000, 10_000, backend_name="tpu",
+                       inc_fraction=0.98) == "native"
+    assert choose_path(50_000, 10_000, backend_name="tpu",
+                       inc_fraction=0.2) == "device"
+    inc = np.array([3, -1, 7, 2], np.int32)
+    assert incumbent_fraction(inc) == 0.75
+    assert incumbent_fraction(np.zeros(0, np.int32)) == 0.0
