@@ -1,0 +1,58 @@
+# Dev entry points — reference-parity surface for its kubebuilder Makefile
+# (/root/reference/Makefile): same verbs, rebuild-native commands. Every
+# target shells to the scripts CI runs, so `make test` here and the
+# workflows can never drift.
+
+.PHONY: help test fast check generate apidoc hygiene bench scenarios \
+        docker-build install uninstall deploy undeploy run demo
+
+help: ## Display this help.
+	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ \
+	  {printf "  \033[36m%-14s\033[0m %s\n", $$1, $$2}' $(MAKEFILE_LIST)
+
+test: ## Full suite + graft compile contracts + hygiene (ref: make test).
+	hack/run-checks.sh
+
+fast: ## ~2-min signal: everything not marked slow.
+	python -m pytest tests/ -q -m "not slow"
+
+check: test ## Alias the reference's CI verb.
+
+generate: ## Regenerate protobuf bindings + API docs (ref: make generate).
+	hack/regen-proto.sh
+	hack/generate-apidoc.sh
+
+apidoc: ## Regenerate docs/api.md only (ref: make apidoc).
+	hack/generate-apidoc.sh
+
+hygiene: ## No-diff gate over generated artifacts (ref: test-go.yml).
+	hack/check-hygiene.sh
+
+bench: ## The driver-contract headline benchmark (one JSON line).
+	python bench.py
+
+scenarios: ## The five BASELINE scenarios.
+	python -m benchmarks.scenarios --json
+
+docker-build: ## Build the four component images (ref: make docker-build).
+	for img in agent bridge result-fetcher solver; do \
+	  docker build -f build/$$img/Dockerfile -t slurm-bridge-tpu-$$img:latest . \
+	  || exit 1; done
+
+install: ## Install CRDs into the current kube context (ref: make install).
+	kubectl apply -k manifests/crd
+
+uninstall: ## Remove CRDs (ref: make uninstall).
+	kubectl delete -k manifests/crd
+
+deploy: ## Deploy the full stack (ref: make deploy).
+	kubectl apply -k manifests/default
+
+undeploy: ## Tear the stack down (ref: make undeploy).
+	kubectl delete -k manifests/default
+
+run: ## Run the bridge locally against the current kube context (ref: make run).
+	python -m slurm_bridge_tpu.bridge.main
+
+demo: ## End-to-end walkthrough against the bundled fake Slurm.
+	python -m slurm_bridge_tpu.bridge.demo
