@@ -383,3 +383,30 @@ def test_native_engine_matches_oracle_through_streaming():
     res = streaming_place(snap, batch, inc, engine="native")
     oracle = greedy_place(snap, batch, incumbent=inc)
     np.testing.assert_array_equal(res.placement.node_of, oracle.node_of)
+
+
+@pytest.mark.slow
+def test_native_engine_soak_no_drift():
+    """30 ticks of churn must not degrade: the failure-certificate cache,
+    id growth past P, and fragmentation all accumulate tick over tick —
+    latency may settle but not diverge, and stability stays in spec
+    (100-tick production-shape soak recorded in BASELINE.md round 5)."""
+    import time
+
+    sim = churn_scenario(num_nodes=1000, num_jobs=5000, seed=19, load=0.7)
+    sim.engine = "native"  # pin the engine under soak — "auto" could hand
+    sim.tick()             # early ticks to the device auction on a chip host
+    rng = np.random.default_rng(6)
+    times, stabs = [], []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        res = churn_step(sim, rng, churn_jobs=100)
+        times.append(time.perf_counter() - t0)
+        stabs.append(res.stability)
+    assert min(stabs) >= 0.985, f"stability degraded: {min(stabs)}"
+    early = float(np.median(times[:10]))
+    late = float(np.median(times[-10:]))
+    assert late < max(2.5 * early, early + 0.05), (
+        f"tick latency diverging: early p50 {early*1e3:.1f} ms, "
+        f"late p50 {late*1e3:.1f} ms"
+    )
