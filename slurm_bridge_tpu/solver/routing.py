@@ -12,13 +12,16 @@ The framework has three placement engines with different cost envelopes:
 - the **sharded shard_map path** (:mod:`sharded`): the auction kernel over
   a device mesh, for solves big enough to amortise the collectives.
 
-Routing rule (VERDICT r3 #5): a solve below the dispatch floor — or any
-solve when no accelerator is present — goes to the indexed native packer;
+Routing rule (VERDICT r3 #5, extended in rounds 4-5): a solve below the
+dispatch floor, any solve when no accelerator is present, and any gang-
+or incumbent-dominated batch goes to the indexed native packer;
 everything else goes to the device kernel (which further auto-selects
 single-device vs sharded, scheduler._use_sharded). On a 1-core CPU-only
-host the native path solves the 50k×10k headline in ~125 ms vs the JAX-CPU
-auction's ~480 ms, at exact greedy-baseline quality; on the chip the
-auction keeps its quality edge where it is actually faster.
+host the native path solves the 50k×10k headline in ~45 ms at worst-fit
+quality ABOVE the greedy baseline (45,239 vs 44,928 — BASELINE.md round
+5) vs the JAX-CPU auction's ~480 ms; on the chip the auction keeps its
+quality edge for pending-heavy mixed workloads, where it is the only
+engine that beats greedy by the full +1.3%.
 
 The reference has no counterpart — its placement is one kube-scheduler
 decision per pod (SURVEY.md §6); routing exists because the rebuild offers
