@@ -33,12 +33,14 @@ class Configurator:
         events: EventRecorder | None = None,
         watch_interval: float = DEFAULT_WATCH_INTERVAL_S,
         node_sync_interval: float = 1.0,
+        pod_sync_workers: int = 10,
     ):
         self.store = store
         self.client = client
         self.agent_endpoint = agent_endpoint
         self.events = events or EventRecorder()
         self.node_sync_interval = node_sync_interval
+        self.pod_sync_workers = pod_sync_workers
         self.providers: dict[str, VirtualNodeProvider] = {}
         self._tickers: dict[str, Ticker] = {}
         self._watch = Ticker(watch_interval, self.reconcile, name="configurator")
@@ -72,6 +74,7 @@ class Configurator:
             partition,
             agent_endpoint=self.agent_endpoint,
             events=self.events,
+            sync_workers=self.pod_sync_workers,
         )
         provider.register()
         self.providers[partition] = provider

@@ -43,6 +43,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--threads", type=int, default=2,
                         help="operator reconciler workers (--slurm-bridge-operator-threads)")
     parser.add_argument("--configurator-interval", type=float, default=30.0)
+    parser.add_argument("--pod-sync-workers", type=int, default=10,
+                        help="parallel pod converges per virtual-node sync "
+                             "tick (the reference's --pod-sync-workers, "
+                             "DefaultPodSyncWorkers=10)")
     parser.add_argument("--leader-lock", default="",
                         help="lease file enabling leader election; empty = no election")
     parser.add_argument("--leader-lease", default="",
@@ -96,6 +100,7 @@ def main(argv: list[str] | None = None) -> int:
         state_file=args.state_file,
         configurator_interval=args.configurator_interval,
         operator_workers=args.threads,
+        pod_sync_workers=args.pod_sync_workers,
         kubelet_port=None if kubelet_port < 0 else kubelet_port,
         kubelet_address=(vncfg.address if vncfg else "0.0.0.0"),
         kubelet_tls_cert=(vncfg.tls_cert_file if vncfg else ""),

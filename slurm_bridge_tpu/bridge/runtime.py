@@ -53,6 +53,7 @@ class Bridge:
         configurator_interval: float = 30.0,
         node_sync_interval: float = 0.25,
         operator_workers: int = 2,
+        pod_sync_workers: int = 10,
         kubelet_port: int | None = None,
         kubelet_address: str = "127.0.0.1",
         kubelet_tls_cert: str = "",
@@ -88,6 +89,7 @@ class Bridge:
             events=self.events,
             watch_interval=configurator_interval,
             node_sync_interval=node_sync_interval,
+            pod_sync_workers=pod_sync_workers,
         )
         self.scheduler = PlacementScheduler(
             self.store,

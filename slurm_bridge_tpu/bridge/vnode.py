@@ -71,6 +71,7 @@ class VirtualNodeProvider:
         agent_endpoint: str = "",
         events: EventRecorder | None = None,
         inventory_ttl: float = 5.0,
+        sync_workers: int = 10,
     ):
         self.store = store
         self.client = client
@@ -79,6 +80,14 @@ class VirtualNodeProvider:
         self.agent_endpoint = agent_endpoint
         self.events = events or EventRecorder()
         self.inventory_ttl = inventory_ttl
+        #: parallel pod converges per sync tick — the reference's
+        #: PodSyncWorkers (DefaultPodSyncWorkers = 10,
+        #: cmd/slurm-virtual-kubelet/app/options/options.go:107): each
+        #: pod submit is a blocking sbatch exec through the agent, and a
+        #: cold-start bind of thousands of pods serialised behind one
+        #: thread (measured 63.6 s for 5k pods on one core, round 5)
+        self.sync_workers = max(1, sync_workers)
+        self._pool = None  # lazily-built, reused across sync ticks
         self._inv_lock = threading.Lock()
         self._inv: tuple[float, PartitionInfo, list[NodeInfo]] | None = None
 
@@ -174,6 +183,9 @@ class VirtualNodeProvider:
         return self.store.mutate(VirtualNode.KIND, self.node_name, refresh)
 
     def deregister(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
         try:
             self.store.delete(VirtualNode.KIND, self.node_name)
         except NotFound:
@@ -183,17 +195,36 @@ class VirtualNodeProvider:
 
     def sync(self) -> None:
         """One provider tick: refresh the node, then converge every bound
-        pod (the PodSyncWorkers resync, virtual-kubelet.go:298-310)."""
+        pod (the PodSyncWorkers resync, virtual-kubelet.go:298-310) —
+        in parallel across ``sync_workers`` threads, since each converge
+        can block on an agent RPC (submit = one sbatch exec)."""
         self.register()
-        for pod in self.store.list(Pod.KIND):
-            if pod.spec.node_name != self.node_name:
-                continue
-            try:
-                self.sync_pod(pod)
-            except NotFound:
-                continue  # pod deleted mid-sync
-            except Exception:
-                log.exception("sync pod %s failed", pod.name)
+        pods = [
+            p for p in self.store.list(Pod.KIND)
+            if p.spec.node_name == self.node_name
+        ]
+        if len(pods) <= 1 or self.sync_workers == 1:
+            for pod in pods:
+                self._sync_pod_safe(pod)
+            return
+        if self._pool is None:
+            # built once, reused: sync runs every ~250 ms in steady state
+            # and a per-tick pool would churn thread create/teardown
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.sync_workers,
+                thread_name_prefix=f"podsync-{self.partition}",
+            )
+        list(self._pool.map(self._sync_pod_safe, pods))
+
+    def _sync_pod_safe(self, pod: Pod) -> None:
+        try:
+            self.sync_pod(pod)
+        except NotFound:
+            pass  # pod deleted mid-sync
+        except Exception:
+            log.exception("sync pod %s failed", pod.name)
 
     def sync_pod(self, pod: Pod) -> None:
         if pod.meta.deleted:

@@ -104,7 +104,42 @@ def ensure_backend(probe_timeout: float = 60.0) -> str:
             _decided = jax.default_backend()
             return _decided
 
+        # The availability watcher's verdict short-circuits the probe: a
+        # chip on record as dead (≥2 recent consecutive failures,
+        # utils/chipstate.py) would otherwise burn the FULL probe budget
+        # inside whatever called us first — measured in round 5 as a 60 s
+        # stall inside the first scheduler tick of a cold bridge. The
+        # state is advisory and ages out, so a revived chip is re-probed
+        # within the staleness window; SBT_BACKEND still overrides both.
+        try:
+            from slurm_bridge_tpu.utils import chipstate
+        except Exception:  # noqa: BLE001 — state is advisory
+            chipstate = None
+        if chipstate is not None:
+            try:
+                if chipstate.chip_known_dead():
+                    log.warning(
+                        "chip watcher records the accelerator DEAD — "
+                        "pinning CPU without probing (SBT_BACKEND overrides)"
+                    )
+                    _force_cpu()
+                    _decided = "cpu"
+                    return _decided
+            except Exception:  # noqa: BLE001
+                pass
+
         name = _probe_subprocess(probe_timeout)
+        if chipstate is not None and name and name != "cpu":
+            # record SUCCESS only: it resets the failure count when the
+            # chip revives. Failures stay the watcher's call — this
+            # probe's '' is ambiguous (spawn error, broken venv, 60 s of
+            # host load), and two such non-chip misses within the
+            # staleness window would falsely certify the chip dead for
+            # every consumer of the shared state.
+            try:
+                chipstate.record(True, f"backend probe acquired {name}")
+            except Exception:  # noqa: BLE001
+                pass
         if name:
             _decided = name
             return _decided

@@ -51,11 +51,24 @@ print(f"probe ok: {len(devs)}x {devs[0].device_kind} matmul={s}")
 
 
 def diag_dir(override: str | None = None) -> pathlib.Path:
-    return pathlib.Path(
-        override
-        or os.environ.get("SBT_BENCH_DIAG_DIR")
-        or pathlib.Path.cwd() / "diagnostics"
-    )
+    """One stable state directory for every consumer.
+
+    Priority: explicit override, then SBT_BENCH_DIAG_DIR (bench.py's
+    existing knob), then the source checkout's diagnostics/ when this
+    package lives in one — a daemon started with an arbitrary cwd must
+    read the SAME state the watcher writes, or the short-circuit is
+    silently inert — and only then cwd (site-packages installs, where
+    writing next to the package would be wrong).
+    """
+    if override:
+        return pathlib.Path(override)
+    env = os.environ.get("SBT_BENCH_DIAG_DIR")
+    if env:
+        return pathlib.Path(env)
+    checkout = pathlib.Path(__file__).resolve().parents[2] / "diagnostics"
+    if checkout.is_dir():
+        return checkout
+    return pathlib.Path.cwd() / "diagnostics"
 
 
 def state_path(override: str | None = None) -> pathlib.Path:

@@ -87,13 +87,26 @@ def _load_job(root: pathlib.Path, job_id: int) -> dict | None:
 
 
 def _save_job(root: pathlib.Path, rec: dict) -> None:
-    _job_path(root, rec["id"]).write_text(json.dumps(rec))
+    # atomic: a concurrent squeue/scontrol must never read a half-written
+    # record (submissions run in parallel since the provider grew its
+    # PodSyncWorkers pool)
+    path = _job_path(root, rec["id"])
+    tmp = path.with_name(path.name + f".{os.getpid()}.tmp")
+    tmp.write_text(json.dumps(rec))
+    os.replace(tmp, path)
 
 
 def _next_id(root: pathlib.Path) -> int:
+    # flock'd read-increment-write: real sbatch gets its id from slurmctld
+    # atomically; concurrent fake sbatch processes (parallel pod sync)
+    # must not race this counter file
+    import fcntl
+
     f = root / "next_id"
-    cur = int(f.read_text()) if f.exists() else 100
-    f.write_text(str(cur + 1))
+    with open(root / "next_id.lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        cur = int(f.read_text()) if f.exists() and f.read_text().strip() else 100
+        f.write_text(str(cur + 1))
     return cur
 
 

@@ -376,3 +376,46 @@ class TestVnodeConfig:
         cfg_file.write_text("port: 70000\n")
         with pytest.raises(ConfigError, match="port"):
             load_vnode_config(str(cfg_file))
+
+
+def test_ensure_backend_short_circuits_on_dead_chip(monkeypatch, tmp_path):
+    """Round 5: a chip the watcher has on record as dead must resolve to
+    CPU WITHOUT spending the probe budget — the 60 s subprocess probe
+    otherwise lands inside whatever calls ensure_backend first (measured:
+    the first scheduler tick of a cold bridge stalled 60 s)."""
+    import sys
+
+    import pytest as _pytest
+
+    from slurm_bridge_tpu.parallel import backend as B
+    from slurm_bridge_tpu.utils import chipstate
+
+    monkeypatch.setenv("SBT_BENCH_DIAG_DIR", str(tmp_path))
+    chipstate.record(False, "wedged", dir_override=str(tmp_path))
+    chipstate.record(False, "wedged", dir_override=str(tmp_path))
+
+    # a stand-in jax whose platform is unpinned (the real config in this
+    # test process is pinned to cpu, which would return before the probe)
+    class _FakeConfig:
+        jax_platforms = ""
+
+        def update(self, *a, **k):
+            pass
+
+    class _FakeJax:
+        config = _FakeConfig()
+
+        @staticmethod
+        def default_backend():
+            return "cpu"
+
+    monkeypatch.setitem(sys.modules, "jax", _FakeJax())
+    monkeypatch.setattr(B, "_decided", None)
+    monkeypatch.setattr(B, "_backends_initialized", lambda: False)
+    monkeypatch.delenv("SBT_BACKEND", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    monkeypatch.setattr(
+        B, "_probe_subprocess",
+        lambda t: _pytest.fail("probe must not run for a known-dead chip"),
+    )
+    assert B.ensure_backend() == "cpu"
