@@ -53,6 +53,12 @@ class Configurator:
         self._watch.stop()
         for t in self._tickers.values():
             t.stop()
+        for p in self.providers.values():
+            # shut the pod-sync pools: their threads are non-daemon and
+            # would outlive a stopped Bridge (long-lived embedders/tests
+            # cycling bridges would accumulate 10 idle threads per
+            # partition per cycle)
+            p.deregister()
 
     def reconcile(self) -> None:
         """Diff live partitions vs registered providers (:120-184)."""

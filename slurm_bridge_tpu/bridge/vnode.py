@@ -88,6 +88,8 @@ class VirtualNodeProvider:
         #: thread (measured 63.6 s for 5k pods on one core, round 5)
         self.sync_workers = max(1, sync_workers)
         self._pool = None  # lazily-built, reused across sync ticks
+        self._pool_lock = threading.Lock()
+        self._pool_closed = False
         self._inv_lock = threading.Lock()
         self._inv: tuple[float, PartitionInfo, list[NodeInfo]] | None = None
 
@@ -183,9 +185,13 @@ class VirtualNodeProvider:
         return self.store.mutate(VirtualNode.KIND, self.node_name, refresh)
 
     def deregister(self) -> None:
-        if self._pool is not None:
-            self._pool.shutdown(wait=False, cancel_futures=True)
-            self._pool = None
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+            self._pool_closed = True
+        if pool is not None:
+            # no cancel_futures: a sync in flight finishes converging its
+            # pods; the workers exit once the queue drains
+            pool.shutdown(wait=False)
         try:
             self.store.delete(VirtualNode.KIND, self.node_name)
         except NotFound:
@@ -207,16 +213,30 @@ class VirtualNodeProvider:
             for pod in pods:
                 self._sync_pod_safe(pod)
             return
-        if self._pool is None:
-            # built once, reused: sync runs every ~250 ms in steady state
-            # and a per-tick pool would churn thread create/teardown
-            from concurrent.futures import ThreadPoolExecutor
+        # sync() runs concurrently (partition ticker + Configurator.sync_now
+        # from Bridge.delete/converge_once callers), so the lazy build is
+        # locked; built once and reused — sync runs every ~250 ms in steady
+        # state and a per-tick pool would churn thread create/teardown
+        with self._pool_lock:
+            if self._pool is None and not self._pool_closed:
+                from concurrent.futures import ThreadPoolExecutor
 
-            self._pool = ThreadPoolExecutor(
-                max_workers=self.sync_workers,
-                thread_name_prefix=f"podsync-{self.partition}",
-            )
-        list(self._pool.map(self._sync_pod_safe, pods))
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.sync_workers,
+                    thread_name_prefix=f"podsync-{self.partition}",
+                )
+            pool = self._pool
+        if pool is None:
+            for pod in pods:  # deregistered mid-call: converge serially
+                self._sync_pod_safe(pod)
+            return
+        try:
+            list(pool.map(self._sync_pod_safe, pods))
+        except RuntimeError:
+            # pool shut down between the snapshot and the map (teardown
+            # race): finish this tick serially rather than abandon pods
+            for pod in pods:
+                self._sync_pod_safe(pod)
 
     def _sync_pod_safe(self, pod: Pod) -> None:
         try:

@@ -65,9 +65,13 @@ def diag_dir(override: str | None = None) -> pathlib.Path:
     env = os.environ.get("SBT_BENCH_DIAG_DIR")
     if env:
         return pathlib.Path(env)
-    checkout = pathlib.Path(__file__).resolve().parents[2] / "diagnostics"
-    if checkout.is_dir():
-        return checkout
+    root = pathlib.Path(__file__).resolve().parents[2]
+    # "is this a source checkout" must not depend on whether diagnostics/
+    # exists YET — a daemon that starts before the watcher's first write
+    # would otherwise pick cwd and flip directories mid-deployment once
+    # the checkout dir appears
+    if (root / "pyproject.toml").exists() or (root / ".git").exists():
+        return root / "diagnostics"
     return pathlib.Path.cwd() / "diagnostics"
 
 

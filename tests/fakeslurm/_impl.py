@@ -105,7 +105,8 @@ def _next_id(root: pathlib.Path) -> int:
     f = root / "next_id"
     with open(root / "next_id.lock", "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
-        cur = int(f.read_text()) if f.exists() and f.read_text().strip() else 100
+        raw = f.read_text().strip() if f.exists() else ""
+        cur = int(raw) if raw else 100
         f.write_text(str(cur + 1))
     return cur
 

@@ -431,3 +431,46 @@ def test_e2e_invalid_job_fails_fast(bridge):
     job = bridge.wait("badjob", timeout=10.0)
     assert job.status.state == JobState.FAILED
     assert "partition" in job.status.reason
+
+
+def test_scheduler_inventory_reuse_window(fake_slurm):
+    """cluster_state is reused within inventory_ttl (the no-progress retry
+    loop must not re-exec the Slurm CLIs 5x/s), but ANY state-changing
+    tick invalidates it — the next tick must see what it just caused."""
+    from slurm_bridge_tpu.bridge.scheduler import PlacementScheduler
+    from slurm_bridge_tpu.bridge.store import ObjectStore
+
+    class _CountingClient:
+        def __init__(self, inner):
+            self.inner = inner
+            self.calls = 0
+
+        def __getattr__(self, name):
+            fn = getattr(self.inner, name)
+            if name == "Partitions":
+                def wrapped(*a, **k):
+                    self.calls += 1
+                    return fn(*a, **k)
+                return wrapped
+            return fn
+
+    from slurm_bridge_tpu.wire import ServiceClient, dial
+
+    sock = str(fake_slurm.parent / "inv-agent.sock")
+    server = serve({"WorkloadManager": WorkloadServicer(SlurmClient())}, sock)
+    try:
+        client = _CountingClient(ServiceClient(dial(sock), "WorkloadManager"))
+        sched = PlacementScheduler(ObjectStore(), client, inventory_ttl=30.0)
+        sched.cluster_state()
+        sched.cluster_state()
+        sched.cluster_state()
+        assert client.calls == 1, "TTL window not reused"
+        sched._inv_cache = None  # what a state-changing tick does
+        sched.cluster_state()
+        assert client.calls == 2, "invalidation did not refetch"
+        off = PlacementScheduler(ObjectStore(), client, inventory_ttl=0)
+        off.cluster_state()
+        off.cluster_state()
+        assert client.calls == 4, "inventory_ttl=0 must disable reuse"
+    finally:
+        server.stop(None)
