@@ -36,7 +36,7 @@ from slurm_bridge_tpu.bridge.objects import (
     partition_node_name,
 )
 from slurm_bridge_tpu.bridge.statusmap import pod_phase_for
-from slurm_bridge_tpu.bridge.store import NotFound, ObjectStore
+from slurm_bridge_tpu.bridge.store import AlreadyExists, NotFound, ObjectStore
 from slurm_bridge_tpu.core.arrays import array_len
 from slurm_bridge_tpu.core.types import JobInfo, JobStatus, NodeInfo, PartitionInfo
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
@@ -172,9 +172,19 @@ class VirtualNodeProvider:
                 heartbeat=time.time(),
                 agent_endpoint=self.agent_endpoint,
             )
-            node = self.store.create(node)
-            self.events.event(node, Reason.NODE_READY, f"partition {self.partition} ready")
-            return node
+            try:
+                node = self.store.create(node)
+            except AlreadyExists:
+                # create-on-404 must tolerate losing the race: sync() runs
+                # concurrently (ticker + sync_now callers) and two threads
+                # can both observe the node missing — fall through to the
+                # refresh path the winner's node now serves
+                pass
+            else:
+                self.events.event(
+                    node, Reason.NODE_READY, f"partition {self.partition} ready"
+                )
+                return node
 
         def refresh(node: VirtualNode):
             node.capacity = cap

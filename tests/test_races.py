@@ -149,3 +149,86 @@ def test_update_conflict_detected_under_contention():
     with pytest.raises(Conflict):
         store.update(b)
     assert store.get(BridgeJob.KIND, "c").spec.priority == 1
+
+
+def test_provider_sync_races_deregister(monkeypatch, tmp_path):
+    """The pod-sync pool's lifecycle under fire (round 5): concurrent
+    sync() callers (partition ticker + sync_now from Bridge.delete and
+    converge_once) must build at most ONE pool, a deregister mid-sync must
+    not abandon pods or crash, and no podsync thread may survive."""
+    import json
+    import os
+    import pathlib
+
+    from slurm_bridge_tpu.agent import SlurmClient, WorkloadServicer
+    from slurm_bridge_tpu.bridge.objects import (
+        Meta,
+        Pod,
+        PodRole,
+        PodSpec,
+        partition_node_name,
+    )
+    from slurm_bridge_tpu.core.types import JobDemand
+    from slurm_bridge_tpu.bridge.vnode import VirtualNodeProvider
+    from slurm_bridge_tpu.obs.events import EventRecorder
+    from slurm_bridge_tpu.wire import ServiceClient, dial, serve
+
+    tmp = tmp_path
+    nodes = {f"r{i}": {"cpus": 8, "memory_mb": 16000, "partition": "race"}
+             for i in range(8)}
+    state = tmp / "slurm-state"
+    state.mkdir()
+    (state / "cluster.json").write_text(json.dumps(
+        {"partitions": {"race": {"nodes": list(nodes), "default": True}},
+         "nodes": nodes}))
+    monkeypatch.setenv("SBT_FAKESLURM_STATE", str(state))
+    fakeslurm = str(pathlib.Path(__file__).parent / "fakeslurm")
+    monkeypatch.setenv("PATH", fakeslurm + os.pathsep + os.environ["PATH"])
+
+    sock = str(tmp / "agent.sock")
+    server = serve({"WorkloadManager": WorkloadServicer(SlurmClient())}, sock)
+    store = ObjectStore()
+    provider = VirtualNodeProvider(
+        store, ServiceClient(dial(sock), "WorkloadManager"), "race",
+        events=EventRecorder(), sync_workers=4,
+    )
+    node_name = partition_node_name("race")
+    for i in range(12):
+        store.create(Pod(
+            meta=Meta(name=f"rp{i}"),
+            spec=PodSpec(role=PodRole.SIZECAR, partition="race",
+                         node_name=node_name,
+                         demand=JobDemand(partition="race", cpus_per_task=1,
+                                          script="#!/bin/sh\ntrue\n",
+                                          job_name=f"rp{i}")),
+        ))
+    try:
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    provider.sync()
+                except BaseException as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        provider.deregister()  # mid-flight teardown
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors[:3]
+        assert provider._pool is None
+        # every pod still converged (serial fallback covered the teardown)
+        submitted = sum(1 for p in store.list(Pod.KIND) if p.status.job_ids)
+        assert submitted == 12, f"only {submitted}/12 pods converged"
+    finally:
+        server.stop(None)
+    time.sleep(0.5)
+    stray = [t.name for t in threading.enumerate()
+             if t.name.startswith("podsync-race") and t.is_alive()]
+    assert not stray, stray
