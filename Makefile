@@ -3,8 +3,8 @@
 # target shells to the scripts CI runs, so `make test` here and the
 # workflows can never drift.
 
-.PHONY: help test fast check generate apidoc hygiene bench scenarios \
-        docker-build install uninstall deploy undeploy run demo
+.PHONY: help test fast check generate apidoc hygiene bench bench-smoke \
+        scenarios docker-build install uninstall deploy undeploy run demo
 
 help: ## Display this help.
 	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ \
@@ -16,7 +16,7 @@ test: ## Full suite + graft compile contracts + hygiene (ref: make test).
 fast: ## ~2-min signal: everything not marked slow.
 	python -m pytest tests/ -q -m "not slow"
 
-check: test ## Alias the reference's CI verb.
+check: test bench-smoke ## Alias the reference's CI verb (+ encode gate).
 
 generate: ## Regenerate protobuf bindings + API docs (ref: make generate).
 	hack/regen-proto.sh
@@ -30,6 +30,9 @@ hygiene: ## No-diff gate over generated artifacts (ref: test-go.yml).
 
 bench: ## The driver-contract headline benchmark (one JSON line).
 	python bench.py
+
+bench-smoke: ## 5k×1k end-to-end tick; fails on an encode regression.
+	python -m benchmarks.ticksmoke
 
 scenarios: ## The five BASELINE scenarios.
 	python -m benchmarks.scenarios --json

@@ -372,6 +372,22 @@ def main() -> None:
     pods_per_sec = placed / (t_auction / 1e3)
     _PARTIAL.update(value=round(pods_per_sec, 1),
                     vs_baseline=round(t_greedy / t_auction, 2))
+
+    # --- end-to-end tick: proto decode → encode (cached) → solve ---
+    # The solve above starts from an already-encoded snapshot; production
+    # ticks start from agent RPC protos and pay the lowering every tick.
+    # This stage measures that whole pipeline with the cross-tick encode
+    # caches warm (solver/encoder.py), plus the kept-as-oracle loop
+    # encoder for the speedup the caches buy (ISSUE 1 acceptance: ≥10×).
+    tick_label = (
+        "tick_p50_ms_50kx10k"
+        if (n_pods, n_nodes) == (50_000, 10_000)
+        else f"tick_p50_ms_{n_pods}x{n_nodes}"
+    )
+    tick = _tick_pipeline(n_pods, n_nodes, backend, n_dev, cfg)
+    for k, v in tick.items():
+        print(f"# tick: {k}={v}", file=sys.stderr, flush=True)
+
     _emit(
         {
             "metric": _METRIC,
@@ -386,8 +402,49 @@ def main() -> None:
             # measured, not implied (VERDICT r2 weak #6)
             "p50_ms": round(t_auction, 1),
             "p50_target_ms": 200,
+            # the end-to-end tick metric + its phase breakdown and the
+            # encode speedup over the loop oracle (solver/snapshot.py)
+            tick_label: tick["tick_p50_ms"],
+            "tick_decode_ms": tick["decode_ms"],
+            "tick_encode_ms": tick["encode_ms"],
+            "tick_solve_ms": tick["solve_ms"],
+            "encode_loop_ms": tick["encode_loop_ms"],
+            "encode_speedup_vs_loop": tick["encode_speedup_vs_loop"],
         }
     )
+
+
+def _tick_pipeline(
+    n_pods: int, n_nodes: int, backend: str, n_dev: int, cfg
+) -> dict:
+    """benchmarks.stages.profile_tick (the ONE tick-pipeline measurement,
+    shared with the `make bench-smoke` CI gate) on this bench's routed
+    solve engine — same decision the headline solve above made."""
+    from benchmarks.stages import profile_tick
+    from slurm_bridge_tpu.solver.routing import choose_path
+
+    # routing by shape only (shard count ≈ pods: the pipeline re-derives
+    # the exact batch internally; the decision thresholds are coarse)
+    route = choose_path(n_pods, n_nodes, backend_name=backend)
+    if route == "native":
+        solve = None  # profile_tick's default IS the routed native packer
+    elif n_dev > 1:
+        from slurm_bridge_tpu.solver.sharded import sharded_place
+
+        solve = lambda s, b: sharded_place(s, b, cfg)  # noqa: E731
+    else:
+        from slurm_bridge_tpu.solver.session import DeviceSolver
+
+        session: list = []
+
+        def solve(s, b):
+            if not session:
+                session.append(DeviceSolver(s, cfg))
+            else:
+                session[0].update_snapshot(s)
+            return session[0].solve(b)
+
+    return profile_tick(n_nodes, n_pods, seed=42, solve=solve)
 
 
 if __name__ == "__main__":

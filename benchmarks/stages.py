@@ -227,8 +227,104 @@ def profile_stages(snap, batch, cfg: AuctionConfig, *, iters: int = 10) -> dict:
     return out
 
 
+def profile_tick(
+    num_nodes: int,
+    num_jobs: int,
+    *,
+    seed: int = 42,
+    iters: int = 5,
+    solve=None,
+) -> dict:
+    """Per-stage timing of one END-TO-END scheduler tick (proto decode →
+    encode → solve), caches warm — the lens on everything the solve-only
+    stages above exclude. ISSUE 1: lowering, not solving, dominated tick
+    latency; this is the stage table that keeps it honest. The loop-oracle
+    encode rides along as the speedup baseline.
+
+    ``solve`` is a ``(snapshot, batch) -> Placement`` callback; the default
+    is the indexed native packer. bench.py passes its routed engine so the
+    CI smoke gate (benchmarks/ticksmoke.py) and the published headline
+    metric share ONE implementation of this pipeline."""
+    from slurm_bridge_tpu.solver.encoder import EncodedInventory, JobRowCache
+    from slurm_bridge_tpu.solver.snapshot import (
+        encode_cluster_loop,
+        encode_jobs_loop,
+        random_inventory,
+    )
+    from slurm_bridge_tpu.wire.convert import (
+        node_to_proto,
+        nodes_from_protos,
+        partition_to_proto,
+        partitions_from_protos,
+    )
+
+    if solve is None:
+        from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
+        from slurm_bridge_tpu.solver.routing import native_fit_policy
+
+        pol = native_fit_policy()
+        solve = lambda s, b: indexed_place_native(s, b, policy=pol)  # noqa: E731
+
+    partitions, nodes, demands = random_inventory(
+        num_nodes, num_jobs, seed=seed, load=0.7, gpu_fraction=0.15,
+        gang_fraction=0.05,
+    )
+    part_msgs = [partition_to_proto(p) for p in partitions]
+    node_msgs = [node_to_proto(n) for n in nodes]
+    inv = EncodedInventory()
+    rows = JobRowCache()
+    keys = [(j, 0) for j in range(len(demands))]
+
+    phases = []
+    for it in range(iters + 1):  # +1: the first tick warms every cache
+        t0 = time.perf_counter()
+        nd = nodes_from_protos(node_msgs)
+        pt = partitions_from_protos(part_msgs)
+        t1 = time.perf_counter()
+        snap = inv.refresh(nd, pt)
+        batch = rows.encode(keys, demands, snap, codes_token=inv.codes_token())
+        t2 = time.perf_counter()
+        solve(snap, batch)
+        t3 = time.perf_counter()
+        if it:
+            phases.append((t1 - t0, t2 - t1, t3 - t2))
+    decode, encode, solve_ms = (
+        float(np.median([p[i] for p in phases]) * 1e3) for i in range(3)
+    )
+
+    def loop_encode():
+        s = encode_cluster_loop(nodes, partitions)
+        encode_jobs_loop(demands, s)
+
+    loop_encode()  # warmup, matching the timed path's warm-cache posture
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        loop_encode()
+        ts.append((time.perf_counter() - t0) * 1e3)
+    loop_ms = float(np.median(ts))
+    return {
+        "shape": f"{num_jobs}x{num_nodes}",
+        "decode_ms": round(decode, 2),
+        "encode_ms": round(encode, 3),
+        "solve_ms": round(solve_ms, 2),
+        "tick_p50_ms": round(decode + encode + solve_ms, 2),
+        "encode_loop_ms": round(loop_ms, 2),
+        "encode_speedup_vs_loop": round(loop_ms / max(encode, 1e-6), 1),
+        "encode_cache_hits": rows.last_hits,
+        "encode_cache_misses": rows.last_misses,
+    }
+
+
 def main(argv: list[str] | None = None) -> None:
     argv = sys.argv[1:] if argv is None else argv
+    if "--tick" in argv:
+        if "--small" in argv:
+            out = profile_tick(1_000, 5_000, seed=2)
+        else:
+            out = profile_tick(10_000, 50_000)
+        print(json.dumps(out))
+        return
     if "--small" in argv:
         snap, batch = random_scenario(512, 5_000, seed=2, load=0.7)
         cfg = AuctionConfig(rounds=8)

@@ -1,0 +1,51 @@
+"""Encode-regression smoke gate — `make bench-smoke`.
+
+Runs the end-to-end tick stage (proto decode → encode → solve;
+benchmarks/stages.py:profile_tick) at a scaled-down 5k jobs × 1k nodes
+shape and FAILS (exit 1) if the warm cached encode exceeds a generous
+budget or loses its edge over the loop-oracle encoder. The full 50k×10k
+numbers stay in bench.py; this exists so an accidental per-row loop
+sneaking back into the encode path is caught by `make check` in seconds,
+not discovered in the next headline bench run.
+
+Budgets are deliberately loose (≈20× the measured steady state) so CI
+machine jitter never trips them; only a structural regression can.
+
+    SBT_SMOKE_ENCODE_BUDGET_MS   warm encode p50 ceiling   (default 50)
+    SBT_SMOKE_MIN_SPEEDUP        encode speedup floor      (default 3)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from benchmarks.stages import profile_tick
+
+    budget_ms = float(os.environ.get("SBT_SMOKE_ENCODE_BUDGET_MS", "50"))
+    min_speedup = float(os.environ.get("SBT_SMOKE_MIN_SPEEDUP", "3"))
+    out = profile_tick(1_000, 5_000, seed=2)
+    out["encode_budget_ms"] = budget_ms
+    out["min_speedup"] = min_speedup
+    ok = (
+        out["encode_ms"] <= budget_ms
+        and out["encode_speedup_vs_loop"] >= min_speedup
+    )
+    out["ok"] = ok
+    print(json.dumps(out))
+    if not ok:
+        print(
+            f"# bench-smoke FAIL: encode {out['encode_ms']} ms "
+            f"(budget {budget_ms}) / speedup {out['encode_speedup_vs_loop']}x "
+            f"(floor {min_speedup}x)",
+            file=sys.stderr,
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

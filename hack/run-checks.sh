@@ -12,9 +12,18 @@ set -eu
 cd "$(dirname "$0")/.."
 python -m pytest tests/ -q
 python - <<'EOF'
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older JAX: XLA_FLAGS above governs the device count
 import __graft_entry__ as g
 fn, args = g.entry()
 jax.jit(fn)(*args)

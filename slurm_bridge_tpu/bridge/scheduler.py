@@ -45,22 +45,30 @@ from slurm_bridge_tpu.bridge.store import NotFound, ObjectStore
 from slurm_bridge_tpu.core.types import JobDemand, NodeInfo, PartitionInfo
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
 from slurm_bridge_tpu.obs.metrics import REGISTRY
+from slurm_bridge_tpu.obs.metrics import Histogram
 from slurm_bridge_tpu.solver import AuctionConfig, greedy_place
+from slurm_bridge_tpu.solver.encoder import EncodedInventory, JobRowCache
 from slurm_bridge_tpu.solver.session import DeviceSolver
 from slurm_bridge_tpu.solver.snapshot import (
     PAD_PARTITION,
     Placement,
-    encode_cluster,
-    encode_jobs,
     pad_batch,
 )
 from slurm_bridge_tpu.wire import ServiceClient, pb
-from slurm_bridge_tpu.wire.convert import node_from_proto, partition_from_proto
+from slurm_bridge_tpu.wire.convert import (
+    nodes_from_protos,
+    partition_from_proto,
+)
 
 log = logging.getLogger("sbt.scheduler")
 
 _tick_seconds = REGISTRY.histogram(
     "sbt_scheduler_tick_seconds", "placement solve wall time per tick"
+)
+_encode_seconds = REGISTRY.histogram(
+    "sbt_scheduler_encode_seconds",
+    "inventory + queue lowering wall time per tick (cache-aware path)",
+    buckets=Histogram.FAST_BUCKETS,
 )
 _pods_placed = REGISTRY.counter("sbt_scheduler_pods_placed_total", "pods bound")
 _pods_unplaced = REGISTRY.gauge(
@@ -140,6 +148,12 @@ class PlacementScheduler:
         #: a tick at most this long, never wedge the scheduler thread
         self.place_timeout = place_timeout
         self._solver: DeviceSolver | None = None
+        #: cross-tick encode caches (solver/encoder.py): the inventory
+        #: snapshot survives the inventory_ttl window untouched and takes
+        #: row deltas otherwise; pending pods' encoded rows carry forward
+        #: keyed by (uid, resource_version)
+        self._encoded = EncodedInventory()
+        self._job_rows = JobRowCache()
         #: out-of-process PlacementSolver sidecar (SURVEY §7 item 4): when
         #: set, solves go over gRPC instead of in-process JAX
         self._remote: ServiceClient | None = None
@@ -178,10 +192,9 @@ class PlacementScheduler:
                 if n not in seen:
                     seen.add(n)
                     node_names.append(n)
-        nodes = [
-            node_from_proto(m)
-            for m in self.client.Nodes(pb.NodesRequest(names=node_names)).nodes
-        ]
+        nodes = nodes_from_protos(
+            self.client.Nodes(pb.NodesRequest(names=node_names)).nodes
+        )
         self._inv_cache = (time.monotonic(), partitions, nodes)
         return partitions, nodes
 
@@ -291,13 +304,20 @@ class PlacementScheduler:
         Returns (job index → assigned node names, incumbent job indices
         that lost their nodes and must be preempted).
         """
-        snapshot = encode_cluster(nodes, partitions)
-        batch = encode_jobs(demands, snapshot)
+        t_enc = time.perf_counter()
+        snapshot = self._encoded.refresh(nodes, partitions)
+        batch = self._job_rows.encode(
+            [(p.meta.uid, p.meta.resource_version) for p in all_pods],
+            demands,
+            snapshot,
+            codes_token=self._encoded.codes_token(),
+        )
+        _encode_seconds.observe(time.perf_counter() - t_enc)
 
         # Streaming incumbents: pin each already-submitted shard to its
         # hinted node and release its RUNNING usage so everyone re-admits
         # against total capacity (solver/streaming.py semantics).
-        name_idx = {n: i for i, n in enumerate(snapshot.node_names)}
+        name_idx = self._encoded.name_idx
         incumbent_arr = np.full(batch.num_shards, -1, np.int32)
         shard_rows: dict[int, list[int]] = {}
         for row in range(batch.num_shards):

@@ -30,6 +30,12 @@ class Counter:
         with self._lock:
             self._values[tuple(sorted(labels.items()))] += amount
 
+    def value(self, **labels: str) -> float:
+        """Current value for one label set — for tests and in-process
+        consumers, without parsing the exposition text."""
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
+
     def collect(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -58,6 +64,13 @@ class Gauge:
 
 class Histogram:
     DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.2, 0.5, 1.0, 5.0, 30.0)
+    #: For sub-millisecond phases (e.g. the cached encode path): the default
+    #: buckets would dump every observation into the first bucket, hiding
+    #: any regression below 1 ms.
+    FAST_BUCKETS = (
+        0.00001, 0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+        0.5, 1.0,
+    )
 
     def __init__(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS):
         self.name, self.help = name, help_

@@ -46,7 +46,10 @@ from slurm_bridge_tpu.solver.snapshot import (
 )
 from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.wire import pb
-from slurm_bridge_tpu.wire.convert import node_from_proto, partition_from_proto
+from slurm_bridge_tpu.wire.convert import (
+    nodes_from_protos,
+    partitions_from_protos,
+)
 
 log = logging.getLogger("sbt.solver.service")
 
@@ -118,8 +121,8 @@ class PlacementSolverServicer:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"unknown solver {solver!r} (want one of {SOLVERS} or 'auto')",
             )
-        nodes = [node_from_proto(m) for m in request.inventory]
-        partitions = [partition_from_proto(m) for m in request.partitions]
+        nodes = nodes_from_protos(request.inventory)
+        partitions = partitions_from_protos(request.partitions)
         if not partitions:
             # inventory-only callers: one catch-all partition named "" so
             # jobs with an empty partition match every node

@@ -47,6 +47,24 @@ from slurm_bridge_tpu.solver.auction import (
 )
 from slurm_bridge_tpu.solver.snapshot import ClusterSnapshot, JobBatch, Placement
 
+# jax.shard_map (with check_vma) landed well after 0.4.x; earlier versions
+# ship it as jax.experimental.shard_map with the equivalent knob spelled
+# check_rep. Resolve once so the kernel builder below is version-agnostic.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _CHECK_KW = "check_vma"
+else:  # pragma: no cover - exercised on older JAX images
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def _mesh_context(mesh: Mesh):
+    """jax.set_mesh where it exists; on older JAX the Mesh object is its
+    own context manager with the same effect for this kernel."""
+    return jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else mesh
+
+
 _PAD_PART = np.int32(2**30)
 
 
@@ -60,7 +78,7 @@ def _make_sharded_kernel(
     fresh closure per call would force full XLA recompilation every tick."""
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(
             P("mp", None),  # free0 [N, R]
@@ -78,7 +96,7 @@ def _make_sharded_kernel(
         # the control path (admission/pricing) is computed redundantly on
         # every device from all_gathered inputs — identical by determinism,
         # which the static varying-axes analysis cannot prove
-        check_vma=False,
+        **{_CHECK_KW: False},
     )
     def kernel(
         free0_blk, node_part_blk, node_feat_blk,
@@ -259,7 +277,7 @@ def sharded_place(
         batch_has_gangs(gang[:p_real]),
         use_pallas, interpret,
     )
-    with jax.set_mesh(mesh):
+    with _mesh_context(mesh):
         assign, free_after = kernel(
             jnp.asarray(free0),
             jnp.asarray(node_part),

@@ -20,16 +20,51 @@ Encoding decisions (TPU-first):
 
 from __future__ import annotations
 
+import logging
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from slurm_bridge_tpu.core.arrays import array_len
 from slurm_bridge_tpu.core.types import JobDemand, NodeInfo, PartitionInfo
+from slurm_bridge_tpu.obs.metrics import REGISTRY
+
+log = logging.getLogger("sbt.snapshot")
 
 #: Static resource dimensions, in matrix column order.
 RESOURCE_DIMS = ("cpus", "mem_mb", "gpus")
 NUM_RES = len(RESOURCE_DIMS)
+
+#: Features silently unmatchable because the 31-bit mask was already full.
+#: Before this counter existed, a capacity-matching bug from a dropped
+#: feature was undiagnosable (the node simply never matched).
+_features_dropped = REGISTRY.counter(
+    "sbt_encoder_features_dropped_total",
+    "node features dropped because the 31-bit feature mask was full "
+    "(the rate-limited sbt.snapshot warning names each dropped feature)",
+)
+_DROP_LOG_INTERVAL_S = 60.0
+_last_drop_log = [0.0]
+
+
+def _note_dropped_feature(feature: str) -> None:
+    """Count (always) and warn (rate-limited) a feature that fell off the
+    31-bit mask — the node can never match a job requiring it. The counter
+    is unlabeled on purpose: drops only happen on clusters with MANY
+    distinct (often machine-generated) feature strings, where a per-name
+    label would grow the registry without bound; the log carries the name.
+    """
+    _features_dropped.inc()
+    now = time.monotonic()
+    if now - _last_drop_log[0] >= _DROP_LOG_INTERVAL_S:
+        _last_drop_log[0] = now
+        log.warning(
+            "feature bitmask full (31 codes assigned): dropping %r — nodes "
+            "advertising only this feature cannot match jobs requiring it "
+            "(sbt_encoder_features_dropped_total counts every drop)",
+            feature,
+        )
 
 
 @dataclass
@@ -69,6 +104,31 @@ class JobBatch:
     def num_shards(self) -> int:
         return int(self.demand.shape[0])
 
+    def select(self, keep: np.ndarray) -> "JobBatch":
+        """Row subset (boolean mask or index array); ids are preserved —
+        callers owning persistent id spaces (StreamingSim) re-key
+        themselves."""
+        return JobBatch(
+            demand=self.demand[keep],
+            partition_of=self.partition_of[keep],
+            req_features=self.req_features[keep],
+            priority=self.priority[keep],
+            gang_id=self.gang_id[keep],
+            job_of=self.job_of[keep],
+        )
+
+
+def concat_batches(batches: list[JobBatch]) -> JobBatch:
+    """Row-wise concatenation; ids are taken as-is (callers re-key)."""
+    return JobBatch(
+        demand=np.concatenate([b.demand for b in batches]),
+        partition_of=np.concatenate([b.partition_of for b in batches]),
+        req_features=np.concatenate([b.req_features for b in batches]),
+        priority=np.concatenate([b.priority for b in batches]),
+        gang_id=np.concatenate([b.gang_id for b in batches]),
+        job_of=np.concatenate([b.job_of for b in batches]),
+    )
+
 
 @dataclass
 class Placement:
@@ -88,6 +148,92 @@ class Placement:
         return out
 
 
+def node_partition_map(partitions: list[PartitionInfo]) -> tuple[dict[str, int], dict[str, int]]:
+    """(partition name → code, node name → partition code). First listing
+    wins for nodes in several partitions, matching the loop encoder."""
+    partition_codes = {p.name: i for i, p in enumerate(partitions)}
+    node_part: dict[str, int] = {}
+    for p in partitions:
+        for name in p.nodes:
+            node_part.setdefault(name, partition_codes[p.name])
+    return partition_codes, node_part
+
+
+def _feature_mask(
+    feats: tuple[str, ...], feature_codes: dict[str, int]
+) -> int:
+    """Bitmask for one node's feature tuple, assigning fresh codes in
+    first-seen order. Bit 31 is reserved as the "impossible requirement"
+    sentinel (_required_features) — real features stop at bit 30; once the
+    table is full, extra features are unmatchable and counted as dropped."""
+    mask = 0
+    for f in feats:
+        if f not in feature_codes:
+            if len(feature_codes) >= 31:
+                _note_dropped_feature(f)
+                continue  # bitmask full: extra features are unmatchable
+            feature_codes[f] = len(feature_codes)
+        mask |= 1 << feature_codes[f]
+    return mask
+
+
+def node_columns(nodes: list[NodeInfo]) -> dict[str, np.ndarray]:
+    """Raw per-node scalar columns as dense arrays — the scratch form both
+    the vectorized encoder and the delta cache diff against. One attribute
+    sweep per column; everything downstream is NumPy."""
+    n = len(nodes)
+    return {
+        "cpus": np.fromiter((nd.cpus for nd in nodes), np.int64, n),
+        "alloc_cpus": np.fromiter((nd.alloc_cpus for nd in nodes), np.int64, n),
+        "mem": np.fromiter((nd.memory_mb for nd in nodes), np.int64, n),
+        "alloc_mem": np.fromiter((nd.alloc_memory_mb for nd in nodes), np.int64, n),
+        "gpus": np.fromiter((nd.gpus for nd in nodes), np.int64, n),
+        "alloc_gpus": np.fromiter((nd.alloc_gpus for nd in nodes), np.int64, n),
+    }
+
+
+def node_dynamic_arrays(
+    nodes: list[NodeInfo],
+    cols: dict[str, np.ndarray],
+    feature_codes: dict[str, int],
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(capacity [N,R] f32, free [N,R] f32, features [N] u32) from raw
+    columns. State strings and feature tuples are categorical — highly
+    repetitive across a cluster — so schedulability parsing and bitmask
+    assembly run once per distinct value, then broadcast by NumPy take.
+    """
+    n = len(nodes)
+    states = [nd.state for nd in nodes]
+    sched_of: dict[str, bool] = {}
+    for i, s in enumerate(states):
+        if s not in sched_of:
+            sched_of[s] = nodes[i].schedulable
+    schedulable = np.fromiter((sched_of[s] for s in states), np.bool_, n)
+
+    feats = [nd.features for nd in nodes]
+    # first-seen tuple order reproduces the loop encoder's code assignment:
+    # a feature's first appearance is inside the first tuple containing it
+    mask_of: dict[tuple[str, ...], int] = {}
+    for ft in feats:
+        if ft not in mask_of:
+            mask_of[ft] = _feature_mask(ft, feature_codes)
+    features = np.fromiter((mask_of[ft] for ft in feats), np.uint32, n)
+
+    capacity = np.stack(
+        [cols["cpus"], cols["mem"], cols["gpus"]], axis=1
+    ).astype(np.float32)
+    free_int = np.stack(
+        [
+            np.maximum(cols["cpus"] - cols["alloc_cpus"], 0),
+            np.maximum(cols["mem"] - cols["alloc_mem"], 0),
+            np.maximum(cols["gpus"] - cols["alloc_gpus"], 0),
+        ],
+        axis=1,
+    )
+    free = np.where(schedulable[:, None], free_int, 0).astype(np.float32)
+    return capacity, free, features
+
+
 def encode_cluster(
     nodes: list[NodeInfo],
     partitions: list[PartitionInfo],
@@ -96,16 +242,46 @@ def encode_cluster(
 ) -> ClusterSnapshot:
     """Lower NodeInfo/PartitionInfo lists into a ClusterSnapshot.
 
+    Vectorized column build: one attribute sweep per column into dense
+    scratch arrays, categorical caches for state→schedulability and
+    feature-tuple→bitmask, NumPy for all row math. Bit-identical to
+    :func:`encode_cluster_loop` (the kept-as-oracle reference), which the
+    property tests in tests/test_solver.py pin.
+
     Unschedulable nodes (DRAIN/DOWN/…) keep their rows (stable indices
     across ticks — see SURVEY.md §7 determinism note) but advertise zero
     free capacity.
     """
-    partition_codes = {p.name: i for i, p in enumerate(partitions)}
-    node_part: dict[str, int] = {}
-    for p in partitions:
-        for name in p.nodes:
-            node_part.setdefault(name, partition_codes[p.name])
+    partition_codes, node_part = node_partition_map(partitions)
+    feature_codes = dict(feature_codes or {})
+    n = len(nodes)
+    names = [nd.name for nd in nodes]
+    cols = node_columns(nodes)
+    capacity, free, features = node_dynamic_arrays(nodes, cols, feature_codes)
+    partition_of = np.fromiter(
+        (node_part.get(nm, -1) for nm in names), np.int32, n
+    )
+    return ClusterSnapshot(
+        node_names=names,
+        capacity=capacity,
+        free=free,
+        partition_of=partition_of,
+        features=features,
+        partition_codes=partition_codes,
+        feature_codes=feature_codes,
+    )
 
+
+def encode_cluster_loop(
+    nodes: list[NodeInfo],
+    partitions: list[PartitionInfo],
+    *,
+    feature_codes: dict[str, int] | None = None,
+) -> ClusterSnapshot:
+    """The original per-row loop encoder, kept as the correctness oracle:
+    the property tests assert :func:`encode_cluster` is bit-identical to
+    this, and bench.py measures the vectorized+cached path against it."""
+    partition_codes, node_part = node_partition_map(partitions)
     feature_codes = dict(feature_codes or {})
     n = len(nodes)
     capacity = np.zeros((n, NUM_RES), dtype=np.float32)
@@ -119,16 +295,7 @@ def encode_cluster(
         if nd.schedulable:
             free[i] = (nd.free_cpus, nd.free_memory_mb, nd.free_gpus)
         partition_of[i] = node_part.get(nd.name, -1)
-        mask = 0
-        for f in nd.features:
-            if f not in feature_codes:
-                # bit 31 is reserved as the "impossible requirement" sentinel
-                # (_required_features) — real features stop at bit 30
-                if len(feature_codes) >= 31:
-                    continue  # bitmask full: extra features are unmatchable
-                feature_codes[f] = len(feature_codes)
-            mask |= 1 << feature_codes[f]
-        features[i] = mask
+        features[i] = _feature_mask(nd.features, feature_codes)
     return ClusterSnapshot(
         node_names=names,
         capacity=capacity,
@@ -170,6 +337,69 @@ def _gres_gpu_count(gres: str) -> int:
         return 0
 
 
+def job_scalars(
+    demand: JobDemand, snapshot: ClusterSnapshot
+) -> tuple[float, float, float, int, int, int, float]:
+    """One job's shard-row scalars:
+    (cpu/shard, mem/shard, gpu/shard, partition code, feature bits,
+    nshards, priority). The single source of the sizecar sizing rule
+    (pkg/slurm-bridge-operator/pod.go:143-162): cpu = cpus_per_task ×
+    ntasks × array_len spread evenly across ``nodes`` shards; mem =
+    mem_per_cpu × cpu (defaulting 1024 MB/cpu as pod.go:91-95). Shared by
+    the batch encoder, the loop oracle, and the cross-tick job-row cache.
+    """
+    arr = array_len(demand.array)
+    total_cpus = float(demand.total_cpus(arr))
+    nshards = max(1, demand.nodes)
+    mem_per_cpu = float(demand.mem_per_cpu_mb or 1024.0)
+    cpu_per_shard = total_cpus / nshards
+    # gres is a PER-NODE quantity in Slurm (--gres=gpu:4 means 4 GPUs on
+    # every allocated node), so it is NOT divided across shards; the
+    # array fan-out multiplies it like the sizecar cpu rule does
+    gpu_per_shard = float(_gres_gpu_count(demand.gres)) * max(1, arr)
+    part = snapshot.partition_codes.get(demand.partition, -1)
+    feat = _required_features(demand, snapshot.feature_codes)
+    return (
+        cpu_per_shard,
+        cpu_per_shard * mem_per_cpu,
+        gpu_per_shard,
+        part,
+        feat,
+        nshards,
+        float(demand.priority),
+    )
+
+
+def batch_from_scalars(
+    scalars: list[tuple[float, float, float, int, int, int, float]],
+    *,
+    priorities: list[float] | None = None,
+) -> JobBatch:
+    """Assemble a JobBatch from per-job scalar rows — pure NumPy: gang
+    fan-out is one ``np.repeat`` over the shard counts, no per-shard loop."""
+    n_jobs = len(scalars)
+    cpu = np.fromiter((s[0] for s in scalars), np.float64, n_jobs)
+    mem = np.fromiter((s[1] for s in scalars), np.float64, n_jobs)
+    gpu = np.fromiter((s[2] for s in scalars), np.float64, n_jobs)
+    part = np.fromiter((s[3] for s in scalars), np.int32, n_jobs)
+    feat = np.fromiter((s[4] for s in scalars), np.uint32, n_jobs)
+    nshards = np.fromiter((s[5] for s in scalars), np.int64, n_jobs)
+    if priorities is not None:
+        prio = np.asarray(priorities, np.float64)
+    else:
+        prio = np.fromiter((s[6] for s in scalars), np.float64, n_jobs)
+    job_of = np.repeat(np.arange(n_jobs, dtype=np.int32), nshards)
+    demand = np.stack([cpu, mem, gpu], axis=1).astype(np.float32)
+    return JobBatch(
+        demand=demand[job_of].reshape(-1, NUM_RES),
+        partition_of=part[job_of],
+        req_features=feat[job_of],
+        priority=prio.astype(np.float32)[job_of],
+        gang_id=job_of.copy(),
+        job_of=job_of,
+    )
+
+
 def encode_jobs(
     demands: list[JobDemand],
     snapshot: ClusterSnapshot,
@@ -178,10 +408,23 @@ def encode_jobs(
 ) -> JobBatch:
     """Lower pending JobDemands into a JobBatch of placement shards.
 
-    Sizing follows the sizecar rule (pkg/slurm-bridge-operator/pod.go:143-162):
-    cpu = cpus_per_task × ntasks × array_len, spread evenly across ``nodes``
-    shards; mem = mem_per_cpu × cpu (defaulting 1024 MB/cpu as pod.go:91-95).
+    Vectorized: per-job scalars once (string parses cached per distinct
+    array/gres value), then NumPy repeat for the gang fan-out. Bit-identical
+    to :func:`encode_jobs_loop` (the kept-as-oracle reference), pinned by
+    the property tests.
     """
+    scalars = [job_scalars(d, snapshot) for d in demands]
+    return batch_from_scalars(scalars, priorities=priorities)
+
+
+def encode_jobs_loop(
+    demands: list[JobDemand],
+    snapshot: ClusterSnapshot,
+    *,
+    priorities: list[float] | None = None,
+) -> JobBatch:
+    """The original per-shard loop encoder, kept as the correctness oracle
+    for :func:`encode_jobs` (property tests + the bench's loop baseline)."""
     rows_dem: list[tuple[float, float, float]] = []
     rows_part: list[int] = []
     rows_feat: list[int] = []
@@ -251,6 +494,81 @@ def pad_batch(batch: JobBatch, multiple: int) -> JobBatch:
         ),
         job_of=np.concatenate([batch.job_of, np.full(pad, -1, np.int32)]),
     )
+
+
+def random_inventory(
+    num_nodes: int,
+    num_jobs: int,
+    *,
+    seed: int = 0,
+    num_partitions: int = 4,
+    gpu_fraction: float = 0.15,
+    gang_fraction: float = 0.05,
+    gang_size: int = 4,
+    load: float = 0.7,
+    drain_fraction: float = 0.01,
+) -> tuple[list[PartitionInfo], list[NodeInfo], list[JobDemand]]:
+    """Synthetic inventory at the TYPED level (NodeInfo/PartitionInfo/
+    JobDemand) — the raw form the agent RPCs deliver, for benchmarking the
+    full tick pipeline (proto decode → encode → solve) rather than just the
+    solve. ``random_scenario`` remains the already-encoded twin for
+    solver-only benchmarks; distributions match.
+    """
+    rng = np.random.default_rng(seed)
+    cpus = rng.choice([32, 64, 128], size=num_nodes)
+    mem = cpus * rng.choice([2048, 4096], size=num_nodes)
+    has_gpu = rng.random(num_nodes) < gpu_fraction
+    gpus = np.where(has_gpu, rng.choice([4, 8], size=num_nodes), 0)
+    part = rng.integers(0, num_partitions, size=num_nodes)
+    used_frac = rng.uniform(0.0, 0.3, size=num_nodes)
+    alloc_cpus = np.floor(cpus * used_frac).astype(np.int64)
+    alloc_mem = np.floor(mem * used_frac).astype(np.int64)
+    drained = rng.random(num_nodes) < drain_fraction
+    nodes = [
+        NodeInfo(
+            name=f"node{i:05d}",
+            cpus=int(cpus[i]),
+            alloc_cpus=int(alloc_cpus[i]),
+            memory_mb=int(mem[i]),
+            alloc_memory_mb=int(alloc_mem[i]),
+            gpus=int(gpus[i]),
+            gpu_type="gpu_type0" if has_gpu[i] else "",
+            features=("gpu_type0",) if has_gpu[i] else (),
+            state="DRAINED" if drained[i] else ("MIXED" if used_frac[i] > 0 else "IDLE"),
+        )
+        for i in range(num_nodes)
+    ]
+    members: list[list[str]] = [[] for _ in range(num_partitions)]
+    for i in range(num_nodes):
+        members[int(part[i])].append(nodes[i].name)
+    partitions = [
+        PartitionInfo(name=f"part{k}", nodes=tuple(members[k]))
+        for k in range(num_partitions)
+    ]
+
+    mean_cpu_free = float(np.maximum(cpus - alloc_cpus, 0).mean())
+    lam = max(1.0, load * mean_cpu_free * num_nodes / max(1, num_jobs))
+    jcpu = np.maximum(1, rng.poisson(lam, size=num_jobs))
+    jmem = rng.choice([1024, 2048, 4096], size=num_jobs)
+    is_gpu_job = rng.random(num_jobs) < gpu_fraction
+    jgpu = rng.integers(1, 5, size=num_jobs)
+    jpart = rng.integers(0, num_partitions, size=num_jobs)
+    prio = rng.integers(0, 100, size=num_jobs)
+    is_gang = rng.random(num_jobs) < gang_fraction
+    demands = [
+        JobDemand(
+            partition=f"part{int(jpart[j])}",
+            job_name=f"job{j}",
+            cpus_per_task=int(jcpu[j]),
+            ntasks=1,
+            nodes=gang_size if is_gang[j] else 1,
+            mem_per_cpu_mb=int(jmem[j]),
+            gres=f"gpu:gpu_type0:{int(jgpu[j])}" if is_gpu_job[j] else "",
+            priority=int(prio[j]),
+        )
+        for j in range(num_jobs)
+    ]
+    return partitions, nodes, demands
 
 
 def random_scenario(

@@ -36,6 +36,7 @@ tests; ``streaming_place`` is the functional core.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -45,6 +46,7 @@ from slurm_bridge_tpu.solver.snapshot import (
     ClusterSnapshot,
     JobBatch,
     Placement,
+    concat_batches,
     pad_batch,
     random_scenario,
 )
@@ -104,13 +106,9 @@ def streaming_place(
     inc_mask = incumbent >= 0
     solve_batch = batch
     if not preemption and inc_mask.any():
-        solve_batch = JobBatch(
-            demand=batch.demand,
-            partition_of=batch.partition_of,
-            req_features=batch.req_features,
+        solve_batch = dataclasses.replace(
+            batch,
             priority=np.where(inc_mask, batch.priority + _KEEP_BOOST, batch.priority),
-            gang_id=batch.gang_id,
-            job_of=batch.job_of,
         )
     p_real = solve_batch.num_shards
     if engine == "native" and not sharded:
@@ -203,15 +201,7 @@ class StreamingSim:
         """Remove all shards of the given jobs (completed/cancelled)."""
         gone = np.isin(self.batch.job_of, job_ids)
         keep = ~gone
-        b = self.batch
-        self.batch = JobBatch(
-            demand=b.demand[keep],
-            partition_of=b.partition_of[keep],
-            req_features=b.req_features[keep],
-            priority=b.priority[keep],
-            gang_id=b.gang_id[keep],
-            job_of=b.job_of[keep],
-        )
+        self.batch = self.batch.select(keep)
         self.assign = self.assign[keep]
         return int(gone.sum())
 
@@ -224,15 +214,15 @@ class StreamingSim:
         fresh = self._next_job + np.arange(uniq.size)
         self._next_job += uniq.size
         job_of = fresh[inverse].astype(np.int32)
-        b = self.batch
-        self.batch = JobBatch(
-            demand=np.concatenate([b.demand, new.demand]),
-            partition_of=np.concatenate([b.partition_of, new.partition_of]),
-            req_features=np.concatenate([b.req_features, new.req_features]),
-            priority=np.concatenate([b.priority, new.priority]),
-            gang_id=np.concatenate([b.gang_id, job_of]),  # re-keyed per job
-            job_of=np.concatenate([b.job_of, job_of]),
+        rekeyed = JobBatch(
+            demand=new.demand,
+            partition_of=new.partition_of,
+            req_features=new.req_features,
+            priority=new.priority,
+            gang_id=job_of,  # re-keyed per job
+            job_of=job_of,
         )
+        self.batch = concat_batches([self.batch, rekeyed])
         self.assign = np.concatenate(
             [self.assign, np.full(new.num_shards, -1, np.int32)]
         )

@@ -37,9 +37,10 @@ def ensure_self_signed(
         from cryptography.hazmat.primitives import hashes, serialization
         from cryptography.hazmat.primitives.asymmetric import rsa
         from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
-    except ImportError:  # pragma: no cover - baked into the image
-        log.warning("cryptography unavailable; cannot bootstrap TLS certs")
-        return False
+    except ImportError:
+        # images without the cryptography wheel still carry the openssl
+        # CLI — same cert shape, so the kubelet API keeps its TLS posture
+        return _ensure_self_signed_openssl(cert_path, key_path, common_name)
 
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
     subject = x509.Name(
@@ -88,4 +89,50 @@ def ensure_self_signed(
     with open(cert_path, "wb") as f:
         f.write(cert.public_bytes(serialization.Encoding.PEM))
     log.info("generated self-signed TLS cert at %s", cert_path)
+    return True
+
+
+def _ensure_self_signed_openssl(
+    cert_path: str, key_path: str, common_name: str
+) -> bool:
+    """openssl-CLI fallback with the same cert shape (2048-bit RSA, one
+    year, serverAuth, 127.0.0.1 + node-name SANs)."""
+    import shutil
+    import subprocess
+
+    openssl = shutil.which("openssl")
+    if not openssl:
+        log.warning(
+            "neither cryptography nor the openssl CLI is available; "
+            "cannot bootstrap TLS certs"
+        )
+        return False
+    for path in (cert_path, key_path):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+    san = f"IP:127.0.0.1,DNS:{common_name.replace(' ', '-')}"
+    try:
+        subprocess.run(
+            [
+                openssl, "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", key_path, "-out", cert_path, "-days", "365",
+                "-subj", f"/O=kubecluster/OU=sbj/CN={common_name}",
+                "-addext", f"subjectAltName={san}",
+                "-addext", "extendedKeyUsage=serverAuth",
+            ],
+            check=True,
+            capture_output=True,
+            timeout=60,
+        )
+    except (subprocess.SubprocessError, OSError) as exc:
+        log.warning("openssl cert bootstrap failed: %s", exc)
+        # ensure_self_signed only reaches generation when NEITHER file
+        # existed, so anything present now is openssl's half-made output
+        for path in (cert_path, key_path):
+            if os.path.exists(path):
+                os.unlink(path)
+        return False
+    os.chmod(key_path, 0o600)
+    log.info("generated self-signed TLS cert at %s (openssl CLI)", cert_path)
     return True

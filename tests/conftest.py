@@ -25,7 +25,13 @@ import jax
 
 if not _use_tpu:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        # newer JAX spells the device-count knob as a config option; older
+        # versions only honour the XLA_FLAGS env set above, so a missing
+        # option is fine as long as jax wasn't imported before this module
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass
 
 import pathlib
 

@@ -49,6 +49,12 @@ def test_bench_emits_one_json_line_forced_cpu():
     assert payload["vs_baseline"] > 0
     assert payload["p50_ms"] > 0
     assert payload["p50_target_ms"] == 200
+    # the end-to-end tick metric (ISSUE 1): shape-labeled like the headline
+    # so a non-default shape can't masquerade as the 50kx10k number
+    assert payload["tick_p50_ms_800x64"] > 0
+    assert payload["tick_encode_ms"] > 0
+    assert payload["encode_loop_ms"] > 0
+    assert payload["encode_speedup_vs_loop"] > 0
     assert "note" not in payload  # a clean run carries no failure marker
 
 

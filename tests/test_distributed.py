@@ -260,9 +260,16 @@ def test_multiprocess_distributed_sharded_solve(tmp_path):
         rank = int(sys.argv[1])
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["SBT_BACKEND"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4").strip()
         import jax
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 4)
+        try:
+            jax.config.update("jax_num_cpu_devices", 4)
+        except AttributeError:
+            pass  # older JAX: XLA_FLAGS above governs the device count
         jax.distributed.initialize(
             "localhost:{port}", num_processes=2, process_id=rank)
         sys.path.insert(0, {str(pathlib.Path(__file__).parent.parent)!r})

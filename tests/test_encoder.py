@@ -1,0 +1,279 @@
+"""The cross-tick encode caches (solver/encoder.py): delta refresh,
+identity reuse, job-row carry-forward, and the invalidation rules that
+keep them honest (ISSUE 1 tentpole)."""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from slurm_bridge_tpu.bridge.objects import Meta, Pod, PodSpec
+from slurm_bridge_tpu.bridge.scheduler import PlacementScheduler
+from slurm_bridge_tpu.bridge.store import ObjectStore
+from slurm_bridge_tpu.core.types import JobDemand, NodeInfo, PartitionInfo
+from slurm_bridge_tpu.solver.encoder import EncodedInventory, JobRowCache
+from slurm_bridge_tpu.solver.snapshot import (
+    encode_cluster,
+    encode_jobs,
+    random_inventory,
+)
+
+
+def _world(num_nodes=24, num_jobs=30, seed=7):
+    return random_inventory(
+        num_nodes, num_jobs, seed=seed, gpu_fraction=0.3, gang_fraction=0.2
+    )
+
+
+def _assert_snapshot_equal(a, b):
+    assert a.node_names == b.node_names
+    assert np.array_equal(a.capacity, b.capacity)
+    assert np.array_equal(a.free, b.free)
+    assert np.array_equal(a.partition_of, b.partition_of)
+    assert np.array_equal(a.features, b.features)
+    assert a.partition_codes == b.partition_codes
+
+
+# ---------------------------------------------------------- EncodedInventory
+
+
+def test_identity_refresh_is_a_hit_and_equal():
+    parts, nodes, _ = _world()
+    inv = EncodedInventory()
+    s1 = inv.refresh(nodes, parts)
+    rev = inv.rev
+    s2 = inv.refresh(nodes, parts)  # same list objects: the TTL window
+    assert inv.rev == rev and inv.last_delta_rows == 0
+    _assert_snapshot_equal(s1, s2)
+    _assert_snapshot_equal(s2, encode_cluster(nodes, parts))
+
+
+def test_refresh_hands_out_a_fresh_free_matrix():
+    """The scheduler releases incumbent usage into snapshot.free in place —
+    a shared array would leak one tick's release into the next."""
+    parts, nodes, _ = _world()
+    inv = EncodedInventory()
+    s1 = inv.refresh(nodes, parts)
+    s1.free[0] += 1000.0
+    s2 = inv.refresh(nodes, parts)
+    assert not np.array_equal(s1.free, s2.free)
+    _assert_snapshot_equal(s2, encode_cluster(nodes, parts))
+
+
+def test_delta_refresh_touches_only_the_changed_row():
+    parts, nodes, _ = _world()
+    inv = EncodedInventory()
+    before = inv.refresh(nodes, parts)
+    # fresh-but-equal objects (what a re-RPC delivers), one node drained
+    # with half its cpus allocated
+    nodes2 = [copy.copy(n) for n in nodes]
+    nodes2[5].alloc_cpus = nodes2[5].cpus // 2
+    nodes2[5].state = "DRAINED"
+    after = inv.refresh(nodes2, list(parts))
+    assert inv.last_delta_rows == 1
+    changed = np.nonzero((before.free != after.free).any(axis=1))[0]
+    assert changed.tolist() == [5]
+    assert after.free[5].sum() == 0  # drained ⇒ advertises nothing
+    _assert_snapshot_equal(after, encode_cluster(nodes2, parts))
+
+
+def test_delta_refresh_resume_and_feature_change():
+    parts, nodes, _ = _world()
+    inv = EncodedInventory()
+    inv.refresh(nodes, parts)
+    nodes2 = [copy.copy(n) for n in nodes]
+    nodes2[3].state = "DOWN"
+    nodes2[8].features = nodes2[8].features + ("newfeat",)
+    mid = inv.refresh(nodes2, list(parts))
+    assert inv.last_delta_rows == 2
+    assert mid.free[3].sum() == 0
+    assert "newfeat" in inv.feature_codes
+    nodes3 = [copy.copy(n) for n in nodes2]
+    nodes3[3].state = "IDLE"  # resume
+    after = inv.refresh(nodes3, list(parts))
+    assert inv.last_delta_rows == 1
+    assert after.free[3].sum() > 0
+    _assert_snapshot_equal(after, encode_cluster(nodes3, parts))
+
+
+def test_node_add_remove_rebuilds_but_keeps_feature_codes():
+    parts, nodes, _ = _world()
+    inv = EncodedInventory()
+    inv.refresh(nodes, parts)
+    rev = inv.rev
+    codes_before = dict(inv.feature_codes)
+    extra = NodeInfo(name="extra00", cpus=8, memory_mb=8192, state="IDLE",
+                     features=("brandnew",))
+    nodes2 = nodes + [extra]
+    parts2 = [
+        PartitionInfo(name=parts[0].name,
+                      nodes=parts[0].nodes + ("extra00",)),
+        *parts[1:],
+    ]
+    s = inv.refresh(nodes2, parts2)
+    assert inv.rev == rev + 1  # full rebuild
+    assert s.num_nodes == len(nodes) + 1
+    # stable bit assignment across rebuilds: old features keep their codes
+    for feat, code in codes_before.items():
+        assert inv.feature_codes[feat] == code
+    assert "brandnew" in inv.feature_codes
+
+
+def test_partition_layout_change_rebuilds():
+    parts, nodes, _ = _world()
+    inv = EncodedInventory()
+    s1 = inv.refresh(nodes, parts)
+    rev = inv.rev
+    parts2 = list(reversed(parts))  # same members, different codes
+    s2 = inv.refresh([copy.copy(n) for n in nodes], parts2)
+    assert inv.rev == rev + 1
+    _assert_snapshot_equal(s2, encode_cluster(nodes, parts2))
+    assert s2.partition_codes != s1.partition_codes
+
+
+# --------------------------------------------------------------- JobRowCache
+
+
+def test_job_rows_bit_identical_to_encode_jobs():
+    parts, nodes, demands = _world()
+    snap = encode_cluster(nodes, parts)
+    oracle = encode_jobs(demands, snap)
+    rows = JobRowCache()
+    keys = [(f"uid{j}", 0) for j in range(len(demands))]
+    got = rows.encode(keys, demands, snap, codes_token=(1, 1))
+    for f in ("demand", "partition_of", "req_features", "priority",
+              "gang_id", "job_of"):
+        assert np.array_equal(getattr(got, f), getattr(oracle, f)), f
+    assert rows.last_misses == len(demands)
+    # steady state: same keys, all hits, still identical, fresh arrays
+    again = rows.encode(keys, demands, snap, codes_token=(1, 1))
+    assert rows.last_hits == len(demands) and rows.last_misses == 0
+    assert again.demand is not got.demand
+    for f in ("demand", "partition_of", "req_features", "priority",
+              "gang_id", "job_of"):
+        assert np.array_equal(getattr(again, f), getattr(oracle, f)), f
+
+
+def test_job_rows_partial_churn_parses_only_arrivals():
+    parts, nodes, demands = _world(num_jobs=12)
+    snap = encode_cluster(nodes, parts)
+    rows = JobRowCache()
+    keys = [(f"uid{j}", 0) for j in range(len(demands))]
+    rows.encode(keys, demands, snap, codes_token=(1, 1))
+    # two pods depart, one arrives, one is re-submitted (generation bump)
+    demands2 = demands[2:] + [JobDemand(partition="part0", cpus_per_task=2)]
+    keys2 = keys[2:] + [("uidNEW", 0)]
+    keys2[0] = (keys2[0][0], 1)  # respec'd pod
+    got = rows.encode(keys2, demands2, snap, codes_token=(1, 1))
+    assert rows.last_misses == 2  # the arrival + the respec
+    assert rows.last_hits == len(demands2) - 2
+    oracle = encode_jobs(demands2, snap)
+    for f in ("demand", "partition_of", "req_features", "priority",
+              "gang_id", "job_of"):
+        assert np.array_equal(getattr(got, f), getattr(oracle, f)), f
+
+
+def test_job_rows_invalidated_by_codes_token():
+    """A grown feature table must re-resolve previously-impossible
+    requirements (the cached bit-31 sentinel would wrongly keep a job
+    unplaceable after its gres type joins the cluster)."""
+    parts, nodes, _ = _world()
+    demands = [JobDemand(partition="part0", gres="gpu:exotic:1",
+                         cpus_per_task=1)]
+    inv = EncodedInventory()
+    snap = inv.refresh(nodes, parts)
+    rows = JobRowCache()
+    keys = [("u1", 0)]
+    b1 = rows.encode(keys, demands, snap, codes_token=inv.codes_token())
+    assert b1.req_features[0] & np.uint32(1 << 31)  # unknown ⇒ impossible
+    # the exotic gpu type appears on a node
+    nodes2 = [copy.copy(n) for n in nodes]
+    nodes2[0].features = nodes2[0].features + ("exotic",)
+    snap2 = inv.refresh(nodes2, list(parts))
+    b2 = rows.encode(keys, demands, snap2, codes_token=inv.codes_token())
+    assert rows.last_misses == 1  # token moved: re-encoded
+    assert not (b2.req_features[0] & np.uint32(1 << 31))
+
+
+# ------------------------------------------------------ scheduler integration
+
+
+def _sched_world():
+    parts, nodes, demands = _world(num_nodes=16, num_jobs=8, seed=3)
+    pods = [
+        Pod(meta=Meta(name=f"pod{j}"),
+            spec=PodSpec(partition=d.partition, demand=d))
+        for j, d in enumerate(demands)
+    ]
+    return parts, nodes, demands, pods
+
+
+def test_solve_local_reuses_encode_across_ticks():
+    parts, nodes, demands, pods = _sched_world()
+    sched = PlacementScheduler(ObjectStore(), client=None, backend="greedy")
+    by_job1, lost1 = sched._solve_local(parts, nodes, demands, pods, len(pods))
+    assert lost1 == []
+    # second tick, same inventory objects (TTL window) and same pods:
+    # the job cache must serve every row
+    by_job2, _ = sched._solve_local(parts, nodes, demands, pods, len(pods))
+    assert sched._job_rows.last_hits == len(pods)
+    assert sched._job_rows.last_misses == 0
+    assert by_job1 == by_job2
+    assert sched._encoded.last_delta_rows == 0
+
+
+def test_solve_local_encode_survives_incumbent_release():
+    """Incumbent usage release mutates snapshot.free in place; with the
+    cached snapshot that mutation must not leak into the next tick."""
+    parts, nodes, demands, pods = _sched_world()
+    sched = PlacementScheduler(ObjectStore(), client=None, backend="greedy")
+    by_job, _ = sched._solve_local(parts, nodes, demands, pods, len(pods))
+    placed = {j: names for j, names in by_job.items() if names}
+    assert placed, "expected at least one placement"
+    j, names = next(iter(placed.items()))
+    pods[j].spec.node_name = "vnode"
+    pods[j].spec.placement_hint = tuple(names)
+    base_free = sched._encoded._free.copy()
+    pending = [p for i, p in enumerate(pods) if i != j]
+    dem2 = [p.spec.demand for p in pending] + [pods[j].spec.demand]
+    sched._solve_local(parts, nodes, dem2, pending + [pods[j]], len(pending))
+    assert np.array_equal(sched._encoded._free, base_free), (
+        "incumbent release leaked into the cached inventory"
+    )
+
+
+# ------------------------------------------------------ feature-drop counter
+
+
+def test_feature_mask_overflow_counts_and_warns(caplog):
+    """Satellite (ISSUE 1): a feature falling off the full 31-bit mask was
+    silently unmatchable; now it increments
+    sbt_encoder_features_dropped_total{feature=...} and rate-limit-logs."""
+    import logging
+
+    from slurm_bridge_tpu.solver import snapshot as snap_mod
+
+    nodes = [
+        NodeInfo(name=f"n{i}", cpus=4, memory_mb=4096, state="IDLE",
+                 features=(f"feat{i:02d}",))
+        for i in range(31)
+    ] + [
+        NodeInfo(name="n31", cpus=4, memory_mb=4096, state="IDLE",
+                 features=("overflowed",)),
+    ]
+    parts = [PartitionInfo(name="p", nodes=tuple(n.name for n in nodes))]
+    before = snap_mod._features_dropped.value()
+    snap_mod._last_drop_log[0] = 0.0  # reset the rate limiter
+    with caplog.at_level(logging.WARNING, logger="sbt.snapshot"):
+        s = encode_cluster(nodes, parts)
+    assert snap_mod._features_dropped.value() == before + 1
+    assert any("overflowed" in r.message for r in caplog.records)
+    assert "overflowed" not in s.feature_codes
+    assert s.features[31] == 0  # the node advertises no matchable feature
+    # rate limit: an immediate second encode must not log again
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="sbt.snapshot"):
+        encode_cluster(nodes, parts)
+    assert not caplog.records
+    assert snap_mod._features_dropped.value() == before + 2

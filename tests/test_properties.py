@@ -6,6 +6,11 @@ adversarial corners a generator finds)."""
 from __future__ import annotations
 
 import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed in this image"
+)
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -916,3 +916,122 @@ def test_choose_path_incumbent_dominance():
     inc = np.array([3, -1, 7, 2], np.int32)
     assert incumbent_fraction(inc) == 0.75
     assert incumbent_fraction(np.zeros(0, np.int32)) == 0.0
+
+
+# ------------------------------------------- vectorized-encoder equivalence
+
+
+def _fuzzed_world(rng):
+    """Adversarial typed inventory: overlapping partitions, orphan nodes,
+    composite/drained states, >31 distinct features (mask overflow), and
+    demands spanning gangs, arrays, and every gres form the parser knows."""
+    from slurm_bridge_tpu.core.types import NodeInfo, PartitionInfo
+
+    num_nodes = int(rng.integers(0, 40))
+    num_parts = int(rng.integers(1, 5))
+    states = ["IDLE", "MIXED", "ALLOCATED", "DOWN", "DRAINED", "IDLE+CLOUD",
+              "MIXED*", "COMPLETING", "MAINT", "ALLOC"]
+    pool = [f"feat{i:02d}" for i in range(40)]  # > 31 ⇒ overflow branch
+    nodes = []
+    for i in range(num_nodes):
+        cpus = int(rng.choice([8, 32, 64]))
+        nfeat = int(rng.integers(0, 5))
+        feats = tuple(rng.choice(pool, size=nfeat, replace=False))
+        nodes.append(NodeInfo(
+            name=f"n{i:03d}",
+            cpus=cpus,
+            alloc_cpus=int(rng.integers(0, cpus + 8)),  # may exceed cpus
+            memory_mb=cpus * 2048,
+            alloc_memory_mb=int(rng.integers(0, cpus * 2048)),
+            gpus=int(rng.choice([0, 4])),
+            alloc_gpus=int(rng.integers(0, 5)),
+            features=feats,
+            state=str(rng.choice(states)),
+        ))
+    partitions = []
+    for k in range(num_parts):
+        members = [n.name for n in nodes if rng.random() < 0.5]
+        partitions.append(PartitionInfo(name=f"p{k}", nodes=tuple(members)))
+    # some nodes end up in no partition, some in several — both must encode
+
+    num_jobs = int(rng.integers(0, 60))
+    gres_forms = ["", "gpu:4", "gpu:feat00:2", "gpu:feat39:1", "tpu:v4:8",
+                  "gpu:a100:2(S:0)", "gpu:bogus:notanint"]
+    arrays = ["", "0-3", "1,3,5", "0-15%4", "1-7:2"]
+    demands = [
+        JobDemand(
+            partition=str(rng.choice([p.name for p in partitions] + ["ghost"])),
+            cpus_per_task=int(rng.integers(0, 9)),
+            ntasks=int(rng.integers(0, 4)),
+            nodes=int(rng.integers(0, 5)),
+            mem_per_cpu_mb=int(rng.choice([0, 512, 2048])),
+            gres=str(rng.choice(gres_forms)),
+            array=str(rng.choice(arrays)),
+            priority=int(rng.integers(-5, 100)),
+        )
+        for _ in range(num_jobs)
+    ]
+    return partitions, nodes, demands
+
+
+def _assert_batch_identical(a, b):
+    for f in ("demand", "partition_of", "req_features", "priority",
+              "gang_id", "job_of"):
+        av, bv = getattr(a, f), getattr(b, f)
+        assert av.dtype == bv.dtype, f
+        assert np.array_equal(av, bv), f
+
+
+def test_vectorized_encoders_match_loop_oracle_fuzzed():
+    """The vectorized encoders are BIT-identical to the kept-as-oracle loop
+    encoders — arrays, dtypes, code tables, insertion order — across
+    randomized worlds covering gang shards, gres parsing, unschedulable
+    nodes and feature-mask overflow (ISSUE 1 acceptance)."""
+    from slurm_bridge_tpu.solver.snapshot import (
+        encode_cluster_loop,
+        encode_jobs_loop,
+    )
+
+    for seed in range(25):
+        rng = np.random.default_rng(seed)
+        partitions, nodes, demands = _fuzzed_world(rng)
+        s_vec = encode_cluster(nodes, partitions)
+        s_loop = encode_cluster_loop(nodes, partitions)
+        assert s_vec.node_names == s_loop.node_names, seed
+        for f in ("capacity", "free", "partition_of", "features"):
+            av, bv = getattr(s_vec, f), getattr(s_loop, f)
+            assert av.dtype == bv.dtype, (seed, f)
+            assert np.array_equal(av, bv), (seed, f)
+        # dict EQUALITY INCLUDING insertion order: code values encode order
+        assert list(s_vec.partition_codes.items()) == list(
+            s_loop.partition_codes.items()
+        ), seed
+        assert list(s_vec.feature_codes.items()) == list(
+            s_loop.feature_codes.items()
+        ), seed
+        b_vec = encode_jobs(demands, s_vec)
+        b_loop = encode_jobs_loop(demands, s_loop)
+        _assert_batch_identical(b_vec, b_loop)
+        # explicit-priorities path too
+        prios = [float(x) for x in rng.uniform(-10, 10, size=len(demands))]
+        _assert_batch_identical(
+            encode_jobs(demands, s_vec, priorities=prios),
+            encode_jobs_loop(demands, s_loop, priorities=prios),
+        )
+
+
+def test_vectorized_encoder_seeded_feature_codes():
+    """A pre-seeded feature table (the EncodedInventory rebuild path) maps
+    identically through both encoders."""
+    from slurm_bridge_tpu.solver.snapshot import encode_cluster_loop
+
+    rng = np.random.default_rng(99)
+    partitions, nodes, _ = _fuzzed_world(rng)
+    seeded = {"warm0": 0, "warm1": 1}
+    s_vec = encode_cluster(nodes, partitions, feature_codes=seeded)
+    s_loop = encode_cluster_loop(nodes, partitions, feature_codes=seeded)
+    assert list(s_vec.feature_codes.items()) == list(
+        s_loop.feature_codes.items()
+    )
+    assert np.array_equal(s_vec.features, s_loop.features)
+    assert seeded == {"warm0": 0, "warm1": 1}  # caller's dict untouched
