@@ -4,7 +4,8 @@
 # workflows can never drift.
 
 .PHONY: help test fast check generate apidoc hygiene bench bench-smoke \
-        scenarios docker-build install uninstall deploy undeploy run demo
+        sim-smoke sim sim-bench scenarios docker-build install uninstall \
+        deploy undeploy run demo
 
 help: ## Display this help.
 	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ \
@@ -16,7 +17,7 @@ test: ## Full suite + graft compile contracts + hygiene (ref: make test).
 fast: ## ~2-min signal: everything not marked slow.
 	python -m pytest tests/ -q -m "not slow"
 
-check: test bench-smoke ## Alias the reference's CI verb (+ encode gate).
+check: test bench-smoke sim-smoke ## Alias the reference's CI verb (+ encode & sim gates).
 
 generate: ## Regenerate protobuf bindings + API docs (ref: make generate).
 	hack/regen-proto.sh
@@ -33,6 +34,15 @@ bench: ## The driver-contract headline benchmark (one JSON line).
 
 bench-smoke: ## 5k×1k end-to-end tick; fails on an encode regression.
 	python -m benchmarks.ticksmoke
+
+sim-smoke: ## Small-shape sim scenarios, double-run: determinism + invariants.
+	python -m slurm_bridge_tpu.sim --smoke
+
+sim: ## Run every fast sim scenario full-size (see --list for names).
+	python -m slurm_bridge_tpu.sim --all
+
+sim-bench: ## The slow 50k×10k full-bridge tick headline (minutes).
+	python -m slurm_bridge_tpu.sim full_50kx10k
 
 scenarios: ## The five BASELINE scenarios.
 	python -m benchmarks.scenarios --json

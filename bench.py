@@ -171,7 +171,10 @@ def _acquire_backend() -> str:
     # window is generous, but a wedge that survived it rarely clears, and
     # the total must leave room for the forced-CPU solve inside whatever
     # patience the outer harness has
-    budget_env = os.environ.get("SBT_BENCH_TPU_BUDGET")
+    # empty string means UNSET (ADVICE r5 #4): `SBT_BENCH_TPU_BUDGET= python
+    # bench.py` must keep the known-dead-chip short-circuit AND the default
+    # budget, not disable the former while silently using the latter
+    budget_env = os.environ.get("SBT_BENCH_TPU_BUDGET") or None
     budget = float(budget_env or "600") / (2 ** (attempt - 1))
     # VERDICT r4 #3: when the availability watcher (hack/chip-watch.sh →
     # utils/chipstate.py) has the chip on record as dead — ≥2 consecutive
@@ -279,7 +282,7 @@ def main() -> None:
     # the watchdog must outlive one full probe budget plus the solve —
     # a fixed constant would silently cut SBT_BENCH_TPU_BUDGET short,
     # skipping the promised stack dump / re-exec attempts
-    budget = float(os.environ.get("SBT_BENCH_TPU_BUDGET", "600"))
+    budget = float(os.environ.get("SBT_BENCH_TPU_BUDGET") or "600")
     _start_watchdog(budget + 900.0)
     backend = _acquire_backend()
 

@@ -1,0 +1,7 @@
+"""``python -m benchmarks.scenarios`` — the five BASELINE solver
+scenarios (see ``__init__.py``). The sim-driven full-bridge scenarios
+live beside this file as ``sim_*.py``, each runnable on its own."""
+
+from benchmarks.scenarios import main
+
+main()

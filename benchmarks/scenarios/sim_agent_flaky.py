@@ -1,0 +1,20 @@
+"""Sim scenario: agent RPC flaps — 30% UNAVAILABLE on SubmitJob/JobInfo.
+
+Exercises the transient-RPC ride-out (vnode.py), the submit ledger's
+idempotency under retries, and recovery after the flap clears (the
+smoke gate asserts ``recovery_ticks`` is recorded).
+
+    python -m benchmarks.scenarios.sim_agent_flaky [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.agent_flaky_rpc``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import agent_flaky_rpc as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "agent_flaky_rpc"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

@@ -1,0 +1,19 @@
+"""Sim scenario: cold-start burst — the whole queue arrives at tick 0.
+
+Gang-heavy front-loaded backlog; measures how the full bridge digests a
+cold start (the headline shape's arrival pattern, scaled down).
+
+    python -m benchmarks.scenarios.sim_burst_backlog [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.burst_backlog``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import burst_backlog as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "burst_backlog"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

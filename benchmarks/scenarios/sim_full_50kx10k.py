@@ -1,0 +1,22 @@
+"""Sim scenario: the SLOW headline — 50k pods × 10k nodes through the
+FULL bridge pipeline (store → encode → solve → bind → mirror).
+
+Records ``full_tick_p50_ms_50kx10k`` with the per-phase breakdown — the
+previously-unmeasured number the round-5 VERDICT called out (the solver
+was 63 ms at this shape; the product path around it was never driven).
+Takes minutes; excluded from sim-smoke, run via the slow-marked test or
+
+    python -m benchmarks.scenarios.sim_full_50kx10k
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.full_50kx10k``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import full_50kx10k as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "full_50kx10k"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

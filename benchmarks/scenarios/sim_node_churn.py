@@ -1,0 +1,21 @@
+"""Sim scenario: node churn — 20% of nodes drain mid-run while the
+inventory lies (stale snapshots) and status updates go missing.
+
+The scheduler must ride out a shrinking, stale inventory and drain once
+the nodes resume; the stale window is excluded from the per-tick
+bind-fit check (ground-truth capacity is still asserted every tick).
+
+    python -m benchmarks.scenarios.sim_node_churn [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.node_churn``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import node_churn as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "node_churn"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

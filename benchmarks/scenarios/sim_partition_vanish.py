@@ -1,0 +1,20 @@
+"""Sim scenario: a whole partition disappears mid-run, then returns.
+
+The configurator tears the virtual node down (NODE_GONE), pending pods
+for the partition wait as Unschedulable, and everything converges when
+the agent lists the partition again.
+
+    python -m benchmarks.scenarios.sim_partition_vanish [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.partition_vanish``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import partition_vanish as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "partition_vanish"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

@@ -1,0 +1,20 @@
+"""Sim scenario: preemption storm — a priority-1000 burst displaces
+incumbents (scheduler preemption mode on).
+
+Asserts the displaced pods are cancelled + requeued without double-bind
+or gang-atomicity breaches, and that the queue still drains.
+
+    python -m benchmarks.scenarios.sim_preemption_storm [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.preemption_storm``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import preemption_storm as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "preemption_storm"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

@@ -1,0 +1,19 @@
+"""Sim scenario: steady Poisson arrivals, no faults — the baseline.
+
+Mixed cpu/mem/GPU demand over a heterogeneous 4-partition cluster; the
+determinism and queue-drain reference point for the fault scenarios.
+
+    python -m benchmarks.scenarios.sim_steady_poisson [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.steady_poisson``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import steady_poisson as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "steady_poisson"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

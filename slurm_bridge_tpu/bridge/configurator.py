@@ -34,13 +34,20 @@ class Configurator:
         watch_interval: float = DEFAULT_WATCH_INTERVAL_S,
         node_sync_interval: float = 1.0,
         pod_sync_workers: int = 10,
+        provider_inventory_ttl: float | None = None,
     ):
         self.store = store
         self.client = client
         self.agent_endpoint = agent_endpoint
         self.events = events or EventRecorder()
+        #: ``node_sync_interval <= 0`` disables the per-partition sync
+        #: tickers entirely — the embedder (e.g. the sim harness, which
+        #: must stay single-threaded for determinism) drives ``sync_now()``
         self.node_sync_interval = node_sync_interval
         self.pod_sync_workers = pod_sync_workers
+        #: forwarded to each provider; ``None`` keeps the provider default
+        #: (the sim sets 0 so no wall-clock cache window leaks in)
+        self.provider_inventory_ttl = provider_inventory_ttl
         self.providers: dict[str, VirtualNodeProvider] = {}
         self._tickers: dict[str, Ticker] = {}
         self._watch = Ticker(watch_interval, self.reconcile, name="configurator")
@@ -57,8 +64,12 @@ class Configurator:
             # shut the pod-sync pools: their threads are non-daemon and
             # would outlive a stopped Bridge (long-lived embedders/tests
             # cycling bridges would accumulate 10 idle threads per
-            # partition per cycle)
-            p.deregister()
+            # partition per cycle). close(), NOT deregister() — a clean
+            # stop (leader step-down, embedder cycling) must not delete
+            # the VirtualNodes: the NodePodMirror propagates deletions to
+            # the real apiserver and the nodes would flap across restarts
+            # (ADVICE r5 #1); only _remove_partition deletes nodes.
+            p.close()
 
     def reconcile(self) -> None:
         """Diff live partitions vs registered providers (:120-184)."""
@@ -74,6 +85,9 @@ class Configurator:
             p.sync()
 
     def _add_partition(self, partition: str) -> None:
+        kwargs = {}
+        if self.provider_inventory_ttl is not None:
+            kwargs["inventory_ttl"] = self.provider_inventory_ttl
         provider = VirtualNodeProvider(
             self.store,
             self.client,
@@ -81,14 +95,16 @@ class Configurator:
             agent_endpoint=self.agent_endpoint,
             events=self.events,
             sync_workers=self.pod_sync_workers,
+            **kwargs,
         )
         provider.register()
         self.providers[partition] = provider
-        ticker = Ticker(
-            self.node_sync_interval, provider.sync, name=f"vnode-{partition}"
-        )
-        ticker.start()
-        self._tickers[partition] = ticker
+        if self.node_sync_interval > 0:
+            ticker = Ticker(
+                self.node_sync_interval, provider.sync, name=f"vnode-{partition}"
+            )
+            ticker.start()
+            self._tickers[partition] = ticker
         log.info("partition %s: virtual node %s up", partition, provider.node_name)
 
     def _remove_partition(self, partition: str) -> None:

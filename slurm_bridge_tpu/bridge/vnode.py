@@ -194,7 +194,16 @@ class VirtualNodeProvider:
 
         return self.store.mutate(VirtualNode.KIND, self.node_name, refresh)
 
-    def deregister(self) -> None:
+    def close(self) -> None:
+        """Shut the pod-sync pool WITHOUT deleting the store node.
+
+        This is the clean-shutdown half of the old ``deregister()``
+        (ADVICE r5 #1): Configurator.stop() — every Bridge.stop(), leader
+        step-down, embedder cycle — must stop the non-daemon worker
+        threads, but deleting the VirtualNode there made node objects
+        flap across restarts (the NodePodMirror propagates the deletion
+        to the real apiserver). Only partition removal deletes the node.
+        """
         with self._pool_lock:
             pool, self._pool = self._pool, None
             self._pool_closed = True
@@ -202,6 +211,11 @@ class VirtualNodeProvider:
             # no cancel_futures: a sync in flight finishes converging its
             # pods; the workers exit once the queue drains
             pool.shutdown(wait=False)
+
+    def deregister(self) -> None:
+        """Tear down for real: close the pool AND delete the store node
+        (the partition vanished — _remove_partition's path)."""
+        self.close()
         try:
             self.store.delete(VirtualNode.KIND, self.node_name)
         except NotFound:

@@ -1,0 +1,5 @@
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+
+sys.exit(main())

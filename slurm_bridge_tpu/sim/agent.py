@@ -1,0 +1,433 @@
+"""In-process fake Slurm agent — the simulator's ground-truth cluster.
+
+Duck-types the ``WorkloadManager`` :class:`ServiceClient` surface the
+bridge dials (Partitions/Partition/Nodes/SubmitJob/JobInfo/JobState/
+CancelJob), so the REAL bridge components — configurator, virtual-node
+providers, placement scheduler — run unmodified against it with zero
+gRPC or subprocess cost. Behind the client sits :class:`SimCluster`, a
+deterministic model of Slurm's side of the contract:
+
+- submission allocates immediately when the requested node set fits
+  (honouring ``--nodelist`` hints, falling back to first-fit over the
+  partition), otherwise the job queues PENDING — exactly the lag the
+  statusmap translation layer has to ride out;
+- jobs run for ``time_limit_s`` *virtual* seconds (the trace generator
+  stamps each job's duration there) and complete when the harness
+  advances the clock past their end time — no wall-clock sleeps anywhere;
+- allocation is guarded: a start that would oversubscribe any node's
+  capacity raises, so the "capacity never oversubscribed" invariant is
+  enforced by ground truth, not just sampled;
+- the submit ledger dedupes by ``submitter_id`` like the real agent
+  (``agent/server.py``), keeping retried submissions idempotent under
+  injected RPC faults.
+
+Time is a ``clock()`` callable supplied by the harness (virtual seconds
+since scenario start); determinism needs no patching of ``time``.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass, field
+
+from slurm_bridge_tpu.core.arrays import array_len
+from slurm_bridge_tpu.core.scontrol import parse_gres_gpus
+from slurm_bridge_tpu.core.types import JobInfo, JobStatus, NodeInfo, PartitionInfo
+from slurm_bridge_tpu.wire import pb
+from slurm_bridge_tpu.wire.convert import (
+    job_info_to_proto,
+    node_to_proto,
+    partition_to_proto,
+)
+
+log = logging.getLogger("sbt.sim.agent")
+
+
+class OversubscribedError(AssertionError):
+    """A job start would exceed a node's capacity — ground-truth invariant
+    breach (the scheduler or the sim's own fit check mis-accounted)."""
+
+
+@dataclass
+class SimNode:
+    """One simulated Slurm node: static capacity + live allocation."""
+
+    name: str
+    cpus: int
+    memory_mb: int
+    gpus: int = 0
+    gpu_type: str = ""
+    features: tuple[str, ...] = ()
+    #: pre-existing (non-sim-job) allocation, as random_inventory models it
+    base_alloc_cpus: int = 0
+    base_alloc_memory_mb: int = 0
+    state: str = "IDLE"
+    #: live allocation from sim jobs
+    job_cpus: int = 0
+    job_memory_mb: int = 0
+    job_gpus: int = 0
+
+    @property
+    def alloc_cpus(self) -> int:
+        return self.base_alloc_cpus + self.job_cpus
+
+    @property
+    def alloc_memory_mb(self) -> int:
+        return self.base_alloc_memory_mb + self.job_memory_mb
+
+    def info(self) -> NodeInfo:
+        state = self.state
+        if state in ("IDLE", "MIXED") and not self.drained:
+            state = "MIXED" if (self.alloc_cpus or self.job_gpus) else "IDLE"
+        return NodeInfo(
+            name=self.name,
+            cpus=self.cpus,
+            alloc_cpus=min(self.cpus, self.alloc_cpus),
+            memory_mb=self.memory_mb,
+            alloc_memory_mb=min(self.memory_mb, self.alloc_memory_mb),
+            gpus=self.gpus,
+            alloc_gpus=min(self.gpus, self.job_gpus),
+            gpu_type=self.gpu_type,
+            features=self.features,
+            state=state,
+        )
+
+    @property
+    def drained(self) -> bool:
+        return "DRAIN" in self.state or "DOWN" in self.state
+
+
+@dataclass
+class SimJob:
+    """One submitted job — per-node quantities, gang-expanded over
+    ``num_nodes`` distinct nodes (Slurm ``--nodes`` semantics)."""
+
+    id: int
+    name: str
+    submitter_id: str
+    partition: str
+    num_nodes: int
+    cpus_per_node: int
+    mem_per_node_mb: int
+    gpus_per_node: int
+    duration_s: float
+    priority: int
+    nodelist: tuple[str, ...] = ()
+    state: JobStatus = JobStatus.PENDING
+    submit_vt: float = 0.0
+    start_vt: float = -1.0
+    end_vt: float = -1.0
+    assigned: tuple[str, ...] = ()
+    reason: str = ""
+
+    def info(self, now: float | None = None) -> JobInfo:
+        # elapsed runtime like Slurm's RunTime: virtual now, capped at the
+        # job's end — NOT the planned duration (a job 1 s into a 120 s run
+        # must not already read as at its limit)
+        if self.start_vt < 0:
+            run_time = 0
+        elif now is None:
+            run_time = int(max(0.0, self.end_vt - self.start_vt))
+        else:
+            run_time = int(max(0.0, min(now, self.end_vt) - self.start_vt))
+        return JobInfo(
+            id=self.id,
+            name=self.name,
+            state=self.state,
+            run_time_s=run_time,
+            time_limit_s=int(self.duration_s),
+            partition=self.partition,
+            node_list=",".join(self.assigned),
+            batch_host=self.assigned[0] if self.assigned else "",
+            num_nodes=self.num_nodes,
+            std_out=f"/sim/{self.id}.out",
+            std_err=f"/sim/{self.id}.out",
+            reason=self.reason,
+        )
+
+
+@dataclass
+class SimStats:
+    submitted: int = 0
+    deduped: int = 0
+    started: int = 0
+    completed: int = 0
+    cancelled: int = 0
+
+    def as_dict(self) -> dict:
+        return {
+            "submitted": self.submitted,
+            "deduped": self.deduped,
+            "started": self.started,
+            "completed": self.completed,
+            "cancelled": self.cancelled,
+        }
+
+
+class SimCluster:
+    """Deterministic ground-truth Slurm: nodes, partitions, job lifecycle.
+
+    Every mutation happens either in an RPC handler (submit/cancel) or in
+    :meth:`step` — both driven synchronously by the harness, so identical
+    call sequences yield identical state (no threads, no wall clock).
+    """
+
+    def __init__(
+        self,
+        nodes: list[SimNode],
+        partitions: dict[str, tuple[str, ...]],
+        *,
+        clock,
+        default_duration_s: float = 30.0,
+    ):
+        self.nodes: dict[str, SimNode] = {n.name: n for n in nodes}
+        self.partitions = dict(partitions)
+        self.hidden: set[str] = set()
+        self.jobs: dict[int, SimJob] = {}
+        self.clock = clock
+        self.default_duration_s = default_duration_s
+        self.stats = SimStats()
+        self._ledger: dict[str, int] = {}
+        self._next_id = 1000
+        self._queue: list[int] = []  # PENDING job ids, submit order
+
+    # ---- inventory ----
+
+    def visible_partitions(self) -> list[str]:
+        return [p for p in self.partitions if p not in self.hidden]
+
+    def partition_info(self, name: str) -> PartitionInfo:
+        members = self.partitions[name]
+        total_cpus = sum(self.nodes[m].cpus for m in members)
+        return PartitionInfo(
+            name=name,
+            nodes=tuple(members),
+            total_cpus=total_cpus,
+            total_nodes=len(members),
+        )
+
+    def node_infos(self, names: list[str]) -> list[NodeInfo]:
+        return [self.nodes[n].info() for n in names if n in self.nodes]
+
+    # ---- fault-plan surface (mutated by the harness, not by RPCs) ----
+
+    def drain(self, names: list[str]) -> None:
+        for n in names:
+            node = self.nodes.get(n)
+            if node is not None and not node.drained:
+                node.state = "DRAINED"
+
+    def resume(self, names: list[str]) -> None:
+        for n in names:
+            node = self.nodes.get(n)
+            if node is not None and node.drained:
+                node.state = "IDLE"
+
+    def hide_partition(self, name: str) -> None:
+        self.hidden.add(name)
+
+    def show_partition(self, name: str) -> None:
+        self.hidden.discard(name)
+
+    # ---- job lifecycle ----
+
+    def submit(self, req: pb.SubmitJobRequest) -> int:
+        submitter = req.submitter_id
+        if submitter and submitter in self._ledger:
+            self.stats.deduped += 1
+            return self._ledger[submitter]
+        arr = array_len(req.array) if req.array else 1
+        total_cpus = (
+            max(1, int(req.cpus_per_task)) * max(1, int(req.ntasks)) * max(1, arr)
+        )
+        nnodes = max(1, int(req.nodes))
+        cpus_per_node = math.ceil(total_cpus / nnodes)
+        mem_per_node = math.ceil(int(req.mem_per_cpu_mb) * total_cpus / nnodes)
+        gpus_per_node, _ = parse_gres_gpus(req.gres) if req.gres else (0, "")
+        job = SimJob(
+            id=self._next_id,
+            name=req.job_name or f"job-{self._next_id}",
+            submitter_id=submitter,
+            partition=req.partition,
+            num_nodes=nnodes,
+            cpus_per_node=cpus_per_node,
+            mem_per_node_mb=mem_per_node,
+            gpus_per_node=gpus_per_node * max(1, arr),
+            duration_s=float(req.time_limit_s) or self.default_duration_s,
+            priority=int(req.priority),
+            nodelist=tuple(req.nodelist),
+            submit_vt=self.clock(),
+        )
+        self._next_id += 1
+        self.jobs[job.id] = job
+        if submitter:
+            self._ledger[submitter] = job.id
+        self.stats.submitted += 1
+        if not self._try_start(job):
+            self._queue.append(job.id)
+        return job.id
+
+    def cancel(self, job_id: int) -> None:
+        job = self.jobs.get(job_id)
+        if job is None or job.state.is_terminal:
+            return  # scancel of an unknown/finished job is a no-op
+        if job.state == JobStatus.RUNNING:
+            self._free(job)
+        job.state = JobStatus.CANCELLED
+        job.end_vt = self.clock()
+        self.stats.cancelled += 1
+
+    def step(self) -> None:
+        """Advance the cluster to the current virtual time: complete jobs
+        whose runtime elapsed, then start queued jobs that now fit."""
+        now = self.clock()
+        for job in self.jobs.values():
+            if job.state == JobStatus.RUNNING and job.end_vt <= now:
+                self._free(job)
+                job.state = JobStatus.COMPLETED
+                self.stats.completed += 1
+        still: list[int] = []
+        for jid in self._queue:
+            job = self.jobs[jid]
+            if job.state != JobStatus.PENDING:
+                continue  # cancelled while queued
+            if not self._try_start(job):
+                still.append(jid)
+        self._queue = still
+
+    def _fits(self, node: SimNode, job: SimJob) -> bool:
+        if node.drained:
+            return False
+        return (
+            node.alloc_cpus + job.cpus_per_node <= node.cpus
+            and node.alloc_memory_mb + job.mem_per_node_mb <= node.memory_mb
+            and node.job_gpus + job.gpus_per_node <= node.gpus
+        )
+
+    def _try_start(self, job: SimJob) -> bool:
+        if job.partition in self.hidden or job.partition not in self.partitions:
+            job.reason = f"partition {job.partition!r} unavailable"
+            return False
+        chosen: list[str] = []
+        # the solver's --nodelist hint first, in hint order; Slurm remains
+        # the final arbiter, so an infeasible hint falls back to first-fit
+        for name in job.nodelist:
+            node = self.nodes.get(name)
+            if node is not None and name not in chosen and self._fits(node, job):
+                chosen.append(name)
+                if len(chosen) == job.num_nodes:
+                    break
+        if len(chosen) < job.num_nodes:
+            for name in self.partitions[job.partition]:
+                if name in chosen:
+                    continue
+                if self._fits(self.nodes[name], job):
+                    chosen.append(name)
+                    if len(chosen) == job.num_nodes:
+                        break
+        if len(chosen) < job.num_nodes:
+            job.reason = "Resources"
+            return False
+        for name in chosen:
+            node = self.nodes[name]
+            node.job_cpus += job.cpus_per_node
+            node.job_memory_mb += job.mem_per_node_mb
+            node.job_gpus += job.gpus_per_node
+            if (
+                node.alloc_cpus > node.cpus
+                or node.alloc_memory_mb > node.memory_mb
+                or node.job_gpus > node.gpus
+            ):
+                raise OversubscribedError(
+                    f"node {name} oversubscribed by job {job.id}"
+                )
+        job.assigned = tuple(chosen)
+        job.state = JobStatus.RUNNING
+        job.start_vt = self.clock()
+        job.end_vt = job.start_vt + job.duration_s
+        job.reason = ""
+        self.stats.started += 1
+        return True
+
+    def _free(self, job: SimJob) -> None:
+        for name in job.assigned:
+            node = self.nodes.get(name)
+            if node is None:
+                continue
+            node.job_cpus -= job.cpus_per_node
+            node.job_memory_mb -= job.mem_per_node_mb
+            node.job_gpus -= job.gpus_per_node
+
+    # ---- introspection for invariants/metrics ----
+
+    def running_jobs(self) -> list[SimJob]:
+        return [j for j in self.jobs.values() if j.state == JobStatus.RUNNING]
+
+    def pending_jobs(self) -> list[SimJob]:
+        return [j for j in self.jobs.values() if j.state == JobStatus.PENDING]
+
+
+class SimWorkloadClient:
+    """The ``WorkloadManager`` client surface over a :class:`SimCluster`.
+
+    Method-for-method compatible with the dynamic :class:`ServiceClient`
+    stub (``wire/rpc.py``) for every RPC the bridge dials; each method
+    accepts the stub's keyword ``timeout`` and ignores it (there is no
+    wall-clock in the simulator). Unknown-partition/unknown-file errors
+    surface as :class:`SimRpcError` so the bridge's grpc error handling
+    runs for real.
+    """
+
+    def __init__(self, cluster: SimCluster):
+        self.cluster = cluster
+
+    def close(self) -> None:  # ServiceClient parity
+        pass
+
+    # ---- inventory RPCs ----
+
+    def Partitions(self, request, timeout=None) -> pb.PartitionsResponse:
+        return pb.PartitionsResponse(partitions=self.cluster.visible_partitions())
+
+    def Partition(self, request, timeout=None) -> pb.PartitionResponse:
+        name = request.partition
+        if name in self.cluster.hidden or name not in self.cluster.partitions:
+            from slurm_bridge_tpu.sim.faults import SimRpcError
+            import grpc
+
+            raise SimRpcError(
+                grpc.StatusCode.NOT_FOUND, f"partition {name!r} not found"
+            )
+        return partition_to_proto(self.cluster.partition_info(name))
+
+    def Nodes(self, request, timeout=None) -> pb.NodesResponse:
+        infos = self.cluster.node_infos(list(request.names))
+        return pb.NodesResponse(nodes=[node_to_proto(n) for n in infos])
+
+    # ---- job RPCs ----
+
+    def SubmitJob(self, request, timeout=None) -> pb.SubmitJobResponse:
+        return pb.SubmitJobResponse(job_id=self.cluster.submit(request))
+
+    def CancelJob(self, request, timeout=None) -> pb.CancelJobResponse:
+        self.cluster.cancel(int(request.job_id))
+        return pb.CancelJobResponse()
+
+    def JobInfo(self, request, timeout=None) -> pb.JobInfoResponse:
+        job = self.cluster.jobs.get(int(request.job_id))
+        if job is None:
+            from slurm_bridge_tpu.sim.faults import SimRpcError
+            import grpc
+
+            raise SimRpcError(
+                grpc.StatusCode.NOT_FOUND, f"job {request.job_id} not found"
+            )
+        return pb.JobInfoResponse(
+            info=[job_info_to_proto(job.info(now=self.cluster.clock()))]
+        )
+
+    def JobState(self, request, timeout=None) -> pb.JobStateResponse:
+        job = self.cluster.jobs.get(int(request.job_id))
+        status = int(job.state) if job is not None else int(JobStatus.UNKNOWN)
+        return pb.JobStateResponse(status=status)

@@ -1,0 +1,153 @@
+"""``python -m slurm_bridge_tpu.sim`` — run simulation scenarios.
+
+    python -m slurm_bridge_tpu.sim --list
+    python -m slurm_bridge_tpu.sim steady_poisson node_churn --seed 7
+    python -m slurm_bridge_tpu.sim --all --scale 0.25
+    python -m slurm_bridge_tpu.sim --smoke          # the `make sim-smoke` gate
+    python -m slurm_bridge_tpu.sim full_50kx10k     # slow headline (minutes)
+
+One JSON object per scenario on stdout; ``--out`` additionally writes the
+array to a file. The headline scenario also emits a one-line
+``{"metric": "full_tick_p50_ms_50kx10k", ...}`` record, bench.py-style.
+
+``--smoke`` runs every fast scenario at a toy scale TWICE with the same
+seed and fails (exit 1) unless (a) the deterministic metrics sections are
+byte-identical, (b) no invariant was violated, and (c) every fault
+scenario that expects to drain actually recovered — the CI determinism +
+recovery gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from slurm_bridge_tpu.sim.harness import run_scenario
+from slurm_bridge_tpu.sim.scenarios import SCENARIOS, SMOKE_SCENARIOS
+
+SMOKE_SCALE = 0.12
+
+
+def _build(name: str, *, seed: int | None, scale: float, ticks: int | None):
+    sc = SCENARIOS[name](scale=scale, **({"seed": seed} if seed is not None else {}))
+    if ticks is not None:
+        sc = dataclasses.replace(sc, ticks=ticks)
+    return sc
+
+
+def _headline(result) -> dict:
+    t = result.timing
+    return {
+        "metric": f"full_tick_p50_ms_{result.shape['pods'] // 1000}kx"
+        f"{result.shape['nodes'] // 1000}k",
+        "value": t["tick_p50_ms"],
+        "unit": "ms",
+        "p95_ms": t["tick_p95_ms"],
+        "phases_p50_ms": t["phases_p50_ms"],
+        "pods": result.shape["pods"],
+        "nodes": result.shape["nodes"],
+        "bound_total": result.determinism["bound_total"],
+        "invariant_violations": len(result.determinism["invariant_violations"]),
+    }
+
+
+def _smoke() -> int:
+    failures: list[str] = []
+    for name in SMOKE_SCENARIOS:
+        runs = []
+        for _ in range(2):
+            sc = _build(name, seed=None, scale=SMOKE_SCALE, ticks=None)
+            runs.append(run_scenario(sc))
+        a, b = runs
+        det_a, det_b = a.determinism_json(), b.determinism_json()
+        line = {
+            "scenario": name,
+            "deterministic": det_a == det_b,
+            "violations": len(a.determinism["invariant_violations"]),
+            "bound_total": a.determinism["bound_total"],
+            "pending_final": a.determinism["pending_final"],
+            "recovery_ticks": a.determinism["recovery_ticks"],
+            "tick_p50_ms": a.timing["tick_p50_ms"],
+        }
+        print(json.dumps(line))
+        if det_a != det_b:
+            failures.append(f"{name}: determinism broke (same seed, different run)")
+        if a.determinism["invariant_violations"]:
+            first = a.determinism["invariant_violations"][0]
+            failures.append(f"{name}: invariant violated: {first}")
+        if a.scenario.faults and a.scenario.expect_drain:
+            if a.determinism["recovery_ticks"] is None:
+                failures.append(f"{name}: never recovered after fault window")
+    if failures:
+        for f in failures:
+            print(f"# sim-smoke FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"# sim-smoke OK: {len(SMOKE_SCENARIOS)} scenarios, deterministic, "
+          "invariants held", file=sys.stderr)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m slurm_bridge_tpu.sim",
+        description="deterministic cluster simulator + fault harness",
+    )
+    parser.add_argument("scenarios", nargs="*", help="scenario names (see --list)")
+    parser.add_argument("--list", action="store_true", help="list scenarios")
+    parser.add_argument("--all", action="store_true",
+                        help="run every fast scenario")
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI gate: toy scale, double-run determinism check")
+    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--scale", type=float, default=1.0,
+                        help="multiply pod/node counts (default 1.0)")
+    parser.add_argument("--ticks", type=int, default=None)
+    parser.add_argument("--out", default="",
+                        help="also write the result array to this JSON file")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name, f in SCENARIOS.items():
+            sc = f()
+            slow = " [slow]" if sc.slow else ""
+            print(f"{name}{slow}: {sc.description}")
+        return 0
+    if args.smoke:
+        return _smoke()
+
+    names = args.scenarios or (list(SMOKE_SCENARIOS) if args.all else [])
+    if not names:
+        parser.error("name at least one scenario, or use --all / --smoke / --list")
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        parser.error(f"unknown scenarios {unknown}; see --list")
+
+    results = []
+    for name in names:
+        sc = _build(name, seed=args.seed, scale=args.scale, ticks=args.ticks)
+        print(f"# running {name} "
+              f"(~{sc.workload.jobs} jobs x {sc.cluster.num_nodes} nodes, "
+              f"{sc.ticks} ticks)", file=sys.stderr, flush=True)
+        result = run_scenario(sc)
+        results.append(result)
+        print(json.dumps(result.as_dict()), flush=True)
+        if name == "full_50kx10k":
+            print(json.dumps(_headline(result)), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump([r.as_dict() for r in results], f, indent=1, sort_keys=True)
+    bad = [
+        r.scenario.name
+        for r in results
+        if r.determinism["invariant_violations"]
+    ]
+    if bad:
+        print(f"# invariant violations in: {', '.join(bad)}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

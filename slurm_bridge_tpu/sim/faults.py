@@ -1,0 +1,199 @@
+"""Composable fault injection for the simulator.
+
+A :class:`FaultPlan` is a set of :class:`Fault` windows over the tick
+axis. Two delivery mechanisms:
+
+- **RPC-level** faults ride a :class:`FaultyClient` wrapper around the
+  :class:`SimWorkloadClient`: injected gRPC errors (raised as
+  :class:`SimRpcError`, a real ``grpc.RpcError`` so every production
+  error path runs), recorded virtual latency, stale snapshots (inventory
+  RPCs frozen at window entry) and lost status updates (JobInfo/JobState
+  frozen per job) — all seeded, so identical runs inject identically.
+- **Cluster-level** faults (node drain/resume churn, partition
+  disappearance, preemption storms) are applied by the harness at tick
+  boundaries through the :class:`SimCluster` mutators and the arrival
+  trace.
+
+Windows are ``[start_tick, end_tick)``; cluster-level faults revert at
+``end_tick`` (drained nodes resume, hidden partitions return).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import grpc
+
+
+class SimRpcError(grpc.RpcError):
+    """An injected RPC failure carrying the ``code()``/``details()``
+    surface the bridge's handlers read (grpc's own subclasses are not
+    constructible outside a live call)."""
+
+    def __init__(self, code: grpc.StatusCode, details: str = "injected fault"):
+        super().__init__(details)
+        self._code = code
+        self._details = details
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._details
+
+
+#: fault kinds delivered via the client wrapper
+RPC_KINDS = ("rpc_error", "rpc_latency", "stale_snapshot", "lost_status")
+#: fault kinds applied by the harness at tick boundaries
+CLUSTER_KINDS = ("drain_nodes", "partition_vanish", "preemption_storm")
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One fault window. Fields beyond (kind, start, end) are kind-specific:
+
+    - ``rpc_error``: ``methods`` ("" or empty = all), ``rate``, ``code``
+    - ``rpc_latency``: ``methods``, ``latency_ms`` (virtual, recorded — the
+      simulator never sleeps)
+    - ``stale_snapshot``: inventory RPCs serve window-entry state
+    - ``lost_status``: JobInfo/JobState serve each job's window-entry state
+    - ``drain_nodes``: ``nodes`` explicit names and/or ``node_fraction``
+      drawn deterministically from the plan seed; resumed at ``end_tick``
+    - ``partition_vanish``: ``partition`` hidden for the window
+    - ``preemption_storm``: ``jobs`` arrivals at ``priority`` injected at
+      ``start_tick`` (requires the scheduler's preemption mode to displace)
+    """
+
+    kind: str
+    start_tick: int
+    end_tick: int
+    methods: tuple[str, ...] = ()
+    rate: float = 1.0
+    code: str = "UNAVAILABLE"
+    latency_ms: float = 0.0
+    nodes: tuple[str, ...] = ()
+    node_fraction: float = 0.0
+    partition: str = ""
+    jobs: int = 0
+    priority: int = 1000
+
+    def active(self, tick: int) -> bool:
+        return self.start_tick <= tick < self.end_tick
+
+    def matches(self, method: str) -> bool:
+        return not self.methods or method in self.methods
+
+    @property
+    def status_code(self) -> grpc.StatusCode:
+        return getattr(grpc.StatusCode, self.code)
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    faults: tuple[Fault, ...] = ()
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def active(self, kind: str, tick: int) -> list[Fault]:
+        return [f for f in self.faults if f.kind == kind and f.active(tick)]
+
+    def starting(self, kind: str, tick: int) -> list[Fault]:
+        return [f for f in self.faults if f.kind == kind and f.start_tick == tick]
+
+    def ending(self, kind: str, tick: int) -> list[Fault]:
+        return [f for f in self.faults if f.kind == kind and f.end_tick == tick]
+
+    @property
+    def last_end_tick(self) -> int:
+        """Tick by which every fault window has closed (0 = no faults)."""
+        return max((f.end_tick for f in self.faults), default=0)
+
+    def describe(self) -> list[dict]:
+        out = []
+        for f in self.faults:
+            d = {"kind": f.kind, "window": [f.start_tick, f.end_tick]}
+            if f.kind == "rpc_error":
+                d.update(methods=list(f.methods) or ["*"], rate=f.rate, code=f.code)
+            elif f.kind == "rpc_latency":
+                d.update(methods=list(f.methods) or ["*"], latency_ms=f.latency_ms)
+            elif f.kind == "drain_nodes":
+                d.update(nodes=len(f.nodes), node_fraction=f.node_fraction)
+            elif f.kind == "partition_vanish":
+                d.update(partition=f.partition)
+            elif f.kind == "preemption_storm":
+                d.update(jobs=f.jobs, priority=f.priority)
+            out.append(d)
+        return out
+
+
+#: inventory RPCs a stale_snapshot window freezes
+_SNAPSHOT_METHODS = ("Partitions", "Partition", "Nodes")
+#: status RPCs a lost_status window freezes
+_STATUS_METHODS = ("JobInfo", "JobState")
+
+
+class FaultyClient:
+    """Client wrapper consulting the plan's RPC-level faults per call.
+
+    The harness advances :attr:`tick` at each tick boundary; injection
+    draws come from a dedicated seeded RNG, so runs with identical plans,
+    seeds and call sequences inject identically (determinism contract).
+    """
+
+    def __init__(self, inner, plan: FaultPlan, *, seed: int = 0):
+        import numpy as np
+
+        self._inner = inner
+        self._plan = plan
+        self._rng = np.random.default_rng(seed)
+        self.tick = 0
+        self.injected_errors: dict[str, int] = {}
+        self.injected_latency_ms = 0.0
+        self._stale: dict[tuple, object] = {}
+        self._stale_window = False
+
+    def set_tick(self, tick: int) -> None:
+        self.tick = tick
+        stale_now = bool(self._plan.active("stale_snapshot", tick)) or bool(
+            self._plan.active("lost_status", tick)
+        )
+        if stale_now and not self._stale_window:
+            self._stale.clear()  # fresh window: freeze state as of entry
+        self._stale_window = stale_now
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def __getattr__(self, method: str):
+        inner_fn = getattr(self._inner, method)
+        if not callable(inner_fn) or method.startswith("_"):
+            return inner_fn
+
+        def call(request, timeout=None):
+            for f in self._plan.active("rpc_error", self.tick):
+                if f.matches(method) and self._rng.random() < f.rate:
+                    self.injected_errors[method] = (
+                        self.injected_errors.get(method, 0) + 1
+                    )
+                    raise SimRpcError(
+                        f.status_code, f"injected {f.code} on {method}"
+                    )
+            for f in self._plan.active("rpc_latency", self.tick):
+                if f.matches(method):
+                    self.injected_latency_ms += f.latency_ms
+            freeze = (
+                method in _SNAPSHOT_METHODS
+                and self._plan.active("stale_snapshot", self.tick)
+            ) or (
+                method in _STATUS_METHODS
+                and self._plan.active("lost_status", self.tick)
+            )
+            if freeze:
+                key = (method, request.SerializeToString(deterministic=True))
+                if key not in self._stale:
+                    self._stale[key] = inner_fn(request, timeout=timeout)
+                return self._stale[key]
+            return inner_fn(request, timeout=timeout)
+
+        return call

@@ -1,0 +1,554 @@
+"""The simulation harness: real bridge components, virtual time.
+
+One :class:`SimHarness` owns the REAL control plane — :class:`ObjectStore`,
+:class:`BridgeOperator` (reconciled synchronously, event-driven off the
+store watch like its production pump thread), :class:`Configurator` with
+its :class:`VirtualNodeProvider` mirrors (tickers disabled), and
+:class:`PlacementScheduler` — wired to a :class:`SimWorkloadClient`
+(optionally behind a :class:`FaultyClient`). Nothing sleeps; the harness
+advances a virtual clock and drives every control loop one synchronous
+step per tick, so a scenario is deterministic given its seed.
+
+Tick order (one reconcile round):
+
+1. fault boundaries — drain/resume nodes, hide/show partitions, inject
+   preemption-storm arrivals;
+2. arrivals — create BridgeJob CRs, reconcile them (sizecar pods appear);
+3. scheduler tick — the real ``PlacementScheduler.tick`` (store → encode
+   → solve → bind, phase-timed by the scheduler itself);
+4. mirror — configurator partition diff, provider sync (node refresh,
+   submit to "Slurm", statusmap translation), operator status sync for
+   owners of changed pods;
+5. sim step — complete jobs whose virtual runtime elapsed, start queued
+   work;
+6. invariants — see ``sim/invariants.py``;
+7. advance virtual time.
+
+After the scripted ticks, drain-grace ticks (no arrivals, faults over)
+run until the pending queues empty or the grace budget is spent.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+
+import grpc
+import numpy as np
+
+from slurm_bridge_tpu.bridge.configurator import Configurator
+from slurm_bridge_tpu.bridge.objects import (
+    BridgeJob,
+    Meta,
+    Pod,
+    PodPhase,
+    PodRole,
+)
+from slurm_bridge_tpu.bridge.operator import BridgeOperator
+from slurm_bridge_tpu.bridge.scheduler import PlacementScheduler
+from slurm_bridge_tpu.bridge.store import AlreadyExists, ObjectStore
+from slurm_bridge_tpu.core.types import JobStatus
+from slurm_bridge_tpu.obs.events import EventRecorder
+from slurm_bridge_tpu.obs.metrics import REGISTRY
+from slurm_bridge_tpu.sim.agent import SimCluster, SimWorkloadClient
+from slurm_bridge_tpu.sim.faults import FaultPlan, FaultyClient
+from slurm_bridge_tpu.sim.invariants import (
+    Violation,
+    check_drain,
+    check_tick,
+    per_node_demand,
+)
+from slurm_bridge_tpu.sim.trace import (
+    ClusterSpec,
+    WorkloadSpec,
+    build_cluster,
+    generate_trace,
+    storm_arrivals,
+)
+
+log = logging.getLogger("sbt.sim")
+
+_tick_seconds = REGISTRY.histogram(
+    "sbt_sim_tick_seconds", "full simulated reconcile tick wall time"
+)
+
+#: the five phases the full-tick headline decomposes into
+PHASES = ("store", "encode", "solve", "bind", "mirror")
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """A named, fully-seeded simulation run."""
+
+    name: str
+    cluster: ClusterSpec
+    workload: WorkloadSpec
+    faults: FaultPlan = field(default_factory=FaultPlan)
+    ticks: int = 30
+    #: virtual seconds per tick — 5 keeps the drain horizon (grace ticks ×
+    #: interval) comfortably above the worst serialization chain on a
+    #: scarce resource (a few max-duration jobs queued on one GPU node)
+    tick_interval_s: float = 5.0
+    seed: int = 42
+    preemption: bool = False
+    backend: str = "auto"
+    expect_drain: bool = True
+    drain_grace_ticks: int = 60
+    description: str = ""
+    slow: bool = False
+
+
+@dataclass
+class ScenarioResult:
+    scenario: Scenario
+    determinism: dict
+    timing: dict
+    shape: dict
+
+    def as_dict(self) -> dict:
+        return {
+            "scenario": self.scenario.name,
+            "seed": self.scenario.seed,
+            "shape": self.shape,
+            "faults": self.scenario.faults.describe(),
+            "determinism": self.determinism,
+            "timing": self.timing,
+        }
+
+    def determinism_json(self) -> str:
+        """The byte-comparable section: everything except wall-clock."""
+        return json.dumps(
+            {
+                "scenario": self.scenario.name,
+                "seed": self.scenario.seed,
+                "shape": self.shape,
+                "determinism": self.determinism,
+            },
+            sort_keys=True,
+        )
+
+
+def _quiet_event_logs() -> None:
+    # the recorder logs every event; at 50k binds/tick that is pure drag
+    # (and Unschedulable churn would spam stderr through the lastResort
+    # handler) — scenario metrics carry the same information
+    logging.getLogger("sbt.events").setLevel(logging.CRITICAL)
+    logging.getLogger("sbt.scheduler").setLevel(logging.ERROR)
+    logging.getLogger("sbt.vnode").setLevel(logging.ERROR)
+    logging.getLogger("sbt.configurator").setLevel(logging.ERROR)
+
+
+class SimHarness:
+    def __init__(self, scenario: Scenario):
+        _quiet_event_logs()
+        self.scenario = scenario
+        self.vt = 0.0
+        rng = np.random.default_rng(scenario.seed)
+        nodes, partitions = build_cluster(scenario.cluster, rng)
+        self.cluster = SimCluster(nodes, partitions, clock=lambda: self.vt)
+        by_name = {n.name: n for n in nodes}
+        part_names = list(partitions)
+        sizes = [len(partitions[p]) for p in part_names]
+        gpu_caps = [
+            max((by_name[m].gpus for m in partitions[p]), default=0)
+            for p in part_names
+        ]
+        gpu_counts = [
+            sum(1 for m in partitions[p] if by_name[m].gpus > 0)
+            for p in part_names
+        ]
+        self.trace = generate_trace(
+            scenario.workload,
+            scenario.cluster,
+            scenario.ticks,
+            rng,
+            partition_sizes=sizes,
+            partition_gpu_caps=gpu_caps,
+            partition_gpu_counts=gpu_counts,
+        )
+        for f in scenario.faults.faults:
+            if f.kind == "preemption_storm" and f.start_tick < scenario.ticks:
+                self.trace[f.start_tick].extend(
+                    storm_arrivals(
+                        f.start_tick, f.jobs, scenario.cluster, rng,
+                        priority=f.priority,
+                    )
+                )
+        base_client = SimWorkloadClient(self.cluster)
+        self.client = (
+            FaultyClient(base_client, scenario.faults, seed=scenario.seed + 1)
+            if scenario.faults
+            else base_client
+        )
+        # deterministic drain targets resolved up front (plan seed, not
+        # call order): node_fraction picks evenly-spaced names
+        self._drain_targets: dict[int, tuple[str, ...]] = {}
+        names = sorted(self.cluster.nodes)
+        for i, f in enumerate(scenario.faults.faults):
+            if f.kind != "drain_nodes":
+                continue
+            picked = list(f.nodes)
+            if f.node_fraction > 0:
+                k = max(1, int(round(f.node_fraction * len(names))))
+                stride = max(1, len(names) // k)
+                picked.extend(names[(i % stride) :: stride][:k])
+            self._drain_targets[id(f)] = tuple(picked)
+
+        self.store = ObjectStore()
+        self.events = EventRecorder()
+        self._event_counts: dict[str, int] = {}
+        self._preempt_events = 0
+        self.events.add_sink(self._count_event)
+        self.operator = BridgeOperator(
+            self.store, agent_endpoint="sim://agent", events=self.events
+        )
+        self.configurator = Configurator(
+            self.store,
+            self.client,
+            agent_endpoint="sim://agent",
+            events=self.events,
+            node_sync_interval=0.0,  # no tickers: the harness drives sync
+            pod_sync_workers=1,  # serial converge: deterministic order
+            provider_inventory_ttl=0.0,  # no wall-clock cache window
+        )
+        self.scheduler = PlacementScheduler(
+            self.store,
+            self.client,
+            backend=scenario.backend,
+            events=self.events,
+            preemption=scenario.preemption,
+            inventory_ttl=0.0,  # virtual time: always take a fresh snapshot
+        )
+        self._pod_watch = self.store.watch((Pod.KIND,))
+        self.rpc_failures: dict[str, int] = {}
+        self.violations: list[Violation] = []
+        self._digest = hashlib.sha256()
+        self._bound_total = 0
+        self._preempted_total = 0
+        self._tick_phases: list[dict[str, float]] = []
+        self._arrive_ms: list[float] = []
+        self._pending_by_tick: list[int] = []
+        self._drained_at: int | None = None
+        self._recovered_at: int | None = None
+
+    # ---- bookkeeping ----
+
+    def _count_event(self, ev) -> None:
+        self._event_counts[ev.reason] = self._event_counts.get(ev.reason, 0) + 1
+        if ev.message.startswith("preempted:"):
+            self._preempt_events += 1
+
+    def _note(self, *parts: object) -> None:
+        self._digest.update("|".join(str(p) for p in parts).encode())
+        self._digest.update(b"\n")
+
+    def _rpc_fail(self, where: str) -> None:
+        self.rpc_failures[where] = self.rpc_failures.get(where, 0) + 1
+
+    @staticmethod
+    def _pending_names(pods: list[Pod]) -> set[str]:
+        """PlacementScheduler.pending_pods()'s filter over an
+        already-fetched list (one store copy per tick, not one per use) —
+        keep in lockstep with bridge/scheduler.py."""
+        return {
+            p.name
+            for p in pods
+            if p.spec.role == PodRole.SIZECAR
+            and not p.spec.node_name
+            and not p.meta.deleted
+            and p.status.phase == PodPhase.PENDING
+        }
+
+    # ---- tick machinery ----
+
+    def _apply_fault_boundaries(self, tick: int) -> None:
+        plan = self.scenario.faults
+        for f in plan.starting("drain_nodes", tick):
+            self.cluster.drain(list(self._drain_targets.get(id(f), f.nodes)))
+        for f in plan.ending("drain_nodes", tick):
+            self.cluster.resume(list(self._drain_targets.get(id(f), f.nodes)))
+        for f in plan.starting("partition_vanish", tick):
+            self.cluster.hide_partition(f.partition)
+        for f in plan.ending("partition_vanish", tick):
+            self.cluster.show_partition(f.partition)
+
+    def _arrive(self, tick: int) -> int:
+        arrivals = self.trace[tick] if tick < len(self.trace) else []
+        for a in arrivals:
+            job = BridgeJob(meta=Meta(name=a.name), spec=a.spec)
+            # the trace's virtual duration rides the demand's time limit —
+            # the sim agent runs each job for exactly that long
+            try:
+                self.store.create(job)
+            except AlreadyExists:
+                continue
+            self.operator.reconcile(a.name)
+            pod = self.store.try_get(Pod.KIND, f"{a.name}-sizecar")
+            if pod is not None and pod.spec.demand is not None:
+                def stamp(p: Pod, dur=a.duration_s):
+                    p.spec.demand.time_limit_s = max(1, int(round(dur)))
+
+                self.store.mutate(Pod.KIND, pod.name, stamp)
+        return len(arrivals)
+
+    def _mirror(self) -> None:
+        """Partition diff + provider sync + event-driven operator sync —
+        the production mirror half of the reconcile loop."""
+        try:
+            self.configurator.reconcile()
+        except grpc.RpcError:
+            self._rpc_fail("configurator.reconcile")
+        for partition in sorted(self.configurator.providers):
+            provider = self.configurator.providers[partition]
+            try:
+                provider.sync()
+            except grpc.RpcError:
+                self._rpc_fail(f"provider.sync:{partition}")
+        # drain the pod watch queue and reconcile owners of changed pods —
+        # exactly what the operator's _pump_events thread does, made
+        # synchronous (and therefore deterministic)
+        owners: set[str] = set()
+        while True:
+            try:
+                ev = self._pod_watch.get_nowait()
+            except Exception:
+                break
+            obj = self.store.try_get(ev.kind, ev.name)
+            owner = (
+                obj.meta.owner
+                if obj is not None and obj.meta.owner
+                else self.operator._owner_from_name(ev.name)
+            )
+            if owner:
+                owners.add(owner)
+        for owner in sorted(owners):
+            self.operator.reconcile(owner)
+
+    def _free_now(self) -> dict[str, tuple[float, float, float]]:
+        out = {}
+        for name, node in self.cluster.nodes.items():
+            info = node.info()
+            free = (
+                (float(info.free_cpus), float(info.free_memory_mb), float(info.free_gpus))
+                if info.schedulable
+                else (0.0, 0.0, 0.0)
+            )
+            out[name] = free
+        return out
+
+    def run_tick(self, tick: int, *, arrivals: bool = True) -> dict[str, float]:
+        if isinstance(self.client, FaultyClient):
+            self.client.set_tick(tick)
+        self._apply_fault_boundaries(tick)
+
+        t0 = time.perf_counter()
+        n_arrived = self._arrive(tick) if arrivals else 0
+        self._arrive_ms.append((time.perf_counter() - t0) * 1e3)
+
+        stale = bool(self.scenario.faults.active("stale_snapshot", tick))
+        free_before = None if stale else self._free_now()
+        pods_before = self.store.list(Pod.KIND)
+        pre = {
+            p.name: (p.spec.placement_hint, p.spec.demand)
+            for p in pods_before
+            if p.spec.role == PodRole.SIZECAR and p.spec.node_name
+        }
+        pending_before = self._pending_names(pods_before)
+
+        t1 = time.perf_counter()
+        try:
+            self.scheduler.tick()
+        except grpc.RpcError:
+            self._rpc_fail("scheduler.tick")
+        sched_ms = (time.perf_counter() - t1) * 1e3
+        phases = dict(self.scheduler.last_phase_ms)
+
+        t2 = time.perf_counter()
+        self._mirror()
+        phases["mirror"] = (time.perf_counter() - t2) * 1e3
+        # anything tick() spent outside its own phase decomposition
+        # (RPC-fault aborts, remote skips) lands in "store"
+        accounted = sum(phases.get(k, 0.0) for k in ("store", "encode", "solve", "bind"))
+        phases["store"] = phases.get("store", 0.0) + max(0.0, sched_ms - accounted)
+
+        self.cluster.step()
+
+        pods = self.store.list(Pod.KIND)
+        by_name = {p.name: p for p in pods}
+        newly_bound = [
+            p for p in pods if p.name in pending_before and p.spec.node_name
+        ]
+        preempted = [
+            name
+            for name in pre
+            if (cur := by_name.get(name)) is not None
+            and not cur.spec.node_name
+            and cur.status.reason.startswith("Preempted")
+        ]
+        released: dict[str, list[float]] = {}
+        for name in preempted:
+            hints, demand = pre[name]
+            if demand is None:
+                continue
+            cpu, mem, gpu = per_node_demand(demand)
+            for node in hints:
+                u = released.setdefault(node, [0.0, 0.0, 0.0])
+                u[0] += cpu
+                u[1] += mem
+                u[2] += gpu
+        self._bound_total += len(newly_bound)
+        self._preempted_total += len(preempted)
+        for p in sorted(newly_bound, key=lambda p: p.name):
+            self._note(tick, "bind", p.name, p.spec.node_name,
+                       ",".join(p.spec.placement_hint))
+        for name in sorted(preempted):
+            self._note(tick, "preempt", name)
+
+        self.violations.extend(
+            check_tick(
+                tick,
+                pods,
+                self.cluster,
+                newly_bound=newly_bound,
+                free_before=free_before,
+                released={k: tuple(v) for k, v in released.items()},
+            )
+        )
+        pending_after = len(self._pending_names(pods))
+        self._pending_by_tick.append(pending_after)
+        self._note(tick, "pending", pending_after, "arrived", n_arrived)
+        fault_end = self.scenario.faults.last_end_tick
+        if (
+            self._recovered_at is None
+            and fault_end
+            and tick >= fault_end
+            and pending_after == 0
+            and not self.cluster.pending_jobs()
+        ):
+            self._recovered_at = tick
+        if (
+            self._drained_at is None
+            and pending_after == 0
+            and not self.cluster.pending_jobs()
+            and tick >= self.scenario.ticks - 1
+        ):
+            self._drained_at = tick
+
+        tick_ms = sum(phases.get(k, 0.0) for k in PHASES)
+        phases["tick"] = tick_ms
+        _tick_seconds.observe(tick_ms / 1e3)
+        self._tick_phases.append(phases)
+        self.vt += self.scenario.tick_interval_s
+        return phases
+
+    # ---- the full run ----
+
+    def _progress(self, tick: int, phases: dict[str, float]) -> None:
+        if not self.scenario.slow:
+            return
+        import sys
+
+        print(
+            f"# tick {tick}: {phases.get('tick', 0.0):.0f} ms "
+            f"(store {phases.get('store', 0.0):.0f} / encode "
+            f"{phases.get('encode', 0.0):.0f} / solve "
+            f"{phases.get('solve', 0.0):.0f} / bind "
+            f"{phases.get('bind', 0.0):.0f} / mirror "
+            f"{phases.get('mirror', 0.0):.0f}), pending "
+            f"{self._pending_by_tick[-1] if self._pending_by_tick else 0}",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    def run(self) -> ScenarioResult:
+        sc = self.scenario
+        tick = 0
+        for tick in range(sc.ticks):
+            self._progress(tick, self.run_tick(tick))
+        grace_used = 0
+        while (
+            grace_used < sc.drain_grace_ticks
+            and self._drained_at is None
+        ):
+            tick += 1
+            grace_used += 1
+            self._progress(tick, self.run_tick(tick, arrivals=False))
+        total_ticks = tick + 1
+
+        if sc.expect_drain:
+            self.violations.extend(
+                check_drain(
+                    tick,
+                    self._pending_by_tick[-1] if self._pending_by_tick else 0,
+                    len(self.cluster.pending_jobs()),
+                    expect_drain=True,
+                )
+            )
+
+        jobs = self.cluster.jobs.values()
+        determinism = {
+            "bound_total": self._bound_total,
+            "preempted_total": self._preempted_total,
+            "preempt_events": self._preempt_events,
+            "events": dict(sorted(self._event_counts.items())),
+            "sim": self.cluster.stats.as_dict(),
+            "pending_final": self._pending_by_tick[-1] if self._pending_by_tick else 0,
+            "sim_running_final": sum(
+                1 for j in jobs if j.state == JobStatus.RUNNING
+            ),
+            "sim_pending_final": sum(
+                1 for j in jobs if j.state == JobStatus.PENDING
+            ),
+            "rpc_failures": dict(sorted(self.rpc_failures.items())),
+            "injected_errors": dict(
+                sorted(self.client.injected_errors.items())
+            )
+            if isinstance(self.client, FaultyClient)
+            else {},
+            "invariant_violations": [v.as_dict() for v in self.violations],
+            "recovery_ticks": (
+                self._recovered_at - sc.faults.last_end_tick
+                if self._recovered_at is not None and sc.faults
+                else None
+            ),
+            "drained_at_tick": self._drained_at,
+            "grace_ticks_used": grace_used,
+            "digest": self._digest.hexdigest(),
+        }
+        phase_arr = {
+            k: np.asarray([p.get(k, 0.0) for p in self._tick_phases])
+            for k in (*PHASES, "tick")
+        }
+        timing = {
+            "tick_p50_ms": round(float(np.median(phase_arr["tick"])), 3),
+            "tick_p95_ms": round(float(np.percentile(phase_arr["tick"], 95)), 3),
+            "tick_max_ms": round(float(phase_arr["tick"].max()), 3),
+            "phases_p50_ms": {
+                k: round(float(np.median(phase_arr[k])), 3) for k in PHASES
+            },
+            "phases_p95_ms": {
+                k: round(float(np.percentile(phase_arr[k], 95)), 3) for k in PHASES
+            },
+            "arrive_p50_ms": round(float(np.median(self._arrive_ms)), 3),
+            "injected_latency_ms": round(
+                self.client.injected_latency_ms, 3
+            )
+            if isinstance(self.client, FaultyClient)
+            else 0.0,
+        }
+        shape = {
+            "pods": sum(len(t) for t in self.trace),
+            "nodes": sc.cluster.num_nodes,
+            "partitions": sc.cluster.num_partitions,
+            "ticks": total_ticks,
+        }
+        return ScenarioResult(
+            scenario=sc, determinism=determinism, timing=timing, shape=shape
+        )
+
+
+def run_scenario(scenario: Scenario) -> ScenarioResult:
+    return SimHarness(scenario).run()

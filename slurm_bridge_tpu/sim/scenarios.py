@@ -1,0 +1,226 @@
+"""Named simulation scenarios — the catalog the CLI and smoke gate run.
+
+Each factory takes a ``scale`` knob (default 1.0) that multiplies pod and
+node counts, so ``make sim-smoke`` runs the same scenarios at toy shapes
+and the CLI can run them full-size. The runnable per-scenario entry
+points live in ``benchmarks/scenarios/sim_*.py`` (one file per scenario,
+ISSUE 2); this module is the single source of truth they import.
+
+``full_50kx10k`` is the slow headline: the previously-unmeasured full
+bridge reconcile tick (store → encode → solve → bind → mirror) at 50k
+pods × 10k nodes, reported as ``full_tick_p50_ms_50kx10k``.
+"""
+
+from __future__ import annotations
+
+from slurm_bridge_tpu.sim.faults import Fault, FaultPlan
+from slurm_bridge_tpu.sim.harness import Scenario
+from slurm_bridge_tpu.sim.trace import ClusterSpec, WorkloadSpec
+
+
+def _n(base: int, scale: float, floor: int = 8) -> int:
+    return max(floor, int(round(base * scale)))
+
+
+def steady_poisson(scale: float = 1.0, seed: int = 42) -> Scenario:
+    """Steady Poisson arrivals against a heterogeneous 4-partition
+    cluster; no faults — the determinism/queue-drain baseline."""
+    return Scenario(
+        name="steady_poisson",
+        description="Poisson arrivals, mixed cpu/mem/gpu demand, no faults",
+        cluster=ClusterSpec(
+            num_nodes=_n(400, scale), partition_features=("tier0", "tier1")
+        ),
+        workload=WorkloadSpec(
+            jobs=_n(1500, scale, floor=20), arrival="poisson", spread_ticks=10
+        ),
+        ticks=20,
+        seed=seed,
+    )
+
+
+def burst_backlog(scale: float = 1.0, seed: int = 43) -> Scenario:
+    """Cold-start: the whole queue arrives at tick 0 (the headline
+    shape's arrival pattern, scaled down)."""
+    return Scenario(
+        name="burst_backlog",
+        description="front-loaded backlog, gang-heavy, drains from cold start",
+        cluster=ClusterSpec(num_nodes=_n(600, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(3000, scale, floor=30),
+            arrival="front",
+            gang_fraction=0.15,
+        ),
+        ticks=8,
+        seed=seed,
+    )
+
+
+def agent_flaky_rpc(scale: float = 1.0, seed: int = 44) -> Scenario:
+    """Agent RPC flaps: submissions and status queries fail 30% of the
+    time (plus recorded latency) for a window; everything must converge
+    after the flap clears — the retry/idempotency story end to end."""
+    return Scenario(
+        name="agent_flaky_rpc",
+        description="30% UNAVAILABLE on SubmitJob/JobInfo for ticks 4-12",
+        cluster=ClusterSpec(num_nodes=_n(300, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(1000, scale, floor=20), arrival="poisson", spread_ticks=8
+        ),
+        faults=FaultPlan(
+            (
+                Fault(
+                    kind="rpc_error",
+                    start_tick=4,
+                    end_tick=12,
+                    methods=("SubmitJob", "JobInfo"),
+                    rate=0.3,
+                ),
+                Fault(
+                    kind="rpc_latency",
+                    start_tick=4,
+                    end_tick=12,
+                    methods=("SubmitJob",),
+                    latency_ms=50.0,
+                ),
+            )
+        ),
+        ticks=18,
+        seed=seed,
+    )
+
+
+def preemption_storm(scale: float = 1.0, seed: int = 45) -> Scenario:
+    """A high-priority burst lands on a loaded cluster with preemption
+    enabled: incumbents must be displaced (cancel + requeue + dedupe-safe
+    resubmit) without ever double-binding or breaking gang atomicity."""
+    return Scenario(
+        name="preemption_storm",
+        description="priority-1000 burst at tick 6 displaces incumbents",
+        # deliberately oversubscribed (~1.4x free capacity in flight with
+        # long runtimes): the storm cannot fit without displacing, so the
+        # preemption path — cancel, requeue, dedupe-safe resubmit — runs
+        # for real; the long grace + tick interval cover the worked-off
+        # backlog so the drain invariant still closes the scenario
+        cluster=ClusterSpec(num_nodes=_n(150, scale), gpu_fraction=0.0),
+        workload=WorkloadSpec(
+            jobs=_n(700, scale, floor=30),
+            arrival="poisson",
+            spread_ticks=4,
+            gpu_fraction=0.0,
+            cpu_choices=(8, 16, 32),
+            duration_range=(60.0, 120.0),
+        ),
+        faults=FaultPlan(
+            (
+                Fault(
+                    kind="preemption_storm",
+                    start_tick=6,
+                    end_tick=7,
+                    jobs=_n(120, scale, floor=10),
+                    priority=1000,
+                ),
+            )
+        ),
+        ticks=16,
+        tick_interval_s=10.0,
+        drain_grace_ticks=100,
+        preemption=True,
+        seed=seed,
+    )
+
+
+def node_churn(scale: float = 1.0, seed: int = 46) -> Scenario:
+    """Drain/resume churn plus stale inventory snapshots and lost status
+    updates — the scheduler must ride out a shrinking, lying inventory
+    and drain once nodes return."""
+    return Scenario(
+        name="node_churn",
+        description="20% of nodes drain ticks 4-12; stale snapshots + lost "
+        "status ticks 5-10",
+        cluster=ClusterSpec(num_nodes=_n(300, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(900, scale, floor=20), arrival="poisson", spread_ticks=8
+        ),
+        faults=FaultPlan(
+            (
+                Fault(
+                    kind="drain_nodes",
+                    start_tick=4,
+                    end_tick=12,
+                    node_fraction=0.2,
+                ),
+                Fault(kind="stale_snapshot", start_tick=5, end_tick=10),
+                Fault(kind="lost_status", start_tick=5, end_tick=10),
+            )
+        ),
+        ticks=18,
+        seed=seed,
+    )
+
+
+def partition_vanish(scale: float = 1.0, seed: int = 47) -> Scenario:
+    """A whole partition disappears mid-run (agent stops listing it): its
+    virtual node is torn down, its pending pods wait, and everything
+    converges once the partition returns."""
+    return Scenario(
+        name="partition_vanish",
+        description="partition part1 vanishes for ticks 3-10, then returns",
+        cluster=ClusterSpec(num_nodes=_n(300, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(800, scale, floor=20), arrival="poisson", spread_ticks=6
+        ),
+        faults=FaultPlan(
+            (
+                Fault(
+                    kind="partition_vanish",
+                    start_tick=3,
+                    end_tick=10,
+                    partition="part1",
+                ),
+            )
+        ),
+        ticks=16,
+        seed=seed,
+    )
+
+
+def full_50kx10k(scale: float = 1.0, seed: int = 42) -> Scenario:
+    """The headline: 50k pods × 10k nodes through the FULL bridge
+    pipeline. Slow (minutes); records ``full_tick_p50_ms_50kx10k`` with
+    the store/encode/solve/bind/mirror phase breakdown — the number the
+    round-5 VERDICT called the unmeasured 90%."""
+    return Scenario(
+        name="full_50kx10k",
+        description="full-bridge reconcile tick at the 50k x 10k product shape",
+        cluster=ClusterSpec(num_nodes=_n(10_000, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(50_000, scale, floor=100),
+            arrival="front",
+            gang_fraction=0.05,
+            gpu_fraction=0.15,
+            duration_range=(30.0, 120.0),
+        ),
+        ticks=3,
+        expect_drain=False,
+        drain_grace_ticks=0,
+        seed=seed,
+        slow=True,
+    )
+
+
+SCENARIOS = {
+    f.__name__: f
+    for f in (
+        steady_poisson,
+        burst_backlog,
+        agent_flaky_rpc,
+        preemption_storm,
+        node_churn,
+        partition_vanish,
+        full_50kx10k,
+    )
+}
+
+#: the fast set `make sim-smoke` double-runs (everything but the slow one)
+SMOKE_SCENARIOS = tuple(n for n, f in SCENARIOS.items() if n != "full_50kx10k")

@@ -62,6 +62,14 @@ _place_total = REGISTRY.counter(
 _placed_total = REGISTRY.counter(
     "sbt_solver_jobs_placed_total", "jobs placed across all Place RPCs"
 )
+_zero_demand_total = REGISTRY.counter(
+    "sbt_solver_zero_demand_jobs_total",
+    "Place jobs arriving with cpus==0 and mem_mb==0 — the signature of a "
+    "version-skewed peer still writing the old field numbers (ADVICE r5 "
+    "#3); such jobs would otherwise place as zero-cost and oversubscribe",
+)
+_ZERO_DEMAND_LOG_INTERVAL_S = 60.0
+_last_zero_demand_log = [0.0]
 
 SOLVERS = ("auction", "greedy", "sharded", "indexed")
 
@@ -206,7 +214,17 @@ class PlacementSolverServicer:
         rows_job: list[int] = []
         rows_inc: list[int] = []
         name_idx = {n: i for i, n in enumerate(snapshot.node_names)}
+        zero_demand = 0
         for j, job in enumerate(jobs):
+            if not job.cpus and not job.mem_mb:
+                # wire-skew ingress guard (ADVICE r5 #3): cpus/mem_mb moved
+                # to field numbers 10/11 in round 5; a version-skewed peer
+                # still writing the old numbers decodes to all-zero demand
+                # here and every job would place as zero-cost. Count and
+                # warn LOUDLY instead of silently oversubscribing the
+                # cluster (the job still solves — an all-zero row is also
+                # a legitimate "any node" request from thin clients).
+                zero_demand += 1
             nshards = max(1, int(job.nodes))
             part = snapshot.partition_codes.get(job.partition, -1)
             feat = 0
@@ -237,6 +255,18 @@ class PlacementSolverServicer:
                 rows_prio.append(float(job.priority) + (0.5 if pinned else 0.0))
                 rows_job.append(j)
                 rows_inc.append(inc)
+        if zero_demand:
+            _zero_demand_total.inc(zero_demand)
+            now = time.monotonic()
+            if now - _last_zero_demand_log[0] >= _ZERO_DEMAND_LOG_INTERVAL_S:
+                _last_zero_demand_log[0] = now
+                log.warning(
+                    "%d/%d Place jobs carry zero cpu AND zero mem demand — "
+                    "likely wire version skew (cpus/mem_mb renumbered to "
+                    "fields 10/11); upgrade the peer or these jobs place as "
+                    "zero-cost (sbt_solver_zero_demand_jobs_total counts)",
+                    zero_demand, len(jobs),
+                )
         batch = JobBatch(
             demand=np.asarray(rows_dem, dtype=np.float32).reshape(-1, 3),
             partition_of=np.asarray(rows_part, dtype=np.int32),

@@ -1,0 +1,440 @@
+"""Simulator subsystem tests: the deterministic harness, the fake agent's
+ground-truth semantics, fault injection, invariants, and the CLI.
+
+The heavyweight determinism double-runs over every scenario live in
+`make sim-smoke` (python -m slurm_bridge_tpu.sim --smoke); these tests
+pin the same contracts at toy shapes so the fast lane still guards them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import grpc
+import numpy as np
+import pytest
+
+from slurm_bridge_tpu.bridge.objects import Pod, VirtualNode
+from slurm_bridge_tpu.core.types import JobStatus
+from slurm_bridge_tpu.sim import (
+    ClusterSpec,
+    Fault,
+    FaultPlan,
+    Scenario,
+    SimCluster,
+    SimRpcError,
+    SimWorkloadClient,
+    WorkloadSpec,
+    run_scenario,
+)
+from slurm_bridge_tpu.sim.faults import FaultyClient
+from slurm_bridge_tpu.sim.harness import SimHarness
+from slurm_bridge_tpu.sim.invariants import Violation, check_tick, per_node_demand
+from slurm_bridge_tpu.sim.trace import build_cluster, generate_trace
+from slurm_bridge_tpu.wire import pb
+
+
+def _tiny(name="tiny", *, faults=FaultPlan(), jobs=60, nodes=24, ticks=8,
+          preemption=False, seed=7, **wl):
+    # short durations keep the drain-grace loop (and so the fast lane)
+    # cheap; scenario-default durations are exercised by `make sim-smoke`
+    wl.setdefault("duration_range", (5.0, 20.0))
+    return Scenario(
+        name=name,
+        cluster=ClusterSpec(num_nodes=nodes),
+        workload=WorkloadSpec(jobs=jobs, arrival="poisson", spread_ticks=4, **wl),
+        faults=faults,
+        ticks=ticks,
+        seed=seed,
+        preemption=preemption,
+        drain_grace_ticks=40,
+    )
+
+
+# ------------------------------------------------------------- sim agent
+
+
+def _mini_cluster():
+    spec = ClusterSpec(num_nodes=6, num_partitions=2, gpu_fraction=0.5)
+    rng = np.random.default_rng(0)
+    nodes, partitions = build_cluster(spec, rng)
+    vt = [0.0]
+    return SimCluster(nodes, partitions, clock=lambda: vt[0]), vt
+
+
+def _submit(cluster, *, cpus=1, partition="part0", nodes=1, submitter="",
+            time_limit=10, nodelist=()):
+    return cluster.submit(
+        pb.SubmitJobRequest(
+            script="#!/bin/sh\n",
+            partition=partition,
+            cpus_per_task=cpus,
+            ntasks=1,
+            nodes=nodes,
+            mem_per_cpu_mb=100,
+            time_limit_s=time_limit,
+            submitter_id=submitter,
+            nodelist=list(nodelist),
+        )
+    )
+
+
+def test_sim_agent_lifecycle_and_virtual_time():
+    cluster, vt = _mini_cluster()
+    jid = _submit(cluster, time_limit=10)
+    job = cluster.jobs[jid]
+    assert job.state == JobStatus.RUNNING  # fits immediately
+    assert len(job.assigned) == 1
+    vt[0] = 9.0
+    cluster.step()
+    assert job.state == JobStatus.RUNNING
+    vt[0] = 10.0
+    cluster.step()
+    assert job.state == JobStatus.COMPLETED
+    node = cluster.nodes[job.assigned[0]]
+    assert node.job_cpus == 0 and node.job_memory_mb == 0
+
+
+def test_sim_agent_submit_ledger_dedupes():
+    cluster, _ = _mini_cluster()
+    a = _submit(cluster, submitter="uid-1")
+    b = _submit(cluster, submitter="uid-1")
+    assert a == b
+    assert cluster.stats.deduped == 1
+    assert cluster.stats.submitted == 1
+
+
+def test_sim_agent_gang_all_or_nothing_and_queueing():
+    cluster, vt = _mini_cluster()
+    members = cluster.partitions["part0"]
+    # saturate the partition so a gang spanning every node cannot start
+    for m in members:
+        node = cluster.nodes[m]
+        node.base_alloc_cpus = node.cpus - 1
+    jid = _submit(cluster, cpus=2 * len(members), nodes=len(members),
+                  time_limit=5)
+    job = cluster.jobs[jid]
+    assert job.state == JobStatus.PENDING and not job.assigned
+    for m in members:
+        cluster.nodes[m].base_alloc_cpus = 0
+    cluster.step()
+    assert job.state == JobStatus.RUNNING
+    assert sorted(job.assigned) == sorted(set(job.assigned))
+    assert len(job.assigned) == len(members)
+
+
+def test_sim_agent_cancel_frees_and_is_idempotent():
+    cluster, _ = _mini_cluster()
+    jid = _submit(cluster, cpus=2)
+    node = cluster.nodes[cluster.jobs[jid].assigned[0]]
+    assert node.job_cpus == 2
+    cluster.cancel(jid)
+    assert cluster.jobs[jid].state == JobStatus.CANCELLED
+    assert node.job_cpus == 0
+    cluster.cancel(jid)  # idempotent
+    cluster.cancel(999999)  # unknown id: no-op like scancel
+    assert cluster.stats.cancelled == 1
+
+
+def test_sim_agent_drain_blocks_and_resume_restores():
+    cluster, _ = _mini_cluster()
+    members = list(cluster.partitions["part0"])
+    cluster.drain(members)
+    jid = _submit(cluster)
+    assert cluster.jobs[jid].state == JobStatus.PENDING
+    cluster.resume(members)
+    cluster.step()
+    assert cluster.jobs[jid].state == JobStatus.RUNNING
+
+
+def test_sim_agent_hidden_partition_errors_and_queues():
+    cluster, _ = _mini_cluster()
+    client = SimWorkloadClient(cluster)
+    cluster.hide_partition("part0")
+    assert "part0" not in list(
+        client.Partitions(pb.PartitionsRequest()).partitions
+    )
+    with pytest.raises(grpc.RpcError):
+        client.Partition(pb.PartitionRequest(partition="part0"))
+    jid = _submit(cluster)  # submit into the hidden partition: queues
+    assert cluster.jobs[jid].state == JobStatus.PENDING
+    cluster.show_partition("part0")
+    cluster.step()
+    assert cluster.jobs[jid].state == JobStatus.RUNNING
+
+
+def test_sim_agent_nodelist_hint_honoured():
+    cluster, _ = _mini_cluster()
+    target = cluster.partitions["part0"][-1]
+    jid = _submit(cluster, nodelist=(target,))
+    assert cluster.jobs[jid].assigned == (target,)
+
+
+# ------------------------------------------------------------- faults
+
+
+def test_faulty_client_injects_and_is_deterministic():
+    plan = FaultPlan(
+        (Fault(kind="rpc_error", start_tick=0, end_tick=2,
+               methods=("SubmitJob",), rate=1.0),)
+    )
+    counts = []
+    for _ in range(2):
+        cluster, _ = _mini_cluster()
+        client = FaultyClient(SimWorkloadClient(cluster), plan, seed=3)
+        client.set_tick(0)
+        with pytest.raises(grpc.RpcError) as exc:
+            client.SubmitJob(pb.SubmitJobRequest(script="x", partition="part0"))
+        assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+        # non-matching method passes through
+        client.Partitions(pb.PartitionsRequest())
+        client.set_tick(2)  # window over
+        client.SubmitJob(
+            pb.SubmitJobRequest(script="x", partition="part0",
+                                cpus_per_task=1, mem_per_cpu_mb=10)
+        )
+        counts.append(dict(client.injected_errors))
+    assert counts[0] == counts[1] == {"SubmitJob": 1}
+
+
+def test_faulty_client_stale_snapshot_freezes_inventory():
+    plan = FaultPlan(
+        (Fault(kind="stale_snapshot", start_tick=1, end_tick=3),)
+    )
+    cluster, _ = _mini_cluster()
+    client = FaultyClient(SimWorkloadClient(cluster), plan, seed=0)
+    client.set_tick(1)
+    names = list(cluster.partitions["part0"])
+    before = client.Nodes(pb.NodesRequest(names=names))
+    _submit(cluster, cpus=4)  # truth changes underneath
+    again = client.Nodes(pb.NodesRequest(names=names))
+    assert again == before  # frozen at window entry
+    client.set_tick(3)
+    after = client.Nodes(pb.NodesRequest(names=names))
+    assert sum(n.alloc_cpus for n in after.nodes) > sum(
+        n.alloc_cpus for n in before.nodes
+    )
+
+
+def test_sim_rpc_error_is_grpc_rpc_error():
+    err = SimRpcError(grpc.StatusCode.NOT_FOUND, "nope")
+    assert isinstance(err, grpc.RpcError)
+    assert err.code() == grpc.StatusCode.NOT_FOUND
+    assert err.details() == "nope"
+
+
+# ------------------------------------------------------------- invariants
+
+
+def test_invariants_catch_violations():
+    cluster, _ = _mini_cluster()
+    from slurm_bridge_tpu.bridge.objects import Meta, PodSpec, PodStatus
+    from slurm_bridge_tpu.core.types import JobDemand
+
+    # two pods owning the same job id + a gang bound with too few hints
+    node = next(iter(cluster.nodes))
+    pods = [
+        Pod(meta=Meta(name="a"),
+            spec=PodSpec(partition="part0", node_name="vn",
+                         placement_hint=(node,),
+                         demand=JobDemand(partition="part0")),
+            status=PodStatus(job_ids=(5,))),
+        Pod(meta=Meta(name="b"),
+            spec=PodSpec(partition="part0", node_name="vn",
+                         placement_hint=(node,),
+                         demand=JobDemand(partition="part0", nodes=4)),
+            status=PodStatus(job_ids=(5,))),
+    ]
+    out = check_tick(0, pods, cluster)
+    kinds = {v.invariant for v in out}
+    assert "no_double_bind" in kinds
+    assert "gang_atomicity" in kinds
+
+
+def test_invariants_capacity_ground_truth():
+    cluster, _ = _mini_cluster()
+    jid = _submit(cluster, cpus=2)
+    job = cluster.jobs[jid]
+    job.cpus_per_node = 10_000  # corrupt ground truth → must be caught
+    out = check_tick(0, [], cluster)
+    assert any(v.invariant == "capacity" for v in out)
+
+
+def test_per_node_demand_matches_encoder_sizing():
+    from slurm_bridge_tpu.core.types import JobDemand
+
+    d = JobDemand(partition="p", cpus_per_task=4, ntasks=2, nodes=4,
+                  mem_per_cpu_mb=1000, gres="gpu:gpu_type0:2")
+    cpu, mem, gpu = per_node_demand(d)
+    assert cpu == 2.0  # 8 total cpus over 4 shards
+    assert mem == 2000.0
+    assert gpu == 2.0  # gres is per-node, not divided
+
+
+# ------------------------------------------------------------- harness
+
+
+def test_harness_deterministic_and_drains():
+    results = [run_scenario(_tiny()) for _ in range(2)]
+    a, b = results
+    assert a.determinism_json() == b.determinism_json()
+    assert a.determinism["invariant_violations"] == []
+    assert a.determinism["bound_total"] > 0
+    assert a.determinism["pending_final"] == 0
+    assert a.determinism["drained_at_tick"] is not None
+    # phase breakdown present and the tick is the sum of its phases
+    for k in ("store", "encode", "solve", "bind", "mirror"):
+        assert k in a.timing["phases_p50_ms"]
+
+
+def test_harness_seed_changes_digest():
+    a = run_scenario(_tiny(seed=7))
+    b = run_scenario(_tiny(seed=8))
+    assert a.determinism["digest"] != b.determinism["digest"]
+
+
+def test_harness_rpc_fault_recovery():
+    faults = FaultPlan(
+        (Fault(kind="rpc_error", start_tick=2, end_tick=5,
+               methods=("SubmitJob", "JobInfo"), rate=0.5),)
+    )
+    r = run_scenario(_tiny(name="flaky", faults=faults, ticks=8))
+    assert sum(r.determinism["injected_errors"].values()) > 0
+    assert r.determinism["invariant_violations"] == []
+    assert r.determinism["recovery_ticks"] is not None
+    assert r.determinism["pending_final"] == 0
+
+
+@pytest.mark.slow
+def test_harness_preemption_storm_displaces():
+    # slow lane: `make sim-smoke` double-runs this scenario in make check
+    from slurm_bridge_tpu.sim.scenarios import preemption_storm
+
+    r = run_scenario(preemption_storm(scale=0.12))
+    assert r.determinism["preempted_total"] > 0
+    assert r.determinism["sim"]["cancelled"] > 0  # displaced jobs cancelled
+    assert r.determinism["invariant_violations"] == []
+    assert r.determinism["pending_final"] == 0
+
+
+@pytest.mark.slow
+def test_harness_partition_vanish_recovers():
+    # slow lane: `make sim-smoke` double-runs this scenario in make check
+    faults = FaultPlan(
+        (Fault(kind="partition_vanish", start_tick=2, end_tick=6,
+               partition="part1"),)
+    )
+    r = run_scenario(_tiny(name="vanish", faults=faults, ticks=10))
+    assert r.determinism["events"].get("VirtualNodeGone", 0) >= 1
+    assert r.determinism["invariant_violations"] == []
+    assert r.determinism["pending_final"] == 0
+
+
+@pytest.mark.slow
+def test_harness_node_churn_with_stale_snapshots():
+    # slow lane: `make sim-smoke` double-runs this scenario in make check
+    faults = FaultPlan(
+        (
+            Fault(kind="drain_nodes", start_tick=2, end_tick=6,
+                  node_fraction=0.25),
+            Fault(kind="stale_snapshot", start_tick=3, end_tick=5),
+            Fault(kind="lost_status", start_tick=3, end_tick=5),
+        )
+    )
+    r = run_scenario(_tiny(name="churn", faults=faults, ticks=10))
+    assert r.determinism["invariant_violations"] == []
+    assert r.determinism["pending_final"] == 0
+
+
+def test_scheduler_phase_timers_populated():
+    sc = _tiny(ticks=3)
+    h = SimHarness(sc)
+    h.run_tick(0)
+    phases = h.scheduler.last_phase_ms
+    assert set(phases) == {"store", "encode", "solve", "bind"}
+    assert all(v >= 0.0 for v in phases.values())
+    assert phases["store"] > 0.0
+
+
+def test_configurator_stop_keeps_nodes_remove_partition_deletes():
+    """ADVICE r5 #1 regression: a clean stop must NOT delete VirtualNodes
+    (node flap across restarts); only partition removal may."""
+    sc = _tiny(ticks=1, jobs=4)
+    h = SimHarness(sc)
+    h.run_tick(0)
+    nodes_before = {n.name for n in h.store.list(VirtualNode.KIND)}
+    assert nodes_before  # providers registered
+    h.configurator.stop()
+    assert {n.name for n in h.store.list(VirtualNode.KIND)} == nodes_before
+    # pools are closed: further syncs still converge serially
+    for p in h.configurator.providers.values():
+        assert p._pool is None and p._pool_closed
+        p.sync()
+    # partition removal is the one path that deletes the node
+    h.cluster.hide_partition("part0")
+    h.configurator.reconcile()
+    remaining = {n.name for n in h.store.list(VirtualNode.KIND)}
+    assert "slurm-partition-part0" not in remaining
+    assert remaining  # the others survived
+
+
+# ------------------------------------------------------------- CLI
+
+
+def test_cli_list_and_unknown(capsys):
+    from slurm_bridge_tpu.sim.cli import main
+
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "steady_poisson" in out and "full_50kx10k" in out
+    with pytest.raises(SystemExit):
+        main(["not-a-scenario"])
+
+
+def test_cli_runs_scenario_json(tmp_path, capsys):
+    from slurm_bridge_tpu.sim.cli import main
+
+    out_file = tmp_path / "r.json"
+    rc = main(
+        ["steady_poisson", "--scale", "0.03", "--ticks", "4",
+         "--out", str(out_file)]
+    )
+    assert rc == 0
+    line = [
+        l for l in capsys.readouterr().out.splitlines() if l.startswith("{")
+    ][0]
+    obj = json.loads(line)
+    assert obj["scenario"] == "steady_poisson"
+    assert "digest" in obj["determinism"]
+    assert set(obj["timing"]["phases_p50_ms"]) == {
+        "store", "encode", "solve", "bind", "mirror"
+    }
+    saved = json.loads(out_file.read_text())
+    assert saved[0]["determinism"]["digest"] == obj["determinism"]["digest"]
+
+
+@pytest.mark.slow
+def test_full_50kx10k_headline():
+    """The previously-unmeasured headline: the full-bridge tick at the
+    product shape runs end to end with its phase breakdown.
+
+    Defaults to a 1/5-scale shape (10k pods × 2k nodes, ~minutes) so the
+    repo's own full lane stays tractable; SBT_SIM_FULL=1 runs the true
+    50k × 10k (tens of minutes — the recorded number lives in BASELINE.md
+    and is reproducible via `make sim-bench`)."""
+    import os
+
+    from slurm_bridge_tpu.sim.scenarios import full_50kx10k
+
+    scale = 1.0 if os.environ.get("SBT_SIM_FULL") == "1" else 0.2
+    sc = full_50kx10k(scale=scale)
+    r = run_scenario(sc)
+    assert r.shape["nodes"] == sc.cluster.num_nodes
+    assert r.shape["pods"] >= 0.9 * sc.workload.jobs
+    assert r.determinism["bound_total"] > 0.2 * sc.workload.jobs
+    assert r.determinism["invariant_violations"] == []
+    t = r.timing
+    assert t["tick_p50_ms"] > 0
+    assert all(t["phases_p50_ms"][k] >= 0 for k in
+               ("store", "encode", "solve", "bind", "mirror"))

@@ -467,3 +467,37 @@ def test_auto_bridge_routes_through_sidecar_to_indexed(tmp_path, monkeypatch):
         job = bridge.wait("auto-remote", timeout=20.0)
         assert job.status.state == JobState.SUCCEEDED
         assert bridge.scheduler.last_route == "remote-indexed"
+
+
+def test_zero_demand_wire_skew_guard():
+    """ADVICE r5 #3 regression: jobs arriving with cpus==0 AND mem_mb==0
+    (the signature of a version-skewed peer writing the pre-renumber
+    field ids) must be counted loudly, not placed silently as zero-cost."""
+    from slurm_bridge_tpu.solver.service import _zero_demand_total
+
+    servicer = PlacementSolverServicer(solver="greedy")
+    before = _zero_demand_total.value()
+    resp = servicer.Place(
+        pb.PlaceRequest(
+            jobs=[
+                pb.PlaceJob(id="skewed-a"),
+                pb.PlaceJob(id="skewed-b", gpus=1),
+                pb.PlaceJob(id="honest", cpus=1, mem_mb=512),
+            ],
+            inventory=_inventory(2),
+            partitions=_partitions({"": ["n0", "n1"]}),
+        ),
+        None,
+    )
+    assert resp.total == 3
+    assert _zero_demand_total.value() - before == 2
+    # a second Place keeps counting (counter, not gauge)
+    servicer.Place(
+        pb.PlaceRequest(
+            jobs=[pb.PlaceJob(id="skewed-c")],
+            inventory=_inventory(2),
+            partitions=_partitions({"": ["n0", "n1"]}),
+        ),
+        None,
+    )
+    assert _zero_demand_total.value() - before == 3
