@@ -182,6 +182,37 @@ class WorkloadServicer:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         return pb.JobInfoResponse(info=[job_info_to_proto(j) for j in infos])
 
+    def JobsInfo(self, request: pb.JobsInfoRequest, context) -> pb.JobsInfoResponse:
+        """Batched JobInfo (PR-3): one RPC round-trip for a provider's
+        whole status-mirror pass. A job the driver no longer knows comes
+        back found=false instead of aborting the batch — the other 49,999
+        answers must not die with it.
+
+        Each driver query still execs one Slurm CLI, so the batch fans
+        out across a small thread pool — a serial loop would hold the RPC
+        (and a gRPC worker thread) for exec-latency × batch-size, slower
+        than the per-pod path it replaced.
+        """
+
+        def one(job_id: int) -> pb.JobsInfoEntry:
+            try:
+                infos = self.driver.job_info(job_id)
+            except SlurmError:
+                return pb.JobsInfoEntry(job_id=job_id, found=False)
+            return pb.JobsInfoEntry(
+                job_id=job_id,
+                found=True,
+                info=[job_info_to_proto(j) for j in infos],
+            )
+
+        ids = [int(j) for j in request.job_ids]
+        if len(ids) <= 1:
+            return pb.JobsInfoResponse(jobs=[one(i) for i in ids])
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(8, len(ids))) as pool:
+            return pb.JobsInfoResponse(jobs=list(pool.map(one, ids)))
+
     def JobSteps(self, request: pb.JobStepsRequest, context) -> pb.JobStepsResponse:
         try:
             steps = self.driver.job_steps(int(request.job_id))

@@ -27,6 +27,7 @@ from slurm_bridge_tpu.bridge.objects import (
 )
 from slurm_bridge_tpu.bridge.store import (
     Conflict,
+    FrozenInstanceError,
     NotFound,
     ObjectStore,
     StoreEvent,
@@ -43,6 +44,7 @@ __all__ = [
     "BridgeJobStatus",
     "Conflict",
     "FetchJob",
+    "FrozenInstanceError",
     "Meta",
     "NotFound",
     "ObjectStore",

@@ -51,6 +51,9 @@ class TypedClient:
     def try_get(self, name: str):
         return self._store.try_get(self.kind, name)
 
+    def get_for_update(self, name: str):
+        return self._store.get_for_update(self.kind, name)
+
     def update(self, obj):
         return self._store.update(obj)
 

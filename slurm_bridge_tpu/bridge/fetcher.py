@@ -12,6 +12,7 @@ failing fails the job, result.go:26).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import logging
 import os
 import sys
@@ -87,7 +88,9 @@ class FetchWorker:
         if claimed.state != FetchState.RUNNING:
             return
 
-        files = claimed.files
+        # private copy: the claimed snapshot is frozen, and the transfer
+        # loop below checks files off in place
+        files = [dataclasses.replace(f) for f in claimed.files]
         failure = ""
         for f in files:
             if f.done:

@@ -14,6 +14,7 @@ fields override them, default 1 node / 1 cpu / 1024 MB-per-cpu
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 
@@ -215,31 +216,47 @@ class BridgeOperator:
             info.key(): SubjobStatus.from_job_info(info)
             for info in pod.status.job_infos
         }
+        pod_reason = pod.status.reason
 
-        def record(job: BridgeJob):
-            changed = False
+        def build(job: BridgeJob):
+            """Replacement CR sharing frozen spec/meta children — the
+            no-change case (steady-state reconciles) costs zero copies and
+            skips the write (no self-feeding watch loop)."""
+            new_subjobs = job.status.subjobs
             if subjobs and job.status.subjobs != subjobs:
-                job.status.subjobs = subjobs
-                changed = True
+                new_subjobs = subjobs
             new_state = state
             # don't regress a terminal CR state on a stale pod read
             if job.status.state in JobState.TERMINAL:
                 new_state = job.status.state
-            if job.status.state != new_state:
-                job.status.state = new_state
-                changed = True
-            reason = pod.status.reason
-            if reason and job.status.reason != reason:
-                job.status.reason = reason
-                changed = True
-            if self.agent_endpoint and not job.status.cluster_endpoint:
-                job.status.cluster_endpoint = self.agent_endpoint
-                changed = True
-            return changed  # False skips the write (no self-feeding watch loop)
+            new_reason = job.status.reason
+            if pod_reason and job.status.reason != pod_reason:
+                new_reason = pod_reason
+            endpoint = job.status.cluster_endpoint
+            if self.agent_endpoint and not endpoint:
+                endpoint = self.agent_endpoint
+            if (
+                new_subjobs is job.status.subjobs
+                and new_state == job.status.state
+                and new_reason == job.status.reason
+                and endpoint == job.status.cluster_endpoint
+            ):
+                return None
+            return BridgeJob(
+                meta=dataclasses.replace(job.meta),
+                spec=job.spec,
+                status=dataclasses.replace(
+                    job.status,
+                    state=new_state,
+                    reason=new_reason,
+                    subjobs=new_subjobs,
+                    cluster_endpoint=endpoint,
+                ),
+            )
 
         try:
             before = self.store.get(BridgeJob.KIND, job_name)
-            after = self.store.mutate(BridgeJob.KIND, job_name, record)
+            after = self.store.replace_update(BridgeJob.KIND, job_name, build)
         except NotFound:
             return
         if before.status.state != after.status.state:
@@ -294,15 +311,20 @@ class BridgeOperator:
                 pass
             return
 
-        def refresh(p: Pod):
+        def build(p: Pod):
             phase = sizecar.status.phase if sizecar else p.status.phase
             if p.status.containers == containers and p.status.phase == phase:
-                return False
-            p.status.containers = containers
-            p.status.phase = phase
+                return None
+            return Pod(
+                meta=dataclasses.replace(p.meta),
+                spec=p.spec,
+                status=dataclasses.replace(
+                    p.status, containers=containers, phase=phase
+                ),
+            )
 
         try:
-            self.store.mutate(Pod.KIND, name, refresh)
+            self.store.replace_update(Pod.KIND, name, build)
         except NotFound:
             pass
 

@@ -28,6 +28,7 @@ the requeue as a fresh submission.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import time
 
@@ -177,6 +178,11 @@ class PlacementScheduler:
         # cancels whose pod vanished before the failure could be annotated;
         # retried alongside the annotated ones
         self._orphan_cancels: set[int] = set()
+        #: pods currently carrying a pending-cancel annotation, maintained
+        #: from the store's per-kind dirty-set — the retry pass no longer
+        #: scans all 50k pods per tick to find the (usually zero) carriers
+        self._pending_cancel_pods: set[str] = set()
+        self._cancel_scan_rv = 0
         #: which engine the last local solve ran on ("greedy", "native",
         #: "auction", "auction-sharded") — observability for the routing
         #: decision (VERDICT r3 #5); tests assert on it
@@ -218,11 +224,12 @@ class PlacementScheduler:
     # ---- the solve tick ----
 
     def pending_pods(self) -> list[Pod]:
+        # the ``(kind, node_name)`` index: unbound pods all live in the
+        # "" bucket, so the pending scan never touches bound pods at all
         return [
             p
-            for p in self.store.list(Pod.KIND)
+            for p in self.store.list_by_node(Pod.KIND, "")
             if p.spec.role == PodRole.SIZECAR
-            and not p.spec.node_name
             and not p.meta.deleted
             and p.status.phase == PodPhase.PENDING
         ]
@@ -295,13 +302,12 @@ class PlacementScheduler:
             for vn in self.store.list(VirtualNode.KIND)
             if vn.ready and not vn.meta.deleted
         }
-        placed = 0
+        binds: list[tuple[Pod, str, tuple[str, ...]]] = []
         for j, pod in enumerate(pods):
             names = by_job_names.get(j)
             partition = demands[j].partition
             if names and partition in ready_nodes:
-                if self._bind(pod, partition_node_name(partition), tuple(names)):
-                    placed += 1
+                binds.append((pod, partition_node_name(partition), tuple(names)))
             else:
                 reason = (
                     "Unschedulable: insufficient capacity"
@@ -309,6 +315,7 @@ class PlacementScheduler:
                     else f"Unschedulable: no ready virtual node for partition {partition!r}"
                 )
                 self._mark_unschedulable(pod, reason)
+        placed = self._bind_batch(binds)
         preempted = 0
         for j in lost_jobs:
             if self._preempt(all_pods[j]):
@@ -653,9 +660,29 @@ class PlacementScheduler:
                 sorted(self._orphan_cancels), context="retry", timeout=tmo
             )
             self._orphan_cancels = set(still)
-        for pod in self.store.list(Pod.KIND):
-            pending = pod.meta.annotations.get(PENDING_CANCEL_ANNOTATION)
+        # dirty-set scan (changes_since): only pods written since the last
+        # tick can have gained or shed the annotation
+        rv, changed, deleted = self.store.changes_since(
+            Pod.KIND, self._cancel_scan_rv
+        )
+        self._cancel_scan_rv = rv
+        for name in deleted:
+            self._pending_cancel_pods.discard(name)
+        for name in changed:
+            p = self.store.try_get(Pod.KIND, name)
+            if p is not None and p.meta.annotations.get(PENDING_CANCEL_ANNOTATION):
+                self._pending_cancel_pods.add(name)
+            else:
+                self._pending_cancel_pods.discard(name)
+        for name in sorted(self._pending_cancel_pods):
+            pod = self.store.try_get(Pod.KIND, name)
+            pending = (
+                pod.meta.annotations.get(PENDING_CANCEL_ANNOTATION)
+                if pod is not None
+                else None
+            )
             if not pending:
+                self._pending_cancel_pods.discard(name)
                 continue
             ids = [int(t) for t in pending.split(",") if t]
             still = set(self._cancel_jobs(ids, context="retry", timeout=tmo))
@@ -681,6 +708,45 @@ class PlacementScheduler:
                 self.store.mutate(Pod.KIND, pod.name, record)
             except NotFound:
                 self._orphan_cancels.update(still)
+
+    def _bind_batch(self, binds: list[tuple[Pod, str, tuple[str, ...]]]) -> int:
+        """Commit every bind of the tick under ONE store lock acquisition.
+
+        Each replacement pod is built with ``dataclasses.replace`` so
+        unchanged frozen sub-objects (demand, labels, job_infos) are
+        structurally shared instead of deep-copied — at the headline shape
+        this turned a 13.7 s bind phase of 45k mutate() round-trips into
+        one ``update_batch``. The optimistic resource_version carried from
+        the pending read is exactly the old mutate guard: ANY interim
+        write (a concurrent bind, a deletion mark) conflicts, and the
+        loser falls back to the single-pod read-modify-write path.
+        """
+        if not binds:
+            return 0
+        updated = [
+            Pod(
+                meta=dataclasses.replace(pod.meta),
+                spec=dataclasses.replace(
+                    pod.spec, node_name=node_name, placement_hint=hint
+                ),
+                status=dataclasses.replace(pod.status, reason=""),
+            )
+            for pod, node_name, hint in binds
+        ]
+        results = self.store.update_batch(updated)
+        placed = 0
+        for (pod, node_name, hint), res in zip(binds, results):
+            if isinstance(res, Exception):
+                if self._bind(pod, node_name, hint):
+                    placed += 1
+                continue
+            placed += 1
+            self.events.event(
+                pod,
+                Reason.PLACEMENT_OK,
+                f"bound to {node_name} (nodes {','.join(hint)})",
+            )
+        return placed
 
     def _bind(self, pod: Pod, node_name: str, hint: tuple[str, ...]) -> bool:
         bound = [False]
@@ -708,12 +774,16 @@ class PlacementScheduler:
     def _mark_unschedulable(self, pod: Pod, reason: str) -> None:
         try:
 
-            def record(p: Pod):
+            def build(p: Pod):
                 if p.status.reason == reason:
-                    return False
-                p.status.reason = reason
+                    return None
+                return Pod(
+                    meta=dataclasses.replace(p.meta),
+                    spec=p.spec,
+                    status=dataclasses.replace(p.status, reason=reason),
+                )
 
-            self.store.mutate(Pod.KIND, pod.name, record)
+            self.store.replace_update(Pod.KIND, pod.name, build)
         except NotFound:
             return
         self.events.event(pod, Reason.PLACEMENT_FAILED, reason, warning=True)

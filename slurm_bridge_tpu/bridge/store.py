@@ -8,6 +8,26 @@ component keeps the reference's architecture (SURVEY.md §3 call stacks)
 while the framework runs standalone. Swapping this for a real kube client
 retargets the bridge at an actual cluster — the interface is the seam.
 
+Read path (the PR-3 rework): reads hand out **immutable copy-on-read
+snapshots** — the stored object itself, frozen once at write time
+(:mod:`bridge.freeze`) — instead of deep-copying on every ``get``/``list``.
+Mutating a snapshot raises :class:`freeze.FrozenInstanceError`; writers go
+through :meth:`mutate` / :meth:`get_for_update`, which hand them a private
+thawed copy. At the 100k-object headline shape this removes the dominant
+cost of the reconcile tick (BASELINE.md PR-2: 14.3 s of store deep-copies
+per tick).
+
+Write path: writers pass fresh objects (``update``/``create`` take
+ownership and freeze the argument in place); :meth:`update_batch` applies
+many optimistic-concurrency writes under ONE lock acquisition — the
+scheduler's bind loop rides it.
+
+Indexes: a secondary index on ``(kind, spec.node_name)`` serves each
+virtual-node provider exactly its own pods (:meth:`list_by_node`), and a
+per-kind monotonic dirty-set keyed by ``resource_version``
+(:meth:`changes_since`) lets level-triggered consumers scan only what
+changed since their last pass.
+
 Objects are stored by (kind, name). Writers must pass the object they last
 read; a stale ``meta.resource_version`` raises :class:`Conflict`, same as
 a 409 from the API server (controllers retry via requeue).
@@ -15,10 +35,32 @@ a 409 from the API server (controllers retry via requeue).
 
 from __future__ import annotations
 
-import copy
 import queue
 import threading
+import time
 from dataclasses import dataclass
+
+from slurm_bridge_tpu.bridge.freeze import (
+    FrozenInstanceError,
+    freeze,
+    thaw,
+)
+from slurm_bridge_tpu.obs.metrics import REGISTRY, Histogram
+
+__all__ = [
+    "AlreadyExists",
+    "Conflict",
+    "FrozenInstanceError",
+    "NotFound",
+    "ObjectStore",
+    "StoreEvent",
+]
+
+_list_seconds = REGISTRY.histogram(
+    "sbt_store_list_seconds",
+    "store list/list_by_node wall time per call (copy-on-read path)",
+    buckets=Histogram.FAST_BUCKETS,
+)
 
 
 class NotFound(KeyError):
@@ -42,10 +84,30 @@ class StoreEvent:
     name: str
 
 
+def _node_of(obj) -> str | None:
+    """The secondary-index key: ``spec.node_name`` where present."""
+    spec = obj.__dict__.get("spec")
+    if spec is None:
+        return None
+    node = getattr(spec, "node_name", None)
+    return node if isinstance(node, str) else None
+
+
 class ObjectStore:
     def __init__(self):
         self._lock = threading.RLock()
-        self._objects: dict[tuple[str, str], object] = {}
+        #: kind -> name -> frozen stored object
+        self._by_kind: dict[str, dict[str, object]] = {}
+        #: kind -> node_name -> set of names bound there (Pods, mostly)
+        self._by_node: dict[str, dict[str, set[str]]] = {}
+        #: name-sorted cache per kind / per (kind, node); None = stale.
+        #: Updates keep membership, so only create/delete invalidate.
+        self._sorted_names: dict[str, list[str] | None] = {}
+        self._node_sorted: dict[tuple[str, str], list[str] | None] = {}
+        #: monotonic dirty-set: kind -> name -> rv of last create/update,
+        #: and the tombstone side: kind -> name -> rv at delete
+        self._changed: dict[str, dict[str, int]] = {}
+        self._tombstones: dict[str, dict[str, int]] = {}
         self._rv = 0
         self._watchers: list[tuple[queue.Queue, tuple[str, ...] | None]] = []
 
@@ -67,9 +129,10 @@ class ObjectStore:
         """
         q: queue.Queue = queue.Queue()
         with self._lock:
-            for (kind, name) in self._objects:
+            for kind, objs in self._by_kind.items():
                 if kinds is None or kind in kinds:
-                    q.put(StoreEvent("ADDED", kind, name))
+                    for name in objs:
+                        q.put(StoreEvent("ADDED", kind, name))
             self._watchers.append((q, kinds))
         return q
 
@@ -77,24 +140,83 @@ class ObjectStore:
         with self._lock:
             self._watchers = [(w, k) for (w, k) in self._watchers if w is not q]
 
+    # ---- index maintenance (call with the lock held) ----
+
+    def _index_add(self, kind: str, name: str, obj) -> None:
+        node = _node_of(obj)
+        if node is not None:
+            self._by_node.setdefault(kind, {}).setdefault(node, set()).add(name)
+            self._node_sorted[(kind, node)] = None
+
+    def _index_remove(self, kind: str, name: str, obj) -> None:
+        node = _node_of(obj)
+        if node is None:
+            return
+        bucket = self._by_node.get(kind, {}).get(node)
+        if bucket is not None:
+            bucket.discard(name)
+            if not bucket:
+                del self._by_node[kind][node]
+            self._node_sorted[(kind, node)] = None
+
+    def _index_move(self, kind: str, name: str, old, new) -> None:
+        old_node, new_node = _node_of(old), _node_of(new)
+        if old_node == new_node:
+            return
+        self._index_remove(kind, name, old)
+        self._index_add(kind, name, new)
+
+    def _record_change(self, kind: str, name: str) -> None:
+        self._changed.setdefault(kind, {})[name] = self._rv
+        tombs = self._tombstones.get(kind)
+        if tombs is not None:
+            tombs.pop(name, None)
+
+    #: tombstones kept per kind; beyond this the oldest are compacted away
+    #: so a long-running bridge's delete churn doesn't grow memory (and
+    #: the changes_since scan) forever. A consumer further than this many
+    #: deletions behind misses some tombstones — every in-repo consumer
+    #: self-heals (the scheduler's cancel scan drops names whose try_get
+    #: misses), same contract as a K8s watch falling off the event horizon.
+    TOMBSTONE_LIMIT = 10_000
+
+    def _record_delete(self, kind: str, name: str) -> None:
+        self._changed.get(kind, {}).pop(name, None)
+        tombs = self._tombstones.setdefault(kind, {})
+        tombs[name] = self._rv
+        # compact with 25% slack so the sort amortizes over many deletes
+        if len(tombs) > self.TOMBSTONE_LIMIT * 5 // 4:
+            for old in sorted(tombs, key=tombs.__getitem__)[
+                : len(tombs) - self.TOMBSTONE_LIMIT
+            ]:
+                del tombs[old]
+
     # ---- CRUD ----
 
     def create(self, obj) -> object:
+        """Insert ``obj``; the store takes ownership and freezes it in
+        place. The returned object IS the stored (frozen) snapshot."""
         with self._lock:
-            key = self._key(obj)
-            if key in self._objects:
+            kind, name = key = self._key(obj)
+            objs = self._by_kind.setdefault(kind, {})
+            if name in objs:
                 raise AlreadyExists(f"{key[0]}/{key[1]} already exists")
             self._rv += 1
             obj.meta.resource_version = self._rv
-            stored = copy.deepcopy(obj)
-            self._objects[key] = stored
-            self._notify("ADDED", *key)
-        return copy.deepcopy(stored)
+            freeze(obj)
+            objs[name] = obj
+            self._sorted_names[kind] = None
+            self._index_add(kind, name, obj)
+            self._record_change(kind, name)
+            self._notify("ADDED", kind, name)
+        return obj
 
     def get(self, kind: str, name: str) -> object:
+        """The current frozen snapshot — shared, zero-copy. To modify,
+        use :meth:`mutate` or :meth:`get_for_update`."""
         with self._lock:
             try:
-                return copy.deepcopy(self._objects[(kind, name)])
+                return self._by_kind[kind][name]
             except KeyError:
                 raise NotFound(f"{kind}/{name}") from None
 
@@ -104,72 +226,199 @@ class ObjectStore:
         except NotFound:
             return None
 
+    def get_for_update(self, kind: str, name: str) -> object:
+        """A private, mutable deep copy for read-modify-write callers
+        (pass it back through :meth:`update`)."""
+        return thaw(self.get(kind, name))
+
     def update(self, obj) -> object:
-        """Replace; raises Conflict if the caller's copy is stale."""
+        """Replace; raises Conflict if the caller's copy is stale.
+
+        Takes ownership of ``obj`` (freezes it in place) — callers keep
+        reading it but can no longer mutate it."""
         with self._lock:
-            key = self._key(obj)
-            current = self._objects.get(key)
-            if current is None:
-                raise NotFound(f"{key[0]}/{key[1]}")
-            if current.meta.resource_version != obj.meta.resource_version:
-                raise Conflict(
-                    f"{key[0]}/{key[1]}: stale resource_version "
-                    f"{obj.meta.resource_version} != {current.meta.resource_version}"
-                )
-            self._rv += 1
-            obj.meta.resource_version = self._rv
-            stored = copy.deepcopy(obj)
-            self._objects[key] = stored
-            self._notify("MODIFIED", *key)
-        return copy.deepcopy(stored)
+            return self._commit_update(obj)
+
+    def _commit_update(self, obj) -> object:
+        """One optimistic write; caller holds the lock."""
+        kind, name = self._key(obj)
+        objs = self._by_kind.get(kind, {})
+        current = objs.get(name)
+        if current is None:
+            raise NotFound(f"{kind}/{name}")
+        if current.meta.resource_version != obj.meta.resource_version:
+            raise Conflict(
+                f"{kind}/{name}: stale resource_version "
+                f"{obj.meta.resource_version} != {current.meta.resource_version}"
+            )
+        self._rv += 1
+        obj.meta.resource_version = self._rv
+        freeze(obj)
+        objs[name] = obj
+        self._index_move(kind, name, current, obj)
+        self._record_change(kind, name)
+        self._notify("MODIFIED", kind, name)
+        return obj
+
+    def update_batch(self, objs: list) -> list:
+        """Apply many optimistic-concurrency writes under ONE lock
+        acquisition (the scheduler's bind path).
+
+        Returns one entry per input, in order: the stored (frozen) object
+        on success, or the :class:`Conflict`/:class:`NotFound` instance
+        that write raised. A failed write never aborts the batch — each
+        object stands alone, exactly as if written via :meth:`update`.
+        """
+        out: list = []
+        with self._lock:
+            for obj in objs:
+                try:
+                    out.append(self._commit_update(obj))
+                except (Conflict, NotFound) as exc:
+                    out.append(exc)
+        return out
 
     def delete(self, kind: str, name: str) -> None:
-        """Delete an object and cascade to objects it owns (owner refs)."""
+        """Delete an object and cascade transitively through owner refs:
+        children, grandchildren, and so on all go (K8s garbage-collector
+        semantics — one level was not enough, a BridgeJob→Pod→owned-object
+        chain leaked the leaves)."""
         with self._lock:
-            if (kind, name) not in self._objects:
+            objs = self._by_kind.get(kind, {})
+            if name not in objs:
                 raise NotFound(f"{kind}/{name}")
-            del self._objects[(kind, name)]
-            self._notify("DELETED", kind, name)
-            owned = [
-                k
-                for k, o in self._objects.items()
-                if getattr(o.meta, "owner", "") == name
-            ]
-            for okind, oname in owned:
-                del self._objects[(okind, oname)]
-                self._notify("DELETED", okind, oname)
+            self._delete_one(kind, name)
+            frontier = {name}
+            while frontier:
+                owned = sorted(
+                    (k, n)
+                    for k, kobjs in self._by_kind.items()
+                    for n, o in kobjs.items()
+                    if getattr(o.meta, "owner", "") in frontier
+                )
+                frontier = set()
+                for okind, oname in owned:
+                    self._delete_one(okind, oname)
+                    frontier.add(oname)
+
+    def _delete_one(self, kind: str, name: str) -> None:
+        obj = self._by_kind[kind].pop(name)
+        self._sorted_names[kind] = None
+        self._index_remove(kind, name, obj)
+        self._rv += 1
+        self._record_delete(kind, name)
+        self._notify("DELETED", kind, name)
+
+    # ---- reads over many objects ----
+
+    def _names(self, kind: str) -> list[str]:
+        names = self._sorted_names.get(kind)
+        if names is None:
+            names = sorted(self._by_kind.get(kind, {}))
+            self._sorted_names[kind] = names
+        return names
 
     def list(self, kind: str, *, labels: dict[str, str] | None = None) -> list:
+        """Name-sorted frozen snapshots of every object of ``kind``."""
+        t0 = time.perf_counter()
         with self._lock:
-            out = []
-            for (k, _), obj in self._objects.items():
-                if k != kind:
-                    continue
-                if labels and any(
-                    obj.meta.labels.get(lk) != lv for lk, lv in labels.items()
-                ):
-                    continue
-                out.append(copy.deepcopy(obj))
-        out.sort(key=lambda o: o.meta.name)
+            objs = self._by_kind.get(kind, {})
+            out = [objs[n] for n in self._names(kind)]
+        if labels:
+            out = [
+                o
+                for o in out
+                if all(o.meta.labels.get(lk) == lv for lk, lv in labels.items())
+            ]
+        _list_seconds.observe(time.perf_counter() - t0)
+        return out
+
+    def list_by_node(self, kind: str, node_name: str) -> list:
+        """Name-sorted frozen snapshots of the objects whose
+        ``spec.node_name`` equals ``node_name`` — the secondary index that
+        lets each virtual-node provider list only ITS pods instead of
+        copying the whole store every sync tick."""
+        t0 = time.perf_counter()
+        with self._lock:
+            bucket = self._by_node.get(kind, {}).get(node_name)
+            if not bucket:
+                _list_seconds.observe(time.perf_counter() - t0)
+                return []
+            names = self._node_sorted.get((kind, node_name))
+            if names is None:
+                names = sorted(bucket)
+                self._node_sorted[(kind, node_name)] = names
+            objs = self._by_kind.get(kind, {})
+            out = [objs[n] for n in names]
+        _list_seconds.observe(time.perf_counter() - t0)
         return out
 
     def owned_by(self, kind: str, owner: str) -> list:
+        """Name-sorted (same order as :meth:`list` — reconcilers iterating
+        owned sets must be deterministic) frozen snapshots."""
         with self._lock:
-            return [
-                copy.deepcopy(o)
-                for (k, _), o in self._objects.items()
-                if k == kind and o.meta.owner == owner
-            ]
+            return sorted(
+                (
+                    o
+                    for o in self._by_kind.get(kind, {}).values()
+                    if o.meta.owner == owner
+                ),
+                key=lambda o: o.meta.name,
+            )
+
+    def changes_since(
+        self, kind: str, since_rv: int
+    ) -> tuple[int, list[str], list[str]]:
+        """The per-kind monotonic dirty-set: ``(rv, changed, deleted)``.
+
+        ``changed``/``deleted`` are the name-sorted sets of objects
+        created-or-updated / deleted after ``since_rv``; feed the returned
+        ``rv`` back in on the next call. ``since_rv=0`` returns everything
+        (and every tombstone still remembered), so consumers converge from
+        any start point — the watch contract, poll-shaped.
+        """
+        with self._lock:
+            rv = self._rv
+            changed = sorted(
+                n
+                for n, r in self._changed.get(kind, {}).items()
+                if r > since_rv
+            )
+            deleted = sorted(
+                n
+                for n, r in self._tombstones.get(kind, {}).items()
+                if r > since_rv
+            )
+        return rv, changed, deleted
 
     # ---- convenience used by reconcilers ----
 
     def mutate(self, kind: str, name: str, fn, *, retries: int = 8):
-        """Read-modify-write with conflict retry; fn mutates in place and
-        may return False to skip the write."""
+        """Read-modify-write with conflict retry; fn mutates a private
+        thawed copy in place and may return False to skip the write."""
         for _ in range(retries):
-            obj = self.get(kind, name)
+            snapshot = self.get(kind, name)
+            obj = thaw(snapshot)
             if fn(obj) is False:
-                return obj
+                return snapshot
+            try:
+                return self.update(obj)
+            except Conflict:
+                continue
+        raise Conflict(f"{kind}/{name}: too many conflicts")
+
+    def replace_update(self, kind: str, name: str, build, *, retries: int = 8):
+        """Optimistic write without the deep copy: ``build(snapshot)``
+        returns a REPLACEMENT object (``dataclasses.replace``-style,
+        structurally sharing the snapshot's frozen sub-objects) or None to
+        skip the write. The hot write paths (status mirror, bind) ride
+        this instead of :meth:`mutate` — no thaw, no deepcopy, unchanged
+        children shared between versions."""
+        for _ in range(retries):
+            snapshot = self.get(kind, name)
+            obj = build(snapshot)
+            if obj is None:
+                return snapshot
             try:
                 return self.update(obj)
             except Conflict:

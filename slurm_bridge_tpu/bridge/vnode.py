@@ -40,6 +40,7 @@ from slurm_bridge_tpu.bridge.store import AlreadyExists, NotFound, ObjectStore
 from slurm_bridge_tpu.core.arrays import array_len
 from slurm_bridge_tpu.core.types import JobInfo, JobStatus, NodeInfo, PartitionInfo
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
+from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.wire import ServiceClient, pb
 from slurm_bridge_tpu.wire.convert import (
     demand_to_submit,
@@ -50,6 +51,22 @@ from slurm_bridge_tpu.wire.convert import (
 
 log = logging.getLogger("sbt.vnode")
 
+_sync_seconds = REGISTRY.histogram(
+    "sbt_provider_sync_seconds",
+    "one provider sync tick: node refresh + pod converge + status mirror",
+)
+_status_seconds = REGISTRY.histogram(
+    "sbt_provider_status_seconds",
+    "the bulk status-mirror phase of a provider sync tick",
+)
+_bulk_queries = REGISTRY.counter(
+    "sbt_provider_bulk_status_total", "batched JobsInfo queries issued"
+)
+_bulk_fallbacks = REGISTRY.counter(
+    "sbt_provider_bulk_fallback_total",
+    "provider ticks that fell back to per-pod JobInfo (agent lacks JobsInfo)",
+)
+
 #: gRPC codes meaning "the agent is unreachable / busy", not "the request
 #: is bad" — submissions stay Pending and retry on the next sync instead
 #: of failing the pod (the reference fails it either way, provider.go:54).
@@ -59,6 +76,51 @@ _TRANSIENT_RPC = (
     grpc.StatusCode.RESOURCE_EXHAUSTED,
     grpc.StatusCode.CANCELLED,
 )
+
+
+def _status_replacement(pod: Pod, infos: list[JobInfo], phase: str) -> Pod:
+    """A replacement pod carrying the new job state, structurally sharing
+    every frozen sub-object that did not change (spec, labels, …) — the
+    zero-deepcopy write the frozen store makes safe."""
+    return Pod(
+        meta=dataclasses.replace(pod.meta),
+        spec=pod.spec,
+        status=dataclasses.replace(
+            pod.status, job_infos=list(infos), phase=phase
+        ),
+    )
+
+
+#: every JobInfo field EXCEPT the always-ticking runtime counter — derived
+#: from the dataclass so a field added later is diffed by construction
+#: instead of silently excluded
+_INFO_DIFF_FIELDS: tuple[str, ...] = tuple(
+    f.name for f in dataclasses.fields(JobInfo) if f.name != "run_time_s"
+)
+
+#: ids per JobsInfo request: bounds both the response size (gRPC's default
+#: 4 MB message cap — ~50k infos would blow straight through it) and the
+#: per-RPC latency a serial agent-side handler can accumulate
+_BULK_CHUNK = 2000
+
+
+def _infos_equivalent(a: list[JobInfo], b: list[JobInfo]) -> bool:
+    """Whether two job-info lists say the same thing, ignoring the
+    always-ticking ``run_time_s`` counter.
+
+    The diff-driven mirror (PR-3) must not rewrite every RUNNING pod every
+    sync just because its elapsed-runtime display advanced; run_time rides
+    along whenever a real change (state, nodes, exit code, …) lands.
+    """
+    if len(a) != len(b):
+        return False
+    fields = _INFO_DIFF_FIELDS
+    for x, y in zip(a, b):
+        dx, dy = x.__dict__, y.__dict__
+        for name in fields:
+            if dx.get(name) != dy.get(name):
+                return False
+    return True
 
 
 class VirtualNodeProvider:
@@ -72,6 +134,7 @@ class VirtualNodeProvider:
         events: EventRecorder | None = None,
         inventory_ttl: float = 5.0,
         sync_workers: int = 10,
+        status_interval: float = 10.0,
     ):
         self.store = store
         self.client = client
@@ -80,6 +143,15 @@ class VirtualNodeProvider:
         self.agent_endpoint = agent_endpoint
         self.events = events or EventRecorder()
         self.inventory_ttl = inventory_ttl
+        #: max heartbeat age before the node object is rewritten even with
+        #: unchanged capacity — between heartbeats an unchanged node costs
+        #: ZERO store writes per sync (the reference's kubelet pushes node
+        #: status once a MINUTE; writing every 250 ms sync was pure churn)
+        self.status_interval = status_interval
+        #: whether the agent speaks the batched JobsInfo RPC; flipped off
+        #: on the first UNIMPLEMENTED and the mirror falls back to the
+        #: per-pod JobInfo loop (old agents keep working, just slower)
+        self._bulk_supported = True
         #: parallel pod converges per sync tick — the reference's
         #: PodSyncWorkers (DefaultPodSyncWorkers = 10,
         #: cmd/slurm-virtual-kubelet/app/options/options.go:107): each
@@ -137,9 +209,7 @@ class VirtualNodeProvider:
         the surface the reference declares but ships commented out
         (provider.go:324-392)."""
         out = []
-        for pod in self.store.list(Pod.KIND):
-            if pod.spec.node_name != self.node_name:
-                continue
+        for pod in self.store.list_by_node(Pod.KIND, self.node_name):
             dem = pod.spec.demand
             arr = array_len(dem.array) if dem else 1
             info = {
@@ -185,6 +255,15 @@ class VirtualNodeProvider:
                     node, Reason.NODE_READY, f"partition {self.partition} ready"
                 )
                 return node
+        elif (
+            existing.ready
+            and existing.capacity == cap
+            and existing.allocatable == free
+            and time.time() - existing.heartbeat < self.status_interval
+        ):
+            # steady state: same capacity, fresh heartbeat — zero writes
+            # (a node write per sync tick was one-third of the mirror churn)
+            return existing
 
         def refresh(node: VirtualNode):
             node.capacity = cap
@@ -224,15 +303,45 @@ class VirtualNodeProvider:
     # ---- pod lifecycle ----
 
     def sync(self) -> None:
-        """One provider tick: refresh the node, then converge every bound
-        pod (the PodSyncWorkers resync, virtual-kubelet.go:298-310) —
-        in parallel across ``sync_workers`` threads, since each converge
-        can block on an agent RPC (submit = one sbatch exec)."""
+        """One provider tick: refresh the node, converge pods that need a
+        per-pod action (submit / terminate), then mirror live job state
+        into the rest with ONE batched JobsInfo query and diff-only writes.
+
+        This is the PR-3 mirror rework. The old tick listed (and deep-
+        copied) the WHOLE store per provider and paid one JobInfo RPC per
+        pod; now the ``(kind, node_name)`` index hands each provider
+        exactly its pods, terminal pods cost nothing, and an unchanged pod
+        costs zero store writes and no per-pod RPC.
+        """
+        t0 = time.perf_counter()
         self.register()
-        pods = [
-            p for p in self.store.list(Pod.KIND)
-            if p.spec.node_name == self.node_name
-        ]
+        work: list[Pod] = []  # needs per-pod converge (submit/terminate)
+        refresh: list[Pod] = []  # has live jobs: bulk status mirror
+        for p in self.store.list_by_node(Pod.KIND, self.node_name):
+            if p.meta.deleted:
+                work.append(p)
+            elif p.spec.role != PodRole.SIZECAR:
+                continue
+            elif not p.status.job_ids:
+                work.append(p)
+            elif p.status.phase not in PodPhase.TERMINAL:
+                refresh.append(p)
+            # terminal phase with job_ids: nothing left to learn — a dead
+            # pod must not cost one RPC per sync tick forever
+        self._converge(work)
+        t1 = time.perf_counter()
+        self._refresh_statuses(refresh)
+        t2 = time.perf_counter()
+        _status_seconds.observe(t2 - t1)
+        _sync_seconds.observe(t2 - t0)
+
+    def _converge(self, pods: list[Pod]) -> None:
+        """Per-pod converge (the PodSyncWorkers resync, virtual-
+        kubelet.go:298-310) — in parallel across ``sync_workers`` threads,
+        since each converge can block on an agent RPC (submit = one
+        sbatch exec)."""
+        if not pods:
+            return
         if len(pods) <= 1 or self.sync_workers == 1:
             for pod in pods:
                 self._sync_pod_safe(pod)
@@ -278,7 +387,9 @@ class VirtualNodeProvider:
             return
         if not pod.status.job_ids:
             self._submit_pod(pod)
-        else:
+        elif pod.status.phase not in PodPhase.TERMINAL:
+            # SUCCEEDED/FAILED pods are done: querying their jobs forever
+            # was one RPC per dead pod per sync tick (PR-3 satellite)
             self._refresh_status(pod)
 
     def _submit_pod(self, pod: Pod) -> None:
@@ -317,18 +428,32 @@ class VirtualNodeProvider:
             return
         job_id = int(resp.job_id)
 
-        def record(p: Pod):
-            p.status.job_ids = (job_id,)
-            p.status.phase = PodPhase.PENDING
-            p.status.reason = ""
-            p.meta.labels["jobid"] = str(job_id)
-            p.meta.annotations["agent-endpoint"] = self.agent_endpoint
+        def build(p: Pod):
+            return Pod(
+                meta=dataclasses.replace(
+                    p.meta,
+                    labels={**p.meta.labels, "jobid": str(job_id)},
+                    annotations={
+                        **p.meta.annotations,
+                        "agent-endpoint": self.agent_endpoint,
+                    },
+                ),
+                spec=p.spec,
+                status=dataclasses.replace(
+                    p.status,
+                    job_ids=(job_id,),
+                    phase=PodPhase.PENDING,
+                    reason="",
+                ),
+            )
 
-        self.store.mutate(Pod.KIND, pod.name, record)
+        self.store.replace_update(Pod.KIND, pod.name, build)
         self.events.event(pod, Reason.JOB_SUBMITTED, f"slurm job {job_id} submitted")
 
     def _refresh_status(self, pod: Pod) -> None:
-        """GetPodStatus equivalent (provider.go:195-219)."""
+        """GetPodStatus equivalent (provider.go:195-219) — the per-pod
+        form, used by direct ``sync_pod`` callers and the fallback when
+        the agent lacks the batched RPC."""
         queried = pod.status.job_ids
         infos: list[JobInfo] = []
         for job_id in queried:
@@ -338,17 +463,104 @@ class VirtualNodeProvider:
                 infos.append(JobInfo(id=job_id, state=JobStatus.UNKNOWN))
                 continue
             infos.extend(job_info_from_proto(m) for m in resp.info)
+        self._record_status(pod, queried, infos)
+
+    def _refresh_statuses(self, pods: list[Pod]) -> None:
+        """The batched status mirror: ONE JobsInfo round-trip for every
+        live job on this node, then diff-only writes — a pod whose job
+        state did not change costs zero store writes."""
+        if not pods:
+            return
+        if not self._bulk_supported:
+            # pre-PR-3 agent: per-pod queries, but still through the
+            # sync_workers pool — the serial form would be a ~10× sync
+            # latency regression for exactly these deployments
+            _bulk_fallbacks.inc()
+            self._converge(pods)
+            return
+        ids: list[int] = []
+        seen: set[int] = set()
+        for p in pods:
+            for jid in p.status.job_ids:
+                if jid not in seen:
+                    seen.add(jid)
+                    ids.append(jid)
+        by_id: dict[int, list[JobInfo]] = {}
+        # chunked: one logical bulk query, bounded per-RPC payload
+        for lo in range(0, len(ids), _BULK_CHUNK):
+            chunk = ids[lo : lo + _BULK_CHUNK]
+            try:
+                resp = self.client.JobsInfo(pb.JobsInfoRequest(job_ids=chunk))
+            except grpc.RpcError as e:
+                if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    # remember and converge per pod from now on
+                    self._bulk_supported = False
+                    _bulk_fallbacks.inc()
+                    log.warning(
+                        "agent does not implement JobsInfo; "
+                        "falling back to per-pod status queries"
+                    )
+                    self._converge(pods)
+                    return
+                # transient failure: keep current statuses and let the
+                # level-triggered loop retry next sync — flapping 50k pods
+                # to UNKNOWN over one blip would be worse than lag
+                log.warning("bulk status query failed: %s", e.details())
+                return
+            _bulk_queries.inc()
+            for entry in resp.jobs:
+                jid = int(entry.job_id)
+                infos = [job_info_from_proto(m) for m in entry.info]
+                if not entry.found or not infos:
+                    infos = [JobInfo(id=jid, state=JobStatus.UNKNOWN)]
+                by_id[jid] = infos
+        # diff against the snapshots we already hold, then commit every
+        # changed pod under ONE store lock acquisition; a conflict (racing
+        # writer) falls back to the per-pod optimistic retry
+        changed: list[tuple[Pod, tuple[int, ...], list[JobInfo], str]] = []
+        for pod in pods:
+            queried = pod.status.job_ids
+            infos = []
+            for jid in queried:
+                infos.extend(
+                    by_id.get(jid) or [JobInfo(id=jid, state=JobStatus.UNKNOWN)]
+                )
+            phase = pod_phase_for([i.state for i in infos])
+            if pod.status.phase == phase and _infos_equivalent(
+                pod.status.job_infos, infos
+            ):
+                continue  # zero store writes on the steady path
+            changed.append((pod, queried, infos, phase))
+        if not changed:
+            return
+        results = self.store.update_batch(
+            [
+                _status_replacement(pod, infos, phase)
+                for pod, _, infos, phase in changed
+            ]
+        )
+        for (pod, queried, infos, phase), res in zip(changed, results):
+            if isinstance(res, Exception):
+                self._record_status(pod, queried, infos)
+
+    def _record_status(
+        self, pod: Pod, queried: tuple[int, ...], infos: list[JobInfo]
+    ) -> None:
         phase = pod_phase_for([i.state for i in infos])
 
-        def record(p: Pod):
+        def build(p: Pod):
             if p.status.job_ids != queried:
-                return False  # preempted/requeued mid-query — stale state
-            if p.status.job_infos == infos and p.status.phase == phase:
-                return False
-            p.status.job_infos = infos
-            p.status.phase = phase
+                return None  # preempted/requeued mid-query — stale state
+            if p.status.phase == phase and _infos_equivalent(
+                p.status.job_infos, infos
+            ):
+                return None
+            return _status_replacement(p, infos, phase)
 
-        self.store.mutate(Pod.KIND, pod.name, record)
+        try:
+            self.store.replace_update(Pod.KIND, pod.name, build)
+        except NotFound:
+            pass
 
     def _terminate_pod(self, pod: Pod) -> None:
         """DeletePod equivalent (provider.go:156-181): cancel every owned

@@ -427,6 +427,25 @@ class SimWorkloadClient:
             info=[job_info_to_proto(job.info(now=self.cluster.clock()))]
         )
 
+    def JobsInfo(self, request, timeout=None) -> pb.JobsInfoResponse:
+        """Batched JobInfo — agent/server.py parity: unknown ids come back
+        found=false, the batch never aborts on one bad id."""
+        now = self.cluster.clock()
+        entries = []
+        for job_id in request.job_ids:
+            job = self.cluster.jobs.get(int(job_id))
+            if job is None:
+                entries.append(pb.JobsInfoEntry(job_id=job_id, found=False))
+                continue
+            entries.append(
+                pb.JobsInfoEntry(
+                    job_id=job_id,
+                    found=True,
+                    info=[job_info_to_proto(job.info(now=now))],
+                )
+            )
+        return pb.JobsInfoResponse(jobs=entries)
+
     def JobState(self, request, timeout=None) -> pb.JobStateResponse:
         job = self.cluster.jobs.get(int(request.job_id))
         status = int(job.state) if job is not None else int(JobStatus.UNKNOWN)

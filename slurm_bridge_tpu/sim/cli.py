@@ -46,6 +46,9 @@ def _headline(result) -> dict:
         "unit": "ms",
         "p95_ms": t["tick_p95_ms"],
         "phases_p50_ms": t["phases_p50_ms"],
+        # the per-phase split under its contract name, so BENCH json
+        # consumers can track phase-level regressions (PR-3 satellite)
+        "full_tick_phases_ms": t["phases_p50_ms"],
         "pods": result.shape["pods"],
         "nodes": result.shape["nodes"],
         "bound_total": result.determinism["bound_total"],

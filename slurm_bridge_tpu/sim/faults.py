@@ -182,6 +182,32 @@ class FaultyClient:
             for f in self._plan.active("rpc_latency", self.tick):
                 if f.matches(method):
                     self.injected_latency_ms += f.latency_ms
+            if method == "JobsInfo" and self._plan.active(
+                "lost_status", self.tick
+            ):
+                # the batched status RPC freezes PER JOB, like JobInfo —
+                # freezing the whole response would let new jobs entering
+                # the batch thaw every other job's state mid-window
+                from slurm_bridge_tpu.wire import pb
+
+                missing = [
+                    jid
+                    for jid in request.job_ids
+                    if ("JobsInfo", jid) not in self._stale
+                ]
+                if missing:
+                    resp = inner_fn(
+                        pb.JobsInfoRequest(job_ids=missing), timeout=timeout
+                    )
+                    for entry in resp.jobs:
+                        self._stale[("JobsInfo", entry.job_id)] = entry
+                return pb.JobsInfoResponse(
+                    jobs=[
+                        self._stale[("JobsInfo", jid)]
+                        for jid in request.job_ids
+                        if ("JobsInfo", jid) in self._stale
+                    ]
+                )
             freeze = (
                 method in _SNAPSHOT_METHODS
                 and self._plan.active("stale_snapshot", self.tick)

@@ -289,9 +289,21 @@ class SimHarness:
             pod = self.store.try_get(Pod.KIND, f"{a.name}-sizecar")
             if pod is not None and pod.spec.demand is not None:
                 def stamp(p: Pod, dur=a.duration_s):
-                    p.spec.demand.time_limit_s = max(1, int(round(dur)))
+                    import dataclasses
 
-                self.store.mutate(Pod.KIND, pod.name, stamp)
+                    return Pod(
+                        meta=dataclasses.replace(p.meta),
+                        spec=dataclasses.replace(
+                            p.spec,
+                            demand=dataclasses.replace(
+                                p.spec.demand,
+                                time_limit_s=max(1, int(round(dur))),
+                            ),
+                        ),
+                        status=p.status,
+                    )
+
+                self.store.replace_update(Pod.KIND, pod.name, stamp)
         return len(arrivals)
 
     def _mirror(self) -> None:

@@ -62,7 +62,7 @@ def agent_flaky_rpc(scale: float = 1.0, seed: int = 44) -> Scenario:
     after the flap clears — the retry/idempotency story end to end."""
     return Scenario(
         name="agent_flaky_rpc",
-        description="30% UNAVAILABLE on SubmitJob/JobInfo for ticks 4-12",
+        description="30% UNAVAILABLE on SubmitJob/JobInfo/JobsInfo for ticks 4-12",
         cluster=ClusterSpec(num_nodes=_n(300, scale)),
         workload=WorkloadSpec(
             jobs=_n(1000, scale, floor=20), arrival="poisson", spread_ticks=8
@@ -73,7 +73,9 @@ def agent_flaky_rpc(scale: float = 1.0, seed: int = 44) -> Scenario:
                     kind="rpc_error",
                     start_tick=4,
                     end_tick=12,
-                    methods=("SubmitJob", "JobInfo"),
+                    # JobsInfo is the bulk form the mirror dials since PR-3;
+                    # keep the single-job form faulted too for the fallback
+                    methods=("SubmitJob", "JobInfo", "JobsInfo"),
                     rate=0.3,
                 ),
                 Fault(

@@ -51,8 +51,8 @@ def test_store_crud_and_conflict():
     created = s.create(_job())
     assert created.meta.resource_version == 1
 
-    stale = s.get(BridgeJob.KIND, "j1")
-    fresh = s.get(BridgeJob.KIND, "j1")
+    stale = s.get_for_update(BridgeJob.KIND, "j1")
+    fresh = s.get_for_update(BridgeJob.KIND, "j1")
     fresh.status.state = JobState.RUNNING
     s.update(fresh)
     stale.status.state = JobState.FAILED
@@ -61,15 +61,28 @@ def test_store_crud_and_conflict():
     assert s.get(BridgeJob.KIND, "j1").status.state == JobState.RUNNING
 
 
-def test_store_deepcopy_isolation():
+def test_store_snapshot_immutability():
+    """Reads are shared frozen snapshots: mutating one raises instead of
+    corrupting the store (the copy-on-read contract that replaced the
+    deepcopy-per-get)."""
+    from slurm_bridge_tpu.bridge import FrozenInstanceError
+
     s = ObjectStore()
     job = _job()
-    s.create(job)
-    job.spec.partition = "mutated-after-create"
-    assert s.get(BridgeJob.KIND, "j1").spec.partition == "debug"
+    s.create(job)  # the store takes ownership and freezes in place
+    with pytest.raises(FrozenInstanceError):
+        job.spec.partition = "mutated-after-create"
     got = s.get(BridgeJob.KIND, "j1")
-    got.spec.partition = "mutated-after-get"
+    with pytest.raises(FrozenInstanceError):
+        got.spec.partition = "mutated-after-get"
+    with pytest.raises(FrozenInstanceError):
+        got.meta.labels["k"] = "v"
     assert s.get(BridgeJob.KIND, "j1").spec.partition == "debug"
+    # the write path still works on a private thawed copy
+    fresh = s.get_for_update(BridgeJob.KIND, "j1")
+    fresh.spec.partition = "batch"
+    s.update(fresh)
+    assert s.get(BridgeJob.KIND, "j1").spec.partition == "batch"
 
 
 def test_store_cascade_delete():
@@ -103,7 +116,7 @@ def test_store_mutate_retries_conflicts():
     def bump(job):
         if not calls:
             # sneak in a concurrent write on first attempt
-            other = s.get(BridgeJob.KIND, "j1")
+            other = s.get_for_update(BridgeJob.KIND, "j1")
             other.status.reason = "concurrent"
             s.update(other)
         calls.append(1)

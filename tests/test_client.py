@@ -25,6 +25,7 @@ def test_typed_client_crud():
         jobs.create(_job("a"))
     got = jobs.get("a")
     assert got.spec.partition == "debug"
+    got = jobs.get_for_update("a")
     got.spec.priority = 7
     jobs.update(got)
     assert jobs.get("a").spec.priority == 7

@@ -1,0 +1,237 @@
+"""PR-3 mirror rework: batched JobsInfo status sync, diff-driven writes,
+terminal-pod skip, and the per-pod fallback for agents without the RPC."""
+
+import grpc
+import pytest
+
+from slurm_bridge_tpu.bridge.objects import (
+    Meta,
+    Pod,
+    PodPhase,
+    PodRole,
+    PodSpec,
+    PodStatus,
+    partition_node_name,
+)
+from slurm_bridge_tpu.bridge.store import ObjectStore
+from slurm_bridge_tpu.bridge.vnode import VirtualNodeProvider
+from slurm_bridge_tpu.core.types import JobDemand
+from slurm_bridge_tpu.obs.events import EventRecorder
+from slurm_bridge_tpu.sim.agent import SimCluster, SimNode, SimWorkloadClient
+from slurm_bridge_tpu.sim.faults import SimRpcError
+from slurm_bridge_tpu.wire import pb
+
+
+class CountingClient:
+    """Counts every RPC dialed through it (the fake agent's call counter
+    the steady-state assertion reads)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.calls: dict[str, int] = {}
+
+    def total(self) -> int:
+        return sum(self.calls.values())
+
+    def __getattr__(self, name):
+        fn = getattr(self._inner, name)
+        if not callable(fn):
+            return fn
+
+        def call(*a, **kw):
+            self.calls[name] = self.calls.get(name, 0) + 1
+            return fn(*a, **kw)
+
+        return call
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def _cluster(clock) -> SimCluster:
+    nodes = [
+        SimNode(name=f"n{i}", cpus=16, memory_mb=32000) for i in range(4)
+    ]
+    return SimCluster(nodes, {"part0": tuple(n.name for n in nodes)}, clock=clock)
+
+
+def _provider(store, client) -> VirtualNodeProvider:
+    return VirtualNodeProvider(
+        store,
+        client,
+        "part0",
+        events=EventRecorder(),
+        sync_workers=1,
+        inventory_ttl=3600.0,  # cache inventory: isolate the status path
+        status_interval=3600.0,  # heartbeat never forces a node write here
+    )
+
+
+def _bound_pod(name: str) -> Pod:
+    return Pod(
+        meta=Meta(name=name),
+        spec=PodSpec(
+            role=PodRole.SIZECAR,
+            partition="part0",
+            node_name=partition_node_name("part0"),
+            demand=JobDemand(
+                partition="part0",
+                script="#!/bin/sh\ntrue\n",
+                cpus_per_task=1,
+                time_limit_s=1000,
+                job_name=name,
+            ),
+        ),
+    )
+
+
+def _converged_provider(n_pods: int = 3):
+    """A provider whose pods are submitted and visibly RUNNING."""
+    clock = _Clock()
+    cluster = _cluster(clock)
+    client = CountingClient(SimWorkloadClient(cluster))
+    store = ObjectStore()
+    provider = _provider(store, client)
+    for i in range(n_pods):
+        store.create(_bound_pod(f"bp{i}"))
+    provider.sync()  # submit
+    provider.sync()  # mirror PENDING -> RUNNING
+    pods = store.list(Pod.KIND)
+    assert all(p.status.phase == PodPhase.RUNNING for p in pods)
+    assert all(p.status.job_infos for p in pods)
+    return clock, cluster, client, store, provider
+
+
+def test_steady_state_tick_zero_writes_one_rpc():
+    """The acceptance gate: a provider tick with NO pod-state changes
+    performs 0 store writes and at most 1 agent RPC."""
+    clock, cluster, client, store, provider = _converged_provider()
+    rv_before = store.changes_since(Pod.KIND, 0)[0]
+    calls_before = client.total()
+    provider.sync()
+    assert store.changes_since(Pod.KIND, 0)[0] == rv_before  # 0 writes
+    assert client.total() - calls_before <= 1  # the one bulk JobsInfo
+    assert client.calls.get("JobsInfo", 0) >= 1
+    assert client.calls.get("JobInfo", 0) == 0  # never per-pod
+
+
+def test_run_time_tick_alone_causes_no_writes():
+    """Virtual time advancing (run_time_s growing) is not a state change —
+    the diff must not rewrite every RUNNING pod every tick."""
+    clock, cluster, client, store, provider = _converged_provider()
+    rv_before = store.changes_since(Pod.KIND, 0)[0]
+    clock.now += 100.0  # jobs still running, run_time grew by 100s
+    cluster.step()
+    provider.sync()
+    assert store.changes_since(Pod.KIND, 0)[0] == rv_before
+
+
+def test_completion_is_mirrored_with_one_write_per_pod():
+    clock, cluster, client, store, provider = _converged_provider()
+    rv_before = store.changes_since(Pod.KIND, 0)[0]
+    clock.now += 5000.0  # past every job's time limit
+    cluster.step()
+    provider.sync()
+    pods = store.list(Pod.KIND)
+    assert all(p.status.phase == PodPhase.SUCCEEDED for p in pods)
+    rv, changed, _ = store.changes_since(Pod.KIND, rv_before)
+    assert sorted(changed) == sorted(p.name for p in pods)
+
+
+def test_terminal_pods_cost_zero_rpcs():
+    """Regression (PR-3 satellite): a SUCCEEDED/FAILED pod must not keep
+    costing one job-info query per sync tick forever."""
+    clock, cluster, client, store, provider = _converged_provider()
+    clock.now += 5000.0
+    cluster.step()
+    provider.sync()  # mirrors the completions
+    calls_before = client.total()
+    rv_before = store.changes_since(Pod.KIND, 0)[0]
+    for _ in range(3):
+        provider.sync()
+    # no JobsInfo, no JobInfo, no writes: the refresh set is empty
+    assert client.total() == calls_before
+    assert store.changes_since(Pod.KIND, 0)[0] == rv_before
+
+
+def test_sync_pod_skips_terminal_single_path():
+    clock = _Clock()
+    client = CountingClient(SimWorkloadClient(_cluster(clock)))
+    store = ObjectStore()
+    provider = _provider(store, client)
+    pod = _bound_pod("dead")
+    pod.status = PodStatus(phase=PodPhase.FAILED, job_ids=(1234,))
+    store.create(pod)
+    provider.sync_pod(store.get(Pod.KIND, "dead"))
+    assert client.calls.get("JobInfo", 0) == 0
+    assert client.calls.get("JobsInfo", 0) == 0
+
+
+class NoBulkClient(CountingClient):
+    """An agent predating the JobsInfo RPC: the call raises UNIMPLEMENTED
+    exactly as a generic gRPC handler table without the method would."""
+
+    def __getattr__(self, name):
+        if name == "JobsInfo":
+            def unimplemented(*a, **kw):
+                self.calls["JobsInfo"] = self.calls.get("JobsInfo", 0) + 1
+                raise SimRpcError(
+                    grpc.StatusCode.UNIMPLEMENTED, "no such method"
+                )
+
+            return unimplemented
+        return super().__getattr__(name)
+
+
+def test_bulk_unimplemented_falls_back_to_per_pod():
+    clock = _Clock()
+    cluster = _cluster(clock)
+    client = NoBulkClient(SimWorkloadClient(cluster))
+    store = ObjectStore()
+    provider = _provider(store, client)
+    for i in range(3):
+        store.create(_bound_pod(f"fp{i}"))
+    provider.sync()  # submit
+    provider.sync()  # bulk raises UNIMPLEMENTED -> per-pod fallback
+    assert provider._bulk_supported is False
+    assert client.calls.get("JobInfo", 0) >= 3
+    pods = store.list(Pod.KIND)
+    assert all(p.status.phase == PodPhase.RUNNING for p in pods)
+    # once flagged, later syncs go straight to the per-pod path
+    assert client.calls.get("JobsInfo", 0) == 1
+
+
+def test_jobs_info_rpc_marks_unknown_ids():
+    clock = _Clock()
+    cluster = _cluster(clock)
+    client = SimWorkloadClient(cluster)
+    jid = cluster.submit(
+        pb.SubmitJobRequest(
+            script="x", partition="part0", cpus_per_task=1, time_limit_s=60
+        )
+    )
+    resp = client.JobsInfo(pb.JobsInfoRequest(job_ids=[jid, 999999]))
+    assert [e.job_id for e in resp.jobs] == [jid, 999999]
+    assert resp.jobs[0].found and len(resp.jobs[0].info) == 1
+    assert not resp.jobs[1].found and len(resp.jobs[1].info) == 0
+
+
+def test_register_steady_state_writes_nothing():
+    """Node heartbeat throttle: unchanged capacity + fresh heartbeat ==
+    zero VirtualNode writes per sync."""
+    from slurm_bridge_tpu.bridge.objects import VirtualNode
+
+    clock = _Clock()
+    client = CountingClient(SimWorkloadClient(_cluster(clock)))
+    store = ObjectStore()
+    provider = _provider(store, client)
+    provider.register()
+    rv = store.changes_since(VirtualNode.KIND, 0)[0]
+    for _ in range(5):
+        provider.register()
+    assert store.changes_since(VirtualNode.KIND, 0)[0] == rv

@@ -141,8 +141,8 @@ def test_update_conflict_detected_under_contention():
     """Two stale writers: exactly one wins, the other gets Conflict."""
     store = ObjectStore()
     store.create(_job("c"))
-    a = store.get(BridgeJob.KIND, "c")
-    b = store.get(BridgeJob.KIND, "c")
+    a = store.get_for_update(BridgeJob.KIND, "c")
+    b = store.get_for_update(BridgeJob.KIND, "c")
     a.spec.priority = 1
     store.update(a)
     b.spec.priority = 2

@@ -1,0 +1,284 @@
+"""Store rework semantics (PR-3): frozen copy-on-read snapshots, the
+(kind, node_name) secondary index, the per-kind dirty-set, batched
+optimistic writes, and the transitive owner cascade."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from slurm_bridge_tpu.bridge.freeze import (
+    FrozenDict,
+    FrozenInstanceError,
+    FrozenList,
+    freeze,
+    is_frozen,
+    thaw,
+)
+from slurm_bridge_tpu.bridge.objects import (
+    BridgeJob,
+    BridgeJobSpec,
+    Meta,
+    Pod,
+    PodRole,
+    PodSpec,
+    PodStatus,
+)
+from slurm_bridge_tpu.bridge.store import Conflict, NotFound, ObjectStore
+from slurm_bridge_tpu.core.types import JobDemand, JobInfo, JobStatus
+
+
+def _pod(name: str, node: str = "", owner: str = "") -> Pod:
+    return Pod(
+        meta=Meta(name=name, owner=owner, labels={"role": "sizecar"}),
+        spec=PodSpec(
+            role=PodRole.SIZECAR,
+            partition="p0",
+            node_name=node,
+            demand=JobDemand(partition="p0", script="#!/bin/sh\ntrue\n"),
+        ),
+    )
+
+
+def _job(name: str) -> BridgeJob:
+    return BridgeJob(
+        meta=Meta(name=name),
+        spec=BridgeJobSpec(partition="p0", sbatch_script="#!/bin/sh\n"),
+    )
+
+
+# ------------------------------------------------------- freeze machinery
+
+
+def test_freeze_blocks_every_mutation_surface():
+    pod = _pod("f1")
+    pod.status.job_infos = [JobInfo(id=1, state=JobStatus.RUNNING)]
+    freeze(pod)
+    assert is_frozen(pod) and is_frozen(pod.spec.demand)
+    with pytest.raises(FrozenInstanceError):
+        pod.spec.node_name = "n1"
+    with pytest.raises(FrozenInstanceError):
+        pod.meta.labels["x"] = "y"
+    with pytest.raises(FrozenInstanceError):
+        pod.meta.labels.pop("role")
+    with pytest.raises(FrozenInstanceError):
+        pod.status.job_infos.append(JobInfo())
+    with pytest.raises(FrozenInstanceError):
+        pod.status.job_infos[0].state = JobStatus.FAILED
+    with pytest.raises(FrozenInstanceError):
+        pod.spec.demand.cpus_per_task = 99
+    # frozen containers still compare equal to their plain counterparts
+    assert pod.meta.labels == {"role": "sizecar"}
+    assert isinstance(pod.meta.labels, FrozenDict)
+    assert isinstance(pod.status.job_infos, FrozenList)
+
+
+def test_thaw_yields_plain_mutable_graph():
+    pod = _pod("f2")
+    pod.status.job_infos = [JobInfo(id=1)]
+    freeze(pod)
+    t = thaw(pod)
+    assert not is_frozen(t) and not is_frozen(t.spec.demand)
+    assert type(t.meta.labels) is dict
+    assert type(t.status.job_infos) is list
+    t.spec.node_name = "n1"
+    t.meta.labels["x"] = "y"
+    t.status.job_infos.append(JobInfo(id=2))
+    # the frozen original is untouched
+    assert pod.spec.node_name == "" and "x" not in pod.meta.labels
+
+
+def test_dataclasses_replace_shares_frozen_children():
+    pod = freeze(_pod("f3"))
+    new = Pod(
+        meta=dataclasses.replace(pod.meta),
+        spec=dataclasses.replace(pod.spec, node_name="n9"),
+        status=pod.status,
+    )
+    assert not is_frozen(new) and new.spec.demand is pod.spec.demand
+    new.spec.placement_hint = ("a",)  # replacement is mutable pre-freeze
+
+
+# ------------------------------------------------------- snapshot reads
+
+
+def test_reads_share_one_frozen_snapshot_per_version():
+    s = ObjectStore()
+    s.create(_pod("p1"))
+    a = s.get(Pod.KIND, "p1")
+    b = s.get(Pod.KIND, "p1")
+    assert a is b  # zero-copy: same stored object
+    assert a in s.list(Pod.KIND)
+    s.mutate(Pod.KIND, "p1", lambda p: setattr(p.spec, "node_name", "n1"))
+    c = s.get(Pod.KIND, "p1")
+    assert c is not a  # new version = new object; old snapshot intact
+    assert a.spec.node_name == "" and c.spec.node_name == "n1"
+
+
+def test_mutate_fn_gets_private_thawed_copy():
+    s = ObjectStore()
+    s.create(_pod("p1"))
+
+    def bump(p: Pod):
+        p.meta.annotations["k"] = "v"
+        p.status.job_ids = (7,)
+
+    s.mutate(Pod.KIND, "p1", bump)
+    got = s.get(Pod.KIND, "p1")
+    assert got.meta.annotations == {"k": "v"} and got.status.job_ids == (7,)
+
+
+# ------------------------------------------------------- secondary index
+
+
+def test_list_by_node_tracks_bind_and_unbind():
+    s = ObjectStore()
+    s.create(_pod("a", node=""))
+    s.create(_pod("b", node="n1"))
+    s.create(_pod("c", node="n1"))
+    assert [p.name for p in s.list_by_node(Pod.KIND, "n1")] == ["b", "c"]
+    assert [p.name for p in s.list_by_node(Pod.KIND, "")] == ["a"]
+    assert s.list_by_node(Pod.KIND, "n2") == []
+    # bind a -> n1, move c -> n2, delete b
+    s.mutate(Pod.KIND, "a", lambda p: setattr(p.spec, "node_name", "n1"))
+    s.mutate(Pod.KIND, "c", lambda p: setattr(p.spec, "node_name", "n2"))
+    s.delete(Pod.KIND, "b")
+    assert [p.name for p in s.list_by_node(Pod.KIND, "n1")] == ["a"]
+    assert [p.name for p in s.list_by_node(Pod.KIND, "n2")] == ["c"]
+    assert s.list_by_node(Pod.KIND, "") == []
+
+
+def test_fuzzed_index_equivalence_with_filtered_list():
+    """Property check: after arbitrary create/update/delete churn, the
+    indexed read equals the old-style full-list filter for every node."""
+    rng = np.random.default_rng(7)
+    s = ObjectStore()
+    nodes = ["", "n0", "n1", "n2", "n3"]
+    alive: set[str] = set()
+    for step in range(400):
+        op = rng.integers(0, 3)
+        name = f"pod-{rng.integers(0, 60)}"
+        if op == 0:
+            try:
+                s.create(_pod(name, node=str(rng.choice(nodes))))
+                alive.add(name)
+            except Exception:
+                pass
+        elif op == 1 and name in alive:
+            target = str(rng.choice(nodes))
+            s.mutate(
+                Pod.KIND, name, lambda p, t=target: setattr(p.spec, "node_name", t)
+            )
+        elif op == 2 and name in alive:
+            s.delete(Pod.KIND, name)
+            alive.discard(name)
+    full = s.list(Pod.KIND)
+    assert {p.name for p in full} == alive
+    for node in nodes:
+        expect = [p.name for p in full if p.spec.node_name == node]
+        got = [p.name for p in s.list_by_node(Pod.KIND, node)]
+        assert got == expect  # same objects, same (sorted) order
+
+
+# ------------------------------------------------------- dirty-set
+
+
+def test_changes_since_reports_changed_and_deleted():
+    s = ObjectStore()
+    rv0, changed, deleted = s.changes_since(Pod.KIND, 0)
+    assert changed == [] and deleted == []
+    s.create(_pod("a"))
+    s.create(_pod("b"))
+    rv1, changed, deleted = s.changes_since(Pod.KIND, rv0)
+    assert changed == ["a", "b"] and deleted == []
+    s.mutate(Pod.KIND, "a", lambda p: setattr(p.spec, "node_name", "n1"))
+    s.delete(Pod.KIND, "b")
+    rv2, changed, deleted = s.changes_since(Pod.KIND, rv1)
+    assert changed == ["a"] and deleted == ["b"]
+    # nothing moved since rv2
+    rv3, changed, deleted = s.changes_since(Pod.KIND, rv2)
+    assert rv3 == rv2 and changed == [] and deleted == []
+    # a recreated name stops being a tombstone
+    s.create(_pod("b"))
+    _, changed, deleted = s.changes_since(Pod.KIND, rv2)
+    assert changed == ["b"] and deleted == []
+
+
+# ------------------------------------------------------- update_batch
+
+
+def test_update_batch_applies_all_and_reports_conflicts_per_object():
+    s = ObjectStore()
+    s.create(_pod("a"))
+    s.create(_pod("b"))
+    s.create(_pod("c"))
+    snaps = {p.name: p for p in s.list(Pod.KIND)}
+    # someone else wins a write on b between our read and our batch
+    s.mutate(Pod.KIND, "b", lambda p: setattr(p.status, "reason", "raced"))
+
+    def bound(p: Pod, node: str) -> Pod:
+        return Pod(
+            meta=dataclasses.replace(p.meta),
+            spec=dataclasses.replace(p.spec, node_name=node),
+            status=p.status,
+        )
+
+    gone = bound(snaps["c"], "n1")
+    s.delete(Pod.KIND, "c")
+    results = s.update_batch(
+        [bound(snaps["a"], "n1"), bound(snaps["b"], "n1"), gone]
+    )
+    assert isinstance(results[0], Pod)
+    assert isinstance(results[1], Conflict)
+    assert isinstance(results[2], NotFound)
+    assert s.get(Pod.KIND, "a").spec.node_name == "n1"
+    got_b = s.get(Pod.KIND, "b")
+    assert got_b.spec.node_name == "" and got_b.status.reason == "raced"
+    # the successful write landed in the index too
+    assert [p.name for p in s.list_by_node(Pod.KIND, "n1")] == ["a"]
+
+
+def test_update_batch_is_one_write_per_object_semantics():
+    s = ObjectStore()
+    s.create(_pod("a"))
+    snap = s.get(Pod.KIND, "a")
+    new = Pod(
+        meta=dataclasses.replace(snap.meta),
+        spec=dataclasses.replace(snap.spec, node_name="n1"),
+        status=snap.status,
+    )
+    (res,) = s.update_batch([new])
+    assert res.meta.resource_version > snap.meta.resource_version
+    # the stored object is frozen — the batch took ownership
+    with pytest.raises(FrozenInstanceError):
+        res.spec.node_name = "n2"
+
+
+# ------------------------------------------------------- cascade + order
+
+
+def test_delete_cascade_is_transitive():
+    """BridgeJob -> sizecar pod -> pod-owned object: grandchildren must
+    not leak (the one-level cascade did exactly that)."""
+    s = ObjectStore()
+    s.create(_job("j1"))
+    s.create(_pod("j1-sizecar", owner="j1"))
+    s.create(_pod("j1-sizecar-shadow", owner="j1-sizecar"))
+    s.create(_pod("j1-sizecar-shadow-leaf", owner="j1-sizecar-shadow"))
+    s.create(_pod("unrelated"))
+    s.delete(BridgeJob.KIND, "j1")
+    assert s.try_get(Pod.KIND, "j1-sizecar") is None
+    assert s.try_get(Pod.KIND, "j1-sizecar-shadow") is None
+    assert s.try_get(Pod.KIND, "j1-sizecar-shadow-leaf") is None
+    assert s.try_get(Pod.KIND, "unrelated") is not None
+
+
+def test_owned_by_returns_name_sorted():
+    s = ObjectStore()
+    for name in ("z-pod", "a-pod", "m-pod"):
+        s.create(_pod(name, owner="j1"))
+    assert [p.name for p in s.owned_by(Pod.KIND, "j1")] == [
+        "a-pod",
+        "m-pod",
+        "z-pod",
+    ]
