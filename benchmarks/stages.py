@@ -316,8 +316,104 @@ def profile_tick(
     }
 
 
+def profile_reconcile(n_jobs: int = 2_000, *, iters: int = 3) -> dict:
+    """Per-stage timing of the operator's dirty-set batch sweep (PR-4)
+    over N dirty jobs — the cold-start reconcile path the full-tick
+    headline spends its mirror phase in:
+
+    - **create** — sweep over N fresh CRs: N sizecar creates in one
+      ``create_batch``;
+    - **dirty** — sweep after every sizecar went Running: N CR status
+      replacements + N worker-pod creates, two lock acquisitions total;
+    - **steady** — the no-change sweep, which must perform ZERO store
+      writes (``steady_writes`` is asserted by ``make bench-smoke``).
+    """
+    import dataclasses as dc
+    import logging
+
+    from slurm_bridge_tpu.bridge.objects import (
+        BridgeJob,
+        BridgeJobSpec,
+        Meta,
+        Pod,
+        PodPhase,
+    )
+    from slurm_bridge_tpu.bridge.operator import BridgeOperator, sizecar_name
+    from slurm_bridge_tpu.bridge.store import ObjectStore
+    from slurm_bridge_tpu.core.types import JobInfo, JobStatus
+    from slurm_bridge_tpu.obs.events import EventRecorder
+
+    logging.getLogger("sbt.events").setLevel(logging.CRITICAL)
+    create_ms, dirty_ms, steady_ms = [], [], []
+    steady_writes = 0
+    for _ in range(iters):
+        store = ObjectStore()
+        op = BridgeOperator(
+            store, agent_endpoint="bench://agent", events=EventRecorder()
+        )
+        names = [f"bench-{i:05d}" for i in range(n_jobs)]
+        for n in names:
+            store.create(
+                BridgeJob(
+                    meta=Meta(name=n),
+                    spec=BridgeJobSpec(
+                        partition="debug", sbatch_script="#!/bin/sh\ntrue\n"
+                    ),
+                )
+            )
+        t0 = time.perf_counter()
+        op.sweep(names)
+        create_ms.append((time.perf_counter() - t0) * 1e3)
+        # what a mirrored submit tick leaves behind: every sizecar Running
+        # with one live job info
+        store.update_batch(
+            [
+                Pod(
+                    meta=dc.replace(p.meta),
+                    spec=p.spec,
+                    status=dc.replace(
+                        p.status,
+                        phase=PodPhase.RUNNING,
+                        job_ids=(1000 + i,),
+                        job_infos=[
+                            JobInfo(
+                                id=1000 + i,
+                                state=JobStatus.RUNNING,
+                                name=p.meta.owner,
+                            )
+                        ],
+                    ),
+                )
+                for i, p in enumerate(
+                    store.get(Pod.KIND, sizecar_name(n)) for n in names
+                )
+            ]
+        )
+        t0 = time.perf_counter()
+        op.sweep(names)
+        dirty_ms.append((time.perf_counter() - t0) * 1e3)
+        rv_before = store.changes_since(Pod.KIND, 0)[0]
+        t0 = time.perf_counter()
+        op.sweep(names)
+        steady_ms.append((time.perf_counter() - t0) * 1e3)
+        steady_writes += store.changes_since(Pod.KIND, 0)[0] - rv_before
+    dirty = float(np.median(dirty_ms))
+    return {
+        "jobs": n_jobs,
+        "create_sweep_ms": round(float(np.median(create_ms)), 2),
+        "dirty_sweep_ms": round(dirty, 2),
+        "steady_sweep_ms": round(float(np.median(steady_ms)), 2),
+        "per_job_us": round(dirty * 1e3 / n_jobs, 2),
+        "steady_writes": steady_writes,
+    }
+
+
 def main(argv: list[str] | None = None) -> None:
     argv = sys.argv[1:] if argv is None else argv
+    if "--reconcile" in argv:
+        n = 500 if "--small" in argv else 2_000
+        print(json.dumps(profile_reconcile(n)))
+        return
     if "--tick" in argv:
         if "--small" in argv:
             out = profile_tick(1_000, 5_000, seed=2)

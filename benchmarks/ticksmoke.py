@@ -11,8 +11,15 @@ not discovered in the next headline bench run.
 Budgets are deliberately loose (≈20× the measured steady state) so CI
 machine jitter never trips them; only a structural regression can.
 
-    SBT_SMOKE_ENCODE_BUDGET_MS   warm encode p50 ceiling   (default 50)
-    SBT_SMOKE_MIN_SPEEDUP        encode speedup floor      (default 3)
+The PR-4 reconcile micro-stage (``benchmarks.stages --reconcile``: the
+operator's dirty-set sweep over 500 jobs) rides along with two gates of
+its own: a generous dirty-sweep wall budget, and a HARD zero on
+``steady_writes`` — a no-change sweep writing to the store is a
+structural bug (self-feeding watch loop), not jitter, at any speed.
+
+    SBT_SMOKE_ENCODE_BUDGET_MS     warm encode p50 ceiling    (default 50)
+    SBT_SMOKE_MIN_SPEEDUP          encode speedup floor       (default 3)
+    SBT_SMOKE_RECONCILE_BUDGET_MS  dirty-sweep ceiling, 500 jobs (default 1000)
 """
 
 from __future__ import annotations
@@ -24,16 +31,24 @@ import sys
 
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    from benchmarks.stages import profile_tick
+    from benchmarks.stages import profile_reconcile, profile_tick
 
     budget_ms = float(os.environ.get("SBT_SMOKE_ENCODE_BUDGET_MS", "50"))
     min_speedup = float(os.environ.get("SBT_SMOKE_MIN_SPEEDUP", "3"))
+    rec_budget_ms = float(
+        os.environ.get("SBT_SMOKE_RECONCILE_BUDGET_MS", "1000")
+    )
     out = profile_tick(1_000, 5_000, seed=2)
+    rec = profile_reconcile(500)
+    out["reconcile"] = rec
     out["encode_budget_ms"] = budget_ms
     out["min_speedup"] = min_speedup
+    out["reconcile_budget_ms"] = rec_budget_ms
     ok = (
         out["encode_ms"] <= budget_ms
         and out["encode_speedup_vs_loop"] >= min_speedup
+        and rec["dirty_sweep_ms"] <= rec_budget_ms
+        and rec["steady_writes"] == 0
     )
     out["ok"] = ok
     print(json.dumps(out))
@@ -41,7 +56,9 @@ def main() -> int:
         print(
             f"# bench-smoke FAIL: encode {out['encode_ms']} ms "
             f"(budget {budget_ms}) / speedup {out['encode_speedup_vs_loop']}x "
-            f"(floor {min_speedup}x)",
+            f"(floor {min_speedup}x) / dirty sweep {rec['dirty_sweep_ms']} ms "
+            f"(budget {rec_budget_ms}) / steady sweep writes "
+            f"{rec['steady_writes']} (must be 0)",
             file=sys.stderr,
         )
     return 0 if ok else 1

@@ -158,6 +158,53 @@ class WorkloadServicer:
         log.info("submitted job %d (partition=%s)", job_id, request.partition)
         return pb.SubmitJobResponse(job_id=job_id)
 
+    def SubmitJobs(self, request: pb.SubmitJobsRequest, context) -> pb.SubmitJobsResponse:
+        """Batched SubmitJob (PR-4): one RPC round-trip for a provider's
+        whole cold-start submit group. Per-item results — one rejected
+        script comes back ok=false with the status code the unary form
+        would have aborted with, and never fails its batch-mates.
+
+        Like JobsInfo, each item still execs one sbatch, so the batch
+        fans out across a small thread pool; ledger dedupe stays per item
+        (the ledger is locked, and two items with the same submitter id
+        in ONE batch are a caller bug the dedupe resolves benignly).
+        """
+
+        def one(req: pb.SubmitJobRequest) -> pb.SubmitJobsEntry:
+            try:
+                if req.submitter_id:
+                    known = self.ledger.get(req.submitter_id)
+                    if known is not None:
+                        log.info(
+                            "dedupe submit %s -> job %d", req.submitter_id, known
+                        )
+                        return pb.SubmitJobsEntry(job_id=known, ok=True)
+                job_id = self.driver.submit(submit_to_demand(req))
+            except SlurmError as e:
+                return pb.SubmitJobsEntry(
+                    ok=False, error_code="INTERNAL", error=str(e)
+                )
+            except Exception as e:  # noqa: BLE001 — item isolation is the
+                # contract: ANY failure (a malformed request blowing up in
+                # submit_to_demand, a driver bug) must fail its own entry,
+                # never take 511 batch-mates down with the whole RPC
+                log.exception("batch submit item failed")
+                return pb.SubmitJobsEntry(
+                    ok=False, error_code="INTERNAL", error=f"{type(e).__name__}: {e}"
+                )
+            if req.submitter_id:
+                self.ledger.put(req.submitter_id, job_id)
+            log.info("submitted job %d (partition=%s)", job_id, req.partition)
+            return pb.SubmitJobsEntry(job_id=job_id, ok=True)
+
+        reqs = list(request.requests)
+        if len(reqs) <= 1:
+            return pb.SubmitJobsResponse(results=[one(r) for r in reqs])
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(8, len(reqs))) as pool:
+            return pb.SubmitJobsResponse(results=list(pool.map(one, reqs)))
+
     def SubmitJobContainer(
         self, request: pb.SubmitJobContainerRequest, context
     ) -> pb.SubmitJobResponse:

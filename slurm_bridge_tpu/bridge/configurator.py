@@ -80,9 +80,29 @@ class Configurator:
             self._remove_partition(partition)
 
     def sync_now(self) -> None:
-        """Force one synchronous provider sync (tests/converge helpers)."""
-        for p in self.providers.values():
-            p.sync()
+        """Force one synchronous provider sync (tests/converge helpers).
+
+        Partitions converge in parallel (PR-4): each provider sync can
+        block on agent RPCs, and the forced-converge path used to pay the
+        sum of all partitions' cold-start fan-outs serially. With
+        ``pod_sync_workers == 1`` (the simulator's deterministic mode)
+        the syncs stay serial in sorted-partition order.
+        """
+        providers = [self.providers[p] for p in sorted(self.providers)]
+        if len(providers) <= 1 or self.pod_sync_workers == 1:
+            for p in providers:
+                p.sync()
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        # transient pool: sync_now is the forced-converge path, not the
+        # 250 ms ticker (each partition's ticker already runs in its own
+        # thread in steady state) — churn here is irrelevant
+        with ThreadPoolExecutor(
+            max_workers=min(8, len(providers)),
+            thread_name_prefix="partition-sync",
+        ) as pool:
+            list(pool.map(lambda p: p.sync(), providers))
 
     def _add_partition(self, partition: str) -> None:
         kwargs = {}

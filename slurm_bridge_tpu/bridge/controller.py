@@ -19,9 +19,16 @@ import heapq
 import logging
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
+from slurm_bridge_tpu.obs.metrics import REGISTRY
+
 log = logging.getLogger("sbt.controller")
+
+_queue_depth = REGISTRY.gauge(
+    "sbt_controller_queue_depth", "keys queued (ready + delayed) per work queue"
+)
 
 
 @dataclass
@@ -34,15 +41,39 @@ class Result:
 class WorkQueue:
     """Deduplicating delayed work queue with per-key backoff."""
 
-    def __init__(self, *, base_delay: float = 0.005, max_delay: float = 30.0):
+    def __init__(
+        self, *, base_delay: float = 0.005, max_delay: float = 30.0,
+        name: str = "workqueue",
+    ):
         self._lock = threading.Condition()
         self._queued: set[str] = set()
-        self._ready: list[str] = []
+        #: deque, not list: a cold-start storm parks tens of thousands of
+        #: keys here and ``pop(0)`` on a list is O(n) — quadratic drain
+        self._ready: deque[str] = deque()
         self._delayed: list[tuple[float, str]] = []  # heap of (when, key)
         self._failures: dict[str, int] = {}
         self._base = base_delay
         self._max = max_delay
         self._shutdown = False
+        self._depth_set = None  # bound gauge setter, built per name
+        self.name = name
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @name.setter
+    def name(self, value: str) -> None:
+        self._name = value
+        self._depth_set = None  # re-bind the gauge label on rename
+
+    def _observe_depth(self) -> None:
+        """Caller holds the lock. The gauge setter is bound once per
+        queue name (label-tuple built once, not per add/pop)."""
+        setter = self._depth_set
+        if setter is None:
+            setter = self._depth_set = _queue_depth.handle(queue=self._name)
+        setter(len(self._ready) + len(self._delayed))
 
     def add(self, key: str) -> None:
         with self._lock:
@@ -50,6 +81,7 @@ class WorkQueue:
                 return
             self._queued.add(key)
             self._ready.append(key)
+            self._observe_depth()
             self._lock.notify()
 
     def add_after(self, key: str, delay: float) -> None:
@@ -59,6 +91,7 @@ class WorkQueue:
             if self._shutdown:
                 return
             heapq.heappush(self._delayed, (time.monotonic() + delay, key))
+            self._observe_depth()
             self._lock.notify()
 
     def add_rate_limited(self, key: str) -> None:
@@ -83,8 +116,9 @@ class WorkQueue:
                         self._queued.add(key)
                         self._ready.append(key)
                 if self._ready:
-                    key = self._ready.pop(0)
+                    key = self._ready.popleft()
                     self._queued.discard(key)
+                    self._observe_depth()
                     return key
                 if self._shutdown:
                     return None
@@ -117,6 +151,10 @@ class Controller:
     workers: int = 1
     queue: WorkQueue = field(default_factory=WorkQueue)
     _threads: list[threading.Thread] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if self.queue.name == "workqueue":  # default-built: adopt our name
+            self.queue.name = self.name
 
     def start(self) -> None:
         for i in range(self.workers):

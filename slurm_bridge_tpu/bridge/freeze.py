@@ -31,55 +31,15 @@ from __future__ import annotations
 import copy
 import dataclasses
 
-FROZEN_FLAG = "_sbt_frozen"
-_PATCHED_FLAG = "_sbt_freezable"
-
-
-class FrozenInstanceError(AttributeError):
-    """Raised on any attempt to mutate a frozen store snapshot.
-
-    Callers holding a snapshot from ``get``/``list`` must go through
-    ``ObjectStore.mutate`` / ``get_for_update`` (or ``thaw``) to write.
-    """
-
-
-def _guarded_setattr(self, name, value):
-    if self.__dict__.get(FROZEN_FLAG, False):
-        raise FrozenInstanceError(
-            f"{type(self).__name__} is a frozen store snapshot; use "
-            "ObjectStore.mutate/get_for_update (or freeze.thaw) to modify"
-        )
-    object.__setattr__(self, name, value)
-
-
-def _guarded_delattr(self, name):
-    if self.__dict__.get(FROZEN_FLAG, False):
-        raise FrozenInstanceError(
-            f"{type(self).__name__} is a frozen store snapshot"
-        )
-    object.__delattr__(self, name)
-
-
-def _thawing_deepcopy(self, memo):
-    """deepcopy of a (possibly frozen) instance yields a thawed one."""
-    cls = self.__class__
-    new = cls.__new__(cls)
-    memo[id(self)] = new
-    for k, v in self.__dict__.items():
-        if k == FROZEN_FLAG:
-            continue
-        object.__setattr__(new, k, copy.deepcopy(v, memo))
-    return new
-
-
-def _enable(cls: type) -> None:
-    """Teach a dataclass type the frozen guard (idempotent, per-class)."""
-    if cls.__dict__.get(_PATCHED_FLAG, False):
-        return
-    cls.__setattr__ = _guarded_setattr
-    cls.__delattr__ = _guarded_delattr
-    cls.__deepcopy__ = _thawing_deepcopy
-    setattr(cls, _PATCHED_FLAG, True)
+from slurm_bridge_tpu.core.fastpath import (  # noqa: F401  (re-exported)
+    FROZEN_FLAG,
+    FrozenInstanceError,
+    enable_guard as _enable,
+    fast_new,
+    fast_replace,
+    frozen_new,
+    frozen_replace,
+)
 
 
 def _blocked(self, *a, **k):

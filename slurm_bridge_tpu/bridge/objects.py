@@ -22,6 +22,7 @@ import re
 import uuid
 from dataclasses import dataclass, field
 
+from slurm_bridge_tpu.core.fastpath import frozen_new
 from slurm_bridge_tpu.core.types import JobDemand, JobInfo, JobStatus
 
 # RFC 1035 label: what K8s requires of resource names
@@ -103,7 +104,11 @@ class SubjobStatus:
 
     @classmethod
     def from_job_info(cls, info: JobInfo) -> "SubjobStatus":
-        return cls(
+        # frozen_new (every field explicit): rebuilt for every sub-job on
+        # every CR status sync — 45k instances per sweep pass at the
+        # headline shape — born frozen, so the commit walk skips them
+        return frozen_new(
+            cls,
             id=info.id,
             array_id=info.array_id,
             state=info.state,

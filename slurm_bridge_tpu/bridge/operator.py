@@ -14,11 +14,20 @@ fields override them, default 1 node / 1 cpu / 1024 MB-per-cpu
 
 from __future__ import annotations
 
-import dataclasses
 import logging
 import os
+import queue
+import time
 
 from slurm_bridge_tpu.bridge.controller import Controller, Result
+from slurm_bridge_tpu.bridge.freeze import (
+    FrozenDict,
+    FrozenList,
+    fast_new,
+    fast_replace,
+    frozen_new,
+    frozen_replace,
+)
 from slurm_bridge_tpu.bridge.objects import (
     BridgeJob,
     ContainerStatus,
@@ -34,6 +43,7 @@ from slurm_bridge_tpu.bridge.objects import (
     PodStatus,
     SubjobStatus,
     ValidationError,
+    new_uid,
     validate_bridge_job,
 )
 from slurm_bridge_tpu.bridge.statusmap import (
@@ -52,6 +62,31 @@ log = logging.getLogger("sbt.operator")
 RESULT_REQUEUE_S = 30.0  # result-poll requeue (slurmbridgejob_controller.go:141)
 
 _reconciles = REGISTRY.counter("sbt_operator_reconciles_total", "operator reconciles")
+_sweeps = REGISTRY.counter(
+    "sbt_operator_sweeps_total", "dirty-set batch sweeps (PR-4 cold-start path)"
+)
+_reconcile_seconds = REGISTRY.histogram(
+    "sbt_operator_reconcile_seconds",
+    "one single-key reconcile, or one whole dirty-set sweep pass",
+)
+
+#: CR state transitions worth an event (UpdateSBJStatus's recorder calls)
+_STATE_REASONS = {
+    JobState.RUNNING: Reason.JOB_RUNNING,
+    JobState.SUCCEEDED: Reason.JOB_SUCCEEDED,
+    JobState.FAILED: Reason.JOB_FAILED,
+}
+
+#: shared empty job_infos for worker pods — immutable, so aliasing across
+#: 45k creates per sweep is safe and skips a FrozenList build each
+_EMPTY_FROZEN_LIST = FrozenList()
+
+#: dirty sets at least this large AND covering ≥¼ of the stored CRs read
+#: via two bulk list() dict builds instead of per-key try_get (3 locked
+#: lookups × 45k owners is 135k lock round-trips on a cold-start sweep).
+#: Module-level so the equivalence test can drop it and fuzz the bulk
+#: branch too.
+_BULK_SWEEP_THRESHOLD = 512
 
 
 def sizecar_name(job_name: str) -> str:
@@ -106,6 +141,13 @@ class BridgeOperator:
         self.controller = Controller(
             name="bridge-operator", reconcile=self.reconcile, workers=workers
         )
+        #: sweep-side validation cache: name -> the exact spec object that
+        #: passed validation. Validation is a pure function of (name,
+        #: spec), specs are immutable snapshots (any respec is a NEW
+        #: object), and holding the reference pins the address so an `is`
+        #: check can never alias a recycled id. The single-key oracle
+        #: still validates from scratch every time.
+        self._validated_specs: dict[str, object] = {}
 
     # ---- wiring ----
 
@@ -117,20 +159,57 @@ class BridgeOperator:
         threading.Thread(target=self._pump_events, daemon=True).start()
 
     def _pump_events(self) -> None:
-        """Map watch events to reconcile keys: BridgeJobs directly, owned
-        objects via their owner ref (SetupWithManager's Owns(&Pod{}),
-        slurmbridgejob_controller.go:204)."""
+        """Coalesce watch events into a dirty owner set and sweep it in
+        batch (PR-4): a cold-start storm of 100k owned-object events
+        collapses into a handful of sweep passes instead of 100k queued
+        single reconciles. Keys the sweep cannot settle (validation
+        failures, finished jobs, commit conflicts) go to the controller
+        queue, whose single-key :meth:`reconcile` remains the correctness
+        oracle — as does the whole dirty set if a sweep pass dies."""
         while True:
             ev = self._watch_q.get()
             if ev is None:
                 return
-            if ev.kind == BridgeJob.KIND:
-                self.controller.enqueue(ev.name)
-            else:
-                obj = self.store.try_get(ev.kind, ev.name)
-                owner = obj.meta.owner if obj is not None else self._owner_from_name(ev.name)
-                if owner:
-                    self.controller.enqueue(owner)
+            dirty: set[str] = set()
+            self._collect_owner(ev, dirty)
+            # drain whatever the storm has already queued — one sweep
+            # per burst, not one reconcile per event
+            while True:
+                try:
+                    ev = self._watch_q.get_nowait()
+                except queue.Empty:
+                    break
+                if ev is None:
+                    return
+                self._collect_owner(ev, dirty)
+            if not dirty:
+                continue
+            try:
+                for key in self.sweep(dirty):
+                    self.controller.enqueue(key)
+            except Exception:
+                log.exception(
+                    "sweep of %d keys failed; requeueing singly", len(dirty)
+                )
+                for key in sorted(dirty):
+                    self.controller.enqueue(key)
+
+    def _collect_owner(self, ev, dirty: set[str]) -> None:
+        """BridgeJobs reconcile as themselves; owned objects via their
+        owner ref (SetupWithManager's Owns(&Pod{}),
+        slurmbridgejob_controller.go:204). The conventional
+        ``-sizecar``/``-worker``/``-fetch`` name suffix resolves the owner
+        WITHOUT a store read — only unrecognized names pay the ``try_get``
+        (a cold-start tick pumps 100k+ events through here)."""
+        if ev.kind == BridgeJob.KIND:
+            dirty.add(ev.name)
+            return
+        owner = self._owner_from_name(ev.name)
+        if not owner:
+            obj = self.store.try_get(ev.kind, ev.name)
+            owner = obj.meta.owner if obj is not None else ""
+        if owner:
+            dirty.add(owner)
 
     def _owner_from_name(self, obj_name: str) -> str:
         for suffix in ("-sizecar", "-worker", "-fetch"):
@@ -150,9 +229,19 @@ class BridgeOperator:
     # ---- the reconcile ----
 
     def reconcile(self, job_name: str) -> Result | None:
+        t0 = time.perf_counter()
+        try:
+            return self._reconcile(job_name)
+        finally:
+            _reconcile_seconds.observe(time.perf_counter() - t0)
+
+    def _reconcile(self, job_name: str) -> Result | None:
         _reconciles.inc()
         job = self.store.try_get(BridgeJob.KIND, job_name)
         if job is None or job.meta.deleted:
+            # drop the sweep's validation-cache pin here too — a deletion
+            # settled by the single-key path must not leak the spec object
+            self._validated_specs.pop(job_name, None)
             return None
         try:
             validate_bridge_job(job)
@@ -168,7 +257,163 @@ class BridgeOperator:
         self._reconcile_worker(job_name)
         return None
 
+    # ---- the dirty-set batch sweep (PR-4 cold-start path) ----
+
+    def sweep(self, names) -> list[str]:
+        """Batch reconcile of a dirty owner set — the cold-start path.
+
+        Semantically N single reconciles (the fuzzed equivalence test in
+        tests/test_operator_sweep.py holds it to exactly that), but with
+        batched store traffic: reads run against current snapshots, then
+        ALL sizecar/worker creates land in one :meth:`~ObjectStore.
+        create_batch` and ALL CR status replacements plus worker-pod
+        writes land in one :meth:`~ObjectStore.update_batch` — two lock
+        acquisitions per sweep where the single-key path paid ~5 per
+        owner, 45k owners deep on a cold-start tick.
+
+        Returns the keys the sweep deliberately does NOT settle —
+        validation failures, finished jobs (the result-fetch path owns
+        requeue timing), vanished sizecars, and commit conflicts. Callers
+        route those to :meth:`reconcile`, the single-key correctness
+        oracle and the fallback for everything unusual.
+        """
+        t0 = time.perf_counter()
+        _sweeps.inc()
+        slow: list[str] = []
+        #: (pod to create, owning job when the create deserves an event)
+        creates: list[tuple[Pod, BridgeJob | None]] = []
+        cr_updates: list[tuple[BridgeJob, BridgeJob]] = []  # (before, after)
+        worker_updates: list[Pod] = []
+        ordered = sorted(set(names))
+        if (
+            len(ordered) >= _BULK_SWEEP_THRESHOLD
+            and len(ordered) * 4 >= self.store.count(BridgeJob.KIND)
+        ):
+            # bulk reads: a cold-start sweep touches most of the store,
+            # and 3 snapshot lookups × 45k owners is 135k lock round-trips
+            # — two list() calls and dict probes replace them all. Gated
+            # on the dirty set covering ≥¼ of the CRs, so a mid-size burst
+            # against a huge steady-state store does NOT materialize the
+            # whole store to answer a few hundred lookups.
+            get_job = {
+                o.meta.name: o for o in self.store.list(BridgeJob.KIND)
+            }.get
+            get_pod = {o.meta.name: o for o in self.store.list(Pod.KIND)}.get
+        else:
+            get_job = lambda n: self.store.try_get(BridgeJob.KIND, n)  # noqa: E731
+            get_pod = lambda n: self.store.try_get(Pod.KIND, n)  # noqa: E731
+        validated = self._validated_specs
+        for name in ordered:
+            job = get_job(name)
+            if job is None or job.meta.deleted:
+                validated.pop(name, None)
+                continue
+            if validated.get(name) is not job.spec:
+                try:
+                    validate_bridge_job(job)
+                except ValidationError:
+                    slow.append(name)
+                    continue
+                validated[name] = job.spec
+            if job.finished:
+                slow.append(name)
+                continue
+            sizecar = get_pod(sizecar_name(name))
+            if sizecar is None:
+                if job.status.subjobs:
+                    # pod vanished but sub-jobs exist ⇒ Failed — the
+                    # oracle owns the state write + warning event
+                    slow.append(name)
+                    continue
+                sizecar = self._build_sizecar(job)
+                creates.append((sizecar, job))
+            after = self._cr_replacement(job, sizecar)
+            if after is not None:
+                cr_updates.append((job, after))
+            eff = after if after is not None else job
+            if not eff.status.subjobs:
+                continue
+            containers = FrozenList(
+                container_status_for(info) for info in sizecar.status.job_infos
+            )
+            existing = get_pod(worker_name(name))
+            if existing is None:
+                creates.append(
+                    (self._build_worker(job, sizecar, containers), None)
+                )
+            else:
+                repl = self._worker_replacement(existing, sizecar, containers)
+                if repl is not None:
+                    worker_updates.append(repl)
+        if creates:
+            results = self.store.create_batch([pod for pod, _ in creates])
+            for (pod, job), res in zip(creates, results):
+                # AlreadyExists loses the create race exactly like the
+                # single path: silently (and without the event)
+                if job is not None and not isinstance(res, Exception):
+                    self.events.event(
+                        job, Reason.POD_CREATED,
+                        f"sizecar pod {pod.meta.name} created",
+                    )
+        updates = [after for _, after in cr_updates] + worker_updates
+        if updates:
+            results = self.store.update_batch(updates)
+            for (before, _), res in zip(cr_updates, results):
+                if isinstance(res, Exception):
+                    # racing writer: the oracle re-reads and retries
+                    slow.append(before.meta.name)
+                    continue
+                if self._emit_state_events(before, res):
+                    # just finished with a possible result request
+                    slow.append(before.meta.name)
+            for pod, res in zip(worker_updates, results[len(cr_updates):]):
+                if isinstance(res, Exception):
+                    slow.append(pod.meta.owner)
+        _reconcile_seconds.observe(time.perf_counter() - t0)
+        return sorted(set(slow))
+
     # ---- sizecar (ReconcileSizeCarPods, :296-319) ----
+
+    def _build_sizecar(self, job: BridgeJob) -> Pod:
+        demand = demand_for_job(job)
+        arr = array_len(demand.array)
+        # fast_new (every field explicit): one sizecar per arrival, 50k
+        # deep on a cold-start tick, against freeze-guarded classes
+        return fast_new(
+            Pod,
+            meta=fast_new(
+                Meta,
+                name=sizecar_name(job.meta.name),
+                uid=new_uid(),
+                labels={
+                    "role": PodRole.SIZECAR,
+                    "partition": demand.partition,
+                    # resource-request labels (pod.go:164-187)
+                    "request-cpu": str(demand.total_cpus(arr)),
+                    "request-memory-mb": str(demand.total_mem_mb(arr)),
+                },
+                annotations={},
+                owner=job.meta.name,
+                resource_version=0,
+                deleted=False,
+            ),
+            spec=fast_new(
+                PodSpec,
+                role=PodRole.SIZECAR,
+                partition=demand.partition,
+                demand=demand,
+                node_name="",
+                placement_hint=(),
+            ),
+            status=fast_new(
+                PodStatus,
+                phase=PodPhase.PENDING,
+                reason="",
+                job_ids=(),
+                job_infos=[],
+                containers=[],
+            ),
+        )
 
     def _reconcile_sizecar(self, job: BridgeJob) -> None:
         name = sizecar_name(job.meta.name)
@@ -180,25 +425,7 @@ class BridgeOperator:
                 job.meta.name, JobState.FAILED, reason="sizecar pod disappeared"
             )
             return
-        demand = demand_for_job(job)
-        arr = array_len(demand.array)
-        pod = Pod(
-            meta=Meta(
-                name=name,
-                owner=job.meta.name,
-                labels={
-                    "role": PodRole.SIZECAR,
-                    "partition": demand.partition,
-                    # resource-request labels (pod.go:164-187)
-                    "request-cpu": str(demand.total_cpus(arr)),
-                    "request-memory-mb": str(demand.total_mem_mb(arr)),
-                },
-            ),
-            spec=PodSpec(
-                role=PodRole.SIZECAR, partition=demand.partition, demand=demand
-            ),
-            status=PodStatus(phase=PodPhase.PENDING),
-        )
+        pod = self._build_sizecar(job)
         try:
             self.store.create(pod)
         except AlreadyExists:
@@ -207,124 +434,163 @@ class BridgeOperator:
 
     # ---- status sync (UpdateSBJStatus, :246-294) ----
 
-    def _sync_status(self, job_name: str) -> None:
-        pod = self.store.try_get(Pod.KIND, sizecar_name(job_name))
-        if pod is None:
-            return
+    def _cr_replacement(self, job: BridgeJob, pod: Pod) -> BridgeJob | None:
+        """Replacement CR mirroring ``pod``'s state, sharing frozen
+        spec/meta children — or None when nothing changed, so the
+        no-change case (steady-state reconciles) costs zero copies and
+        skips the write (no self-feeding watch loop). Shared by the
+        single-key reconcile and the batch sweep so they can never
+        drift."""
         state = job_state_for_pod_phase(pod.status.phase)
         subjobs = {
             info.key(): SubjobStatus.from_job_info(info)
             for info in pod.status.job_infos
         }
         pod_reason = pod.status.reason
+        new_subjobs = job.status.subjobs
+        if subjobs and job.status.subjobs != subjobs:
+            new_subjobs = subjobs
+        new_state = state
+        # don't regress a terminal CR state on a stale pod read
+        if job.status.state in JobState.TERMINAL:
+            new_state = job.status.state
+        new_reason = job.status.reason
+        if pod_reason and job.status.reason != pod_reason:
+            new_reason = pod_reason
+        endpoint = job.status.cluster_endpoint
+        if self.agent_endpoint and not endpoint:
+            endpoint = self.agent_endpoint
+        if (
+            new_subjobs is job.status.subjobs
+            and new_state == job.status.state
+            and new_reason == job.status.reason
+            and endpoint == job.status.cluster_endpoint
+        ):
+            return None
+        if new_subjobs is not job.status.subjobs:
+            # values are born-frozen SubjobStatus rows; wrapping here lets
+            # the status be born frozen too (commit walk: one dict probe)
+            new_subjobs = FrozenDict(new_subjobs)
+        return fast_replace(
+            job,
+            meta=fast_replace(job.meta),
+            status=frozen_replace(
+                job.status,
+                state=new_state,
+                reason=new_reason,
+                subjobs=new_subjobs,
+                cluster_endpoint=endpoint,
+            ),
+        )
 
-        def build(job: BridgeJob):
-            """Replacement CR sharing frozen spec/meta children — the
-            no-change case (steady-state reconciles) costs zero copies and
-            skips the write (no self-feeding watch loop)."""
-            new_subjobs = job.status.subjobs
-            if subjobs and job.status.subjobs != subjobs:
-                new_subjobs = subjobs
-            new_state = state
-            # don't regress a terminal CR state on a stale pod read
-            if job.status.state in JobState.TERMINAL:
-                new_state = job.status.state
-            new_reason = job.status.reason
-            if pod_reason and job.status.reason != pod_reason:
-                new_reason = pod_reason
-            endpoint = job.status.cluster_endpoint
-            if self.agent_endpoint and not endpoint:
-                endpoint = self.agent_endpoint
-            if (
-                new_subjobs is job.status.subjobs
-                and new_state == job.status.state
-                and new_reason == job.status.reason
-                and endpoint == job.status.cluster_endpoint
-            ):
-                return None
-            return BridgeJob(
-                meta=dataclasses.replace(job.meta),
-                spec=job.spec,
-                status=dataclasses.replace(
-                    job.status,
-                    state=new_state,
-                    reason=new_reason,
-                    subjobs=new_subjobs,
-                    cluster_endpoint=endpoint,
-                ),
+    def _emit_state_events(self, before: BridgeJob, after: BridgeJob) -> bool:
+        """The recorder calls UpdateSBJStatus makes on a state transition.
+        Returns True when the job just finished (needs a result pass)."""
+        if before.status.state == after.status.state:
+            return False
+        r = _STATE_REASONS.get(after.status.state)
+        if r:
+            self.events.event(
+                after, r, f"state {before.status.state} -> {after.status.state}",
+                warning=after.status.state == JobState.FAILED,
             )
+        return after.finished
 
+    def _sync_status(self, job_name: str) -> None:
+        pod = self.store.try_get(Pod.KIND, sizecar_name(job_name))
+        if pod is None:
+            return
         try:
             before = self.store.get(BridgeJob.KIND, job_name)
-            after = self.store.replace_update(BridgeJob.KIND, job_name, build)
+            after = self.store.replace_update(
+                BridgeJob.KIND, job_name, lambda j: self._cr_replacement(j, pod)
+            )
         except NotFound:
             return
-        if before.status.state != after.status.state:
-            reason_map = {
-                JobState.RUNNING: Reason.JOB_RUNNING,
-                JobState.SUCCEEDED: Reason.JOB_SUCCEEDED,
-                JobState.FAILED: Reason.JOB_FAILED,
-            }
-            r = reason_map.get(after.status.state)
-            if r:
-                self.events.event(
-                    after, r, f"state {before.status.state} -> {after.status.state}",
-                    warning=after.status.state == JobState.FAILED,
-                )
-            # a just-finished job with a result request needs another pass
-            if after.finished:
-                self.controller.enqueue(job_name)
+        # a just-finished job with a result request needs another pass
+        if self._emit_state_events(before, after):
+            self.controller.enqueue(job_name)
 
     # ---- worker pods (ReconcileWorkerPods, :365-451) ----
+
+    def _build_worker(
+        self, job: BridgeJob, sizecar: Pod | None, containers: FrozenList
+    ) -> Pod:
+        # fast_new/frozen_new (every field explicit): one worker pod per
+        # job with sub-jobs — 45k per transition sweep at the headline
+        # shape. spec/status are born frozen (their values are scalars or
+        # frozen rows), so the create-commit walk stops at meta.
+        return fast_new(
+            Pod,
+            meta=fast_new(
+                Meta,
+                name=worker_name(job.meta.name),
+                uid=new_uid(),
+                labels={"role": PodRole.WORKER, "partition": job.spec.partition},
+                annotations={},
+                owner=job.meta.name,
+                resource_version=0,
+                deleted=False,
+            ),
+            spec=frozen_new(
+                PodSpec,
+                role=PodRole.WORKER,
+                partition=job.spec.partition,
+                demand=None,
+                node_name=sizecar.spec.node_name if sizecar else "",
+                placement_hint=(),
+            ),
+            status=frozen_new(
+                PodStatus,
+                phase=sizecar.status.phase if sizecar else PodPhase.PENDING,
+                reason="",
+                job_ids=(),
+                job_infos=_EMPTY_FROZEN_LIST,
+                containers=containers,
+            ),
+        )
+
+    @staticmethod
+    def _worker_replacement(
+        p: Pod, sizecar: Pod | None, containers: list[ContainerStatus]
+    ) -> Pod | None:
+        phase = sizecar.status.phase if sizecar else p.status.phase
+        if p.status.containers == containers and p.status.phase == phase:
+            return None
+        return fast_replace(
+            p,
+            meta=fast_replace(p.meta),
+            status=frozen_replace(
+                p.status,
+                containers=containers
+                if isinstance(containers, FrozenList)
+                else FrozenList(containers),
+                phase=phase,
+            ),
+        )
 
     def _reconcile_worker(self, job_name: str) -> None:
         job = self.store.try_get(BridgeJob.KIND, job_name)
         if job is None or not job.status.subjobs:
             return
         sizecar = self.store.try_get(Pod.KIND, sizecar_name(job_name))
-        containers = [
+        containers = FrozenList(
             container_status_for(info)
-            for info in (sizecar.status.job_infos if sizecar else [])
-        ]
+            for info in (sizecar.status.job_infos if sizecar else ())
+        )
         name = worker_name(job_name)
         existing = self.store.try_get(Pod.KIND, name)
         if existing is None:
-            pod = Pod(
-                meta=Meta(
-                    name=name,
-                    owner=job_name,
-                    labels={"role": PodRole.WORKER, "partition": job.spec.partition},
-                ),
-                spec=PodSpec(
-                    role=PodRole.WORKER,
-                    partition=job.spec.partition,
-                    node_name=sizecar.spec.node_name if sizecar else "",
-                ),
-                status=PodStatus(
-                    phase=sizecar.status.phase if sizecar else PodPhase.PENDING,
-                    containers=containers,
-                ),
-            )
             try:
-                self.store.create(pod)
+                self.store.create(self._build_worker(job, sizecar, containers))
             except AlreadyExists:
                 pass
             return
-
-        def build(p: Pod):
-            phase = sizecar.status.phase if sizecar else p.status.phase
-            if p.status.containers == containers and p.status.phase == phase:
-                return None
-            return Pod(
-                meta=dataclasses.replace(p.meta),
-                spec=p.spec,
-                status=dataclasses.replace(
-                    p.status, containers=containers, phase=phase
-                ),
-            )
-
         try:
-            self.store.replace_update(Pod.KIND, name, build)
+            self.store.replace_update(
+                Pod.KIND, name,
+                lambda p: self._worker_replacement(p, sizecar, containers),
+            )
         except NotFound:
             pass
 

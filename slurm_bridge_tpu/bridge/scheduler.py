@@ -28,7 +28,6 @@ the requeue as a fresh submission.
 
 from __future__ import annotations
 
-import dataclasses
 import logging
 import time
 
@@ -42,6 +41,7 @@ from slurm_bridge_tpu.bridge.objects import (
     VirtualNode,
     partition_node_name,
 )
+from slurm_bridge_tpu.bridge.freeze import fast_replace, frozen_replace
 from slurm_bridge_tpu.bridge.store import NotFound, ObjectStore
 from slurm_bridge_tpu.core.types import JobDemand, NodeInfo, PartitionInfo
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
@@ -303,6 +303,7 @@ class PlacementScheduler:
             if vn.ready and not vn.meta.deleted
         }
         binds: list[tuple[Pod, str, tuple[str, ...]]] = []
+        unschedulable: list[tuple[Pod, str]] = []
         for j, pod in enumerate(pods):
             names = by_job_names.get(j)
             partition = demands[j].partition
@@ -314,7 +315,8 @@ class PlacementScheduler:
                     if partition in ready_nodes
                     else f"Unschedulable: no ready virtual node for partition {partition!r}"
                 )
-                self._mark_unschedulable(pod, reason)
+                unschedulable.append((pod, reason))
+        self._mark_unschedulable_batch(unschedulable)
         placed = self._bind_batch(binds)
         preempted = 0
         for j in lost_jobs:
@@ -724,12 +726,15 @@ class PlacementScheduler:
         if not binds:
             return 0
         updated = [
-            Pod(
-                meta=dataclasses.replace(pod.meta),
-                spec=dataclasses.replace(
+            fast_replace(
+                pod,
+                meta=fast_replace(pod.meta),
+                # spec/status born frozen (changed values are scalars):
+                # the 45k-write commit walk stops at meta
+                spec=frozen_replace(
                     pod.spec, node_name=node_name, placement_hint=hint
                 ),
-                status=dataclasses.replace(pod.status, reason=""),
+                status=frozen_replace(pod.status, reason=""),
             )
             for pod, node_name, hint in binds
         ]
@@ -771,16 +776,53 @@ class PlacementScheduler:
         )
         return True
 
+    def _mark_unschedulable_batch(self, marks: list[tuple[Pod, str]]) -> None:
+        """PLACEMENT_FAILED recording for every unplaced pod of the tick
+        in ONE ``update_batch`` (PR-4): the very first cold-start tick
+        marks the ENTIRE backlog unschedulable (no virtual node is ready
+        yet), which used to cost one locked read-modify-write per pod —
+        3.6 s of the 50k-pod tick. Writes land only where the reason
+        actually changed; the warning event fires per pod either way,
+        exactly like the per-pod form."""
+        if not marks:
+            return
+        changed = [(p, r) for p, r in marks if p.status.reason != r]
+        skip_event: set[str] = set()
+        if changed:
+            results = self.store.update_batch(
+                [
+                    fast_replace(
+                        pod,
+                        meta=fast_replace(pod.meta),
+                        status=frozen_replace(pod.status, reason=reason),
+                    )
+                    for pod, reason in changed
+                ]
+            )
+            for (pod, reason), res in zip(changed, results):
+                if isinstance(res, NotFound):
+                    skip_event.add(pod.name)  # deleted mid-tick: no event
+                elif isinstance(res, Exception):
+                    # racing writer: the per-pod optimistic retry (which
+                    # emits its own event on success)
+                    skip_event.add(pod.name)
+                    self._mark_unschedulable(pod, reason)
+        for pod, reason in marks:
+            if pod.name not in skip_event:
+                self.events.event(
+                    pod, Reason.PLACEMENT_FAILED, reason, warning=True
+                )
+
     def _mark_unschedulable(self, pod: Pod, reason: str) -> None:
         try:
 
             def build(p: Pod):
                 if p.status.reason == reason:
                     return None
-                return Pod(
-                    meta=dataclasses.replace(p.meta),
-                    spec=p.spec,
-                    status=dataclasses.replace(p.status, reason=reason),
+                return fast_replace(
+                    p,
+                    meta=fast_replace(p.meta),
+                    status=frozen_replace(p.status, reason=reason),
                 )
 
             self.store.replace_update(Pod.KIND, pod.name, build)

@@ -15,6 +15,7 @@ from slurm_bridge_tpu.bridge.objects import (
     JobState,
     PodPhase,
 )
+from slurm_bridge_tpu.core.fastpath import frozen_new
 from slurm_bridge_tpu.core.types import JobInfo, JobStatus
 
 _BAD_END = (JobStatus.FAILED, JobStatus.CANCELLED, JobStatus.TIMEOUT)
@@ -38,21 +39,30 @@ def pod_phase_for(statuses: list[JobStatus]) -> str:
     return PodPhase.UNKNOWN
 
 
+_STATE_FOR_PHASE = {
+    PodPhase.PENDING: JobState.SUBMITTED,
+    PodPhase.RUNNING: JobState.RUNNING,
+    PodPhase.SUCCEEDED: JobState.SUCCEEDED,
+    PodPhase.FAILED: JobState.FAILED,
+}
+
+
 def job_state_for_pod_phase(phase: str) -> str:
     """Pod phase → CR state (UpdateSBJStatus,
     slurmbridgejob_controller.go:246-294)."""
-    return {
-        PodPhase.PENDING: JobState.SUBMITTED,
-        PodPhase.RUNNING: JobState.RUNNING,
-        PodPhase.SUCCEEDED: JobState.SUCCEEDED,
-        PodPhase.FAILED: JobState.FAILED,
-    }.get(phase, JobState.PENDING)
+    return _STATE_FOR_PHASE.get(phase, JobState.PENDING)
 
 
 def container_status_for(info: JobInfo) -> ContainerStatus:
     """One display "container" per sub-job (status.go:105-186): waiting
     while PENDING, running while RUNNING, terminated with the parsed exit
-    code once ended."""
+    code once ended.
+
+    Built via ``frozen_new`` (every field explicit, born frozen): one
+    instance per sub-job per worker-pod sync — 45k per sweep pass at the
+    headline shape — and these rows land inside born-frozen PodStatus
+    objects, so they MUST be frozen themselves (an unfrozen child inside
+    a frozen parent would be silently mutable in stored snapshots)."""
     name = f"job-{info.key()}"
     if info.state.is_terminal:
         code = 0
@@ -63,9 +73,15 @@ def container_status_for(info: JobInfo) -> ContainerStatus:
                 code = 0
         if code == 0 and info.state in _BAD_END:
             code = 1
-        return ContainerStatus(
-            name=name, state="terminated", exit_code=code, reason=info.state.name
+        return frozen_new(
+            ContainerStatus,
+            name=name, state="terminated", exit_code=code, reason=info.state.name,
         )
     if info.state == JobStatus.RUNNING:
-        return ContainerStatus(name=name, state="running")
-    return ContainerStatus(name=name, state="waiting", reason=info.state.name)
+        return frozen_new(
+            ContainerStatus, name=name, state="running", exit_code=0, reason=""
+        )
+    return frozen_new(
+        ContainerStatus,
+        name=name, state="waiting", exit_code=0, reason=info.state.name,
+    )

@@ -13,7 +13,7 @@ import logging
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from typing import NamedTuple
 
 
 class Reason:
@@ -42,14 +42,16 @@ class Reason:
     RESULT_FETCH_FAILED = "ResultFetchFailed"
 
 
-@dataclass
-class Event:
+class Event(NamedTuple):
+    """A NamedTuple, not a dataclass: the recorder mints ~100k of these
+    per cold-start reconcile tick and C-level construction matters."""
+
     reason: str
     message: str
     kind: str = ""
     name: str = ""
     type: str = "Normal"  # Normal | Warning
-    ts: float = field(default_factory=time.time)
+    ts: float = 0.0
 
 
 class EventRecorder:
@@ -70,12 +72,18 @@ class EventRecorder:
             kind=type(obj).__name__ if obj is not None else "",
             name=getattr(obj, "name", "") if obj is not None else "",
             type="Warning" if warning else "Normal",
+            ts=time.time(),
         )
         with self._lock:
             self._events.append(ev)
-        (self._log.warning if warning else self._log.info)(
-            "%s %s/%s: %s", ev.reason, ev.kind, ev.name, ev.message
-        )
+        # isEnabledFor before the log call: the simulator/benchmarks quiet
+        # this logger and emit ~100k events per cold-start tick — skipping
+        # the no-op logging machinery is a real win there
+        level = logging.WARNING if warning else logging.INFO
+        if self._log.isEnabledFor(level):
+            self._log.log(
+                level, "%s %s/%s: %s", ev.reason, ev.kind, ev.name, ev.message
+            )
         for sink in self._sinks:
             sink(ev)
         return ev

@@ -54,6 +54,18 @@ class Gauge:
         with self._lock:
             self._values[tuple(sorted(labels.items()))] = value
 
+    def handle(self, **labels: str):
+        """A bound setter with the label key resolved ONCE — for hot-path
+        callers (the work-queue depth updates on every add/pop) that would
+        otherwise rebuild the sorted label tuple per observation."""
+        key = tuple(sorted(labels.items()))
+
+        def set_value(value: float) -> None:
+            with self._lock:
+                self._values[key] = value
+
+        return set_value
+
     def collect(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         with self._lock:

@@ -29,9 +29,10 @@ from __future__ import annotations
 
 import logging
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from slurm_bridge_tpu.core.arrays import array_len
+from slurm_bridge_tpu.core.fastpath import frozen_new
 from slurm_bridge_tpu.core.scontrol import parse_gres_gpus
 from slurm_bridge_tpu.core.types import JobInfo, JobStatus, NodeInfo, PartitionInfo
 from slurm_bridge_tpu.wire import pb
@@ -121,28 +122,61 @@ class SimJob:
     assigned: tuple[str, ...] = ()
     reason: str = ""
 
-    def info(self, now: float | None = None) -> JobInfo:
+    def _run_time(self, now: float | None) -> int:
         # elapsed runtime like Slurm's RunTime: virtual now, capped at the
         # job's end — NOT the planned duration (a job 1 s into a 120 s run
         # must not already read as at its limit)
         if self.start_vt < 0:
-            run_time = 0
-        elif now is None:
-            run_time = int(max(0.0, self.end_vt - self.start_vt))
-        else:
-            run_time = int(max(0.0, min(now, self.end_vt) - self.start_vt))
-        return JobInfo(
+            return 0
+        if now is None:
+            return int(max(0.0, self.end_vt - self.start_vt))
+        return int(max(0.0, min(now, self.end_vt) - self.start_vt))
+
+    def fill_info_proto(self, m: pb.JobInfo, now: float | None = None) -> None:
+        """Write this job's state straight into a wire ``JobInfo`` — the
+        batched-status fan-out path (45k rows per mirror tick at the
+        headline shape), skipping the intermediate dataclass AND the
+        proto copy an entry constructed via kwargs would pay. Field-for-
+        field identical to ``job_info_to_proto(self.info(now))`` (the
+        unary path keeps that form; a test holds the two together)."""
+        m.id = self.id
+        m.name = self.name
+        m.status = int(self.state)
+        m.run_time_s = self._run_time(now)
+        m.time_limit_s = int(self.duration_s)
+        m.partition = self.partition
+        m.node_list = ",".join(self.assigned)
+        m.batch_host = self.assigned[0] if self.assigned else ""
+        m.num_nodes = self.num_nodes
+        out = f"/sim/{self.id}.out"
+        m.std_out = out
+        m.std_err = out
+        m.reason = self.reason
+
+    def info(self, now: float | None = None) -> JobInfo:
+        run_time = self._run_time(now)
+        # frozen_new (every field explicit): built once per live job per
+        # status query — skipping the guarded __init__ and the freeze walk
+        out = f"/sim/{self.id}.out"
+        return frozen_new(
+            JobInfo,
             id=self.id,
+            user_id="",
             name=self.name,
+            exit_code="",
             state=self.state,
+            submit_time=None,
+            start_time=None,
             run_time_s=run_time,
             time_limit_s=int(self.duration_s),
+            working_dir="",
+            std_out=out,
+            std_err=out,
             partition=self.partition,
             node_list=",".join(self.assigned),
             batch_host=self.assigned[0] if self.assigned else "",
             num_nodes=self.num_nodes,
-            std_out=f"/sim/{self.id}.out",
-            std_err=f"/sim/{self.id}.out",
+            array_id="",
             reason=self.reason,
         )
 
@@ -410,6 +444,17 @@ class SimWorkloadClient:
     def SubmitJob(self, request, timeout=None) -> pb.SubmitJobResponse:
         return pb.SubmitJobResponse(job_id=self.cluster.submit(request))
 
+    def SubmitJobs(self, request, timeout=None) -> pb.SubmitJobsResponse:
+        """Batched submit — agent/server.py parity: one entry per request,
+        order preserved, answered from ground truth (SimCluster.submit
+        never rejects a script, so every entry is ok; per-item failures
+        are the FaultyClient's job)."""
+        resp = pb.SubmitJobsResponse()
+        add = resp.results.add  # in-place: no per-entry message copy
+        for r in request.requests:
+            add(job_id=self.cluster.submit(r), ok=True)
+        return resp
+
     def CancelJob(self, request, timeout=None) -> pb.CancelJobResponse:
         self.cluster.cancel(int(request.job_id))
         return pb.CancelJobResponse()
@@ -429,22 +474,23 @@ class SimWorkloadClient:
 
     def JobsInfo(self, request, timeout=None) -> pb.JobsInfoResponse:
         """Batched JobInfo — agent/server.py parity: unknown ids come back
-        found=false, the batch never aborts on one bad id."""
+        found=false, the batch never aborts on one bad id.
+
+        Rows are written in place (``jobs.add()`` + ``fill_info_proto``):
+        the kwargs form built each 45k-row response out of intermediate
+        dataclasses and then COPIED every message into the response."""
         now = self.cluster.clock()
-        entries = []
+        jobs = self.cluster.jobs
+        resp = pb.JobsInfoResponse()
+        add = resp.jobs.add
         for job_id in request.job_ids:
-            job = self.cluster.jobs.get(int(job_id))
+            job = jobs.get(int(job_id))
             if job is None:
-                entries.append(pb.JobsInfoEntry(job_id=job_id, found=False))
+                add(job_id=job_id, found=False)
                 continue
-            entries.append(
-                pb.JobsInfoEntry(
-                    job_id=job_id,
-                    found=True,
-                    info=[job_info_to_proto(job.info(now=now))],
-                )
-            )
-        return pb.JobsInfoResponse(jobs=entries)
+            entry = add(job_id=job_id, found=True)
+            job.fill_info_proto(entry.info.add(), now=now)
+        return resp
 
     def JobState(self, request, timeout=None) -> pb.JobStateResponse:
         job = self.cluster.jobs.get(int(request.job_id))

@@ -52,6 +52,8 @@ def _headline(result) -> dict:
         "pods": result.shape["pods"],
         "nodes": result.shape["nodes"],
         "bound_total": result.determinism["bound_total"],
+        "submits_batched": result.determinism["submits_batched"],
+        "submits_fallback": result.determinism["submits_fallback"],
         "invariant_violations": len(result.determinism["invariant_violations"]),
     }
 

@@ -165,12 +165,78 @@ class FaultyClient:
     def close(self) -> None:
         self._inner.close()
 
+    def _submit_jobs(self, inner_fn, request, timeout):
+        """Per-item injection for the batched submit (PR-4).
+
+        A whole-RPC failure must name ``SubmitJobs`` explicitly; every
+        other matching fault ("SubmitJob" or empty methods = all) draws
+        PER ITEM and turns its victims into ok=false entries — a
+        flaky-agent plan written for the unary submit path exercises the
+        same failure surface against the batched form, and one injected
+        fault no longer takes 2,000 batch-mates down with it.
+        """
+        from slurm_bridge_tpu.wire import pb
+
+        for f in self._plan.active("rpc_error", self.tick):
+            if "SubmitJobs" in f.methods and self._rng.random() < f.rate:
+                self.injected_errors["SubmitJobs"] = (
+                    self.injected_errors.get("SubmitJobs", 0) + 1
+                )
+                raise SimRpcError(f.status_code, f"injected {f.code} on SubmitJobs")
+        for f in self._plan.active("rpc_latency", self.tick):
+            # latency faults naming the batched method explicitly charge
+            # once per round-trip (symmetric with the rpc_error handling)
+            if "SubmitJobs" in f.methods:
+                self.injected_latency_ms += f.latency_ms
+        item_faults = [
+            f
+            for f in self._plan.active("rpc_error", self.tick)
+            if f.matches("SubmitJob")
+        ]
+        latency = [
+            f
+            for f in self._plan.active("rpc_latency", self.tick)
+            if f.matches("SubmitJob")
+        ]
+        entries: list = [None] * len(request.requests)
+        forward: list = []
+        fwd_idx: list[int] = []
+        for i, req in enumerate(request.requests):
+            for f in latency:
+                self.injected_latency_ms += f.latency_ms
+            hit = None
+            for f in item_faults:
+                if self._rng.random() < f.rate:
+                    hit = f
+                    break
+            if hit is not None:
+                self.injected_errors["SubmitJob"] = (
+                    self.injected_errors.get("SubmitJob", 0) + 1
+                )
+                entries[i] = pb.SubmitJobsEntry(
+                    ok=False,
+                    error_code=hit.code,
+                    error=f"injected {hit.code} on SubmitJob",
+                )
+                continue
+            forward.append(req)
+            fwd_idx.append(i)
+        if forward:
+            resp = inner_fn(
+                pb.SubmitJobsRequest(requests=forward), timeout=timeout
+            )
+            for i, entry in zip(fwd_idx, resp.results):
+                entries[i] = entry
+        return pb.SubmitJobsResponse(results=entries)
+
     def __getattr__(self, method: str):
         inner_fn = getattr(self._inner, method)
         if not callable(inner_fn) or method.startswith("_"):
             return inner_fn
 
         def call(request, timeout=None):
+            if method == "SubmitJobs":
+                return self._submit_jobs(inner_fn, request, timeout)
             for f in self._plan.active("rpc_error", self.tick):
                 if f.matches(method) and self._rng.random() < f.rate:
                     self.injected_errors[method] = (

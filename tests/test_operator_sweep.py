@@ -1,0 +1,365 @@
+"""PR-4 operator dirty-set sweep: the batch path must be semantically a
+set of single reconciles (fuzzed equivalence), a no-change sweep must be
+free (0 store writes, 0 agent RPCs), and everything unusual must route
+back to the single-key oracle."""
+
+import dataclasses
+
+import pytest
+
+from slurm_bridge_tpu.bridge.freeze import fast_replace
+from slurm_bridge_tpu.bridge.objects import (
+    BridgeJob,
+    BridgeJobSpec,
+    JobState,
+    Meta,
+    Pod,
+    PodPhase,
+    PodRole,
+    PodSpec,
+    PodStatus,
+    partition_node_name,
+)
+from slurm_bridge_tpu.bridge.operator import (
+    BridgeOperator,
+    sizecar_name,
+    worker_name,
+)
+from slurm_bridge_tpu.bridge.store import ObjectStore
+from slurm_bridge_tpu.bridge.vnode import VirtualNodeProvider
+from slurm_bridge_tpu.core.types import JobDemand, JobInfo, JobStatus
+from slurm_bridge_tpu.obs.events import EventRecorder
+from slurm_bridge_tpu.sim.agent import SimCluster, SimNode, SimWorkloadClient
+
+SCRIPT = "#!/bin/sh\ntrue\n"
+
+
+def _spec(**kw) -> BridgeJobSpec:
+    kw.setdefault("partition", "part0")
+    kw.setdefault("sbatch_script", SCRIPT)
+    return BridgeJobSpec(**kw)
+
+
+def _info(jid: int, state=JobStatus.RUNNING, **kw) -> JobInfo:
+    return JobInfo(
+        id=jid, state=state, name=f"job-{jid}", std_out=f"/o/{jid}",
+        node_list="n0", num_nodes=1, **kw,
+    )
+
+
+def _sizecar(job_name: str, *, phase: str, infos: list[JobInfo]) -> Pod:
+    return Pod(
+        meta=Meta(name=sizecar_name(job_name), owner=job_name),
+        spec=PodSpec(
+            role=PodRole.SIZECAR,
+            partition="part0",
+            demand=JobDemand(partition="part0", script=SCRIPT, cpus_per_task=1),
+        ),
+        status=PodStatus(
+            phase=phase,
+            job_ids=tuple(i.id for i in infos),
+            job_infos=list(infos),
+        ),
+    )
+
+
+def _build_fixture(seed: int) -> tuple[ObjectStore, BridgeOperator, list[str], dict]:
+    """A store with jobs across the lifecycle, deterministically derived
+    from ``seed`` so two calls produce equal (modulo uid/rv) stores."""
+    import random
+
+    rng = random.Random(seed)
+    store = ObjectStore()
+    counts: dict[str, int] = {}
+    events = EventRecorder()
+
+    def count(ev):
+        counts[ev.reason] = counts.get(ev.reason, 0) + 1
+
+    events.add_sink(count)
+    op = BridgeOperator(store, agent_endpoint="test://agent", events=events)
+    names: list[str] = []
+    for i in range(40):
+        kind = rng.randrange(9)
+        name = f"fz-{seed}-{i:02d}"
+        names.append(name)
+        jid = 5000 + i
+        if kind == 0:  # fresh job, no sizecar yet
+            store.create(BridgeJob(meta=Meta(name=name), spec=_spec()))
+        elif kind == 1:  # sizecar pending, not yet submitted
+            store.create(BridgeJob(meta=Meta(name=name), spec=_spec()))
+            store.create(_sizecar(name, phase=PodPhase.PENDING, infos=[]))
+        elif kind == 2:  # running, worker not created yet
+            store.create(BridgeJob(meta=Meta(name=name), spec=_spec()))
+            store.create(
+                _sizecar(name, phase=PodPhase.RUNNING, infos=[_info(jid)])
+            )
+        elif kind == 3:  # running, worker stale (no containers)
+            store.create(BridgeJob(meta=Meta(name=name), spec=_spec()))
+            store.create(
+                _sizecar(name, phase=PodPhase.RUNNING, infos=[_info(jid)])
+            )
+            store.create(
+                Pod(
+                    meta=Meta(name=worker_name(name), owner=name),
+                    spec=PodSpec(role=PodRole.WORKER, partition="part0"),
+                    status=PodStatus(phase=PodPhase.PENDING),
+                )
+            )
+        elif kind == 4:  # sizecar vanished but subjobs exist => Failed
+            job = BridgeJob(meta=Meta(name=name), spec=_spec())
+            from slurm_bridge_tpu.bridge.objects import SubjobStatus
+
+            job.status.subjobs = {str(jid): SubjobStatus(id=jid)}
+            store.create(job)
+        elif kind == 5:  # invalid name => validation failure
+            bad = f"Fz_{seed}_{i:02d}"
+            names[-1] = bad
+            store.create(BridgeJob(meta=Meta(name=bad), spec=_spec()))
+        elif kind == 6:  # completed job (sizecar Succeeded)
+            store.create(BridgeJob(meta=Meta(name=name), spec=_spec()))
+            store.create(
+                _sizecar(
+                    name,
+                    phase=PodPhase.SUCCEEDED,
+                    infos=[_info(jid, state=JobStatus.COMPLETED)],
+                )
+            )
+        elif kind == 7:  # already-finished CR (result path no-ops: no result_to)
+            job = BridgeJob(meta=Meta(name=name), spec=_spec())
+            job.status.state = JobState.SUCCEEDED
+            store.create(job)
+        else:  # deletion-marked job: skipped entirely
+            job = BridgeJob(meta=Meta(name=name), spec=_spec())
+            job.meta.deleted = True
+            store.create(job)
+    return store, op, names, counts
+
+
+def _normalize(store: ObjectStore) -> dict:
+    """Store content modulo identity fields (uid, resource_version)."""
+    out = {}
+    for kind in (BridgeJob.KIND, Pod.KIND, "FetchJob"):
+        for obj in store.list(kind):
+            d = dataclasses.asdict(obj)
+            d["meta"].pop("uid", None)
+            d["meta"].pop("resource_version", None)
+            out[(kind, obj.meta.name)] = d
+    return out
+
+
+def _drain(op: BridgeOperator) -> None:
+    """Run the controller queue's ready keys through the oracle (what the
+    worker threads would do), single-threaded and deterministic."""
+    for _ in range(1000):
+        key = op.controller.queue.get(timeout=0)
+        if key is None:
+            return
+        op.reconcile(key)
+    raise AssertionError("controller queue did not drain")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_sweep_equivalent_to_single_reconciles(seed):
+    """THE equivalence contract: sweep(names) + oracle follow-ups leaves
+    the store (and the event stream) exactly as N single reconciles."""
+    store_a, op_a, names_a, counts_a = _build_fixture(seed)
+    store_b, op_b, names_b, counts_b = _build_fixture(seed)
+    assert names_a == names_b
+
+    for key in op_a.sweep(names_a):
+        op_a.reconcile(key)
+    _drain(op_a)
+
+    for name in sorted(set(names_b)):
+        op_b.reconcile(name)
+    _drain(op_b)
+
+    assert _normalize(store_a) == _normalize(store_b)
+    assert counts_a == counts_b
+
+
+def test_sweep_converges_like_reconcile_over_multiple_passes(seed=7):
+    """Sweeping the same dirty set until quiescence ends at the same fixed
+    point as reconciling until quiescence."""
+    store_a, op_a, names_a, _ = _build_fixture(seed)
+    store_b, op_b, names_b, _ = _build_fixture(seed)
+    for _ in range(4):
+        for key in op_a.sweep(names_a):
+            op_a.reconcile(key)
+        _drain(op_a)
+    for _ in range(4):
+        for name in sorted(set(names_b)):
+            op_b.reconcile(name)
+        _drain(op_b)
+    assert _normalize(store_a) == _normalize(store_b)
+
+
+def test_sweep_creates_sizecar_with_event():
+    store = ObjectStore()
+    counts: dict[str, int] = {}
+    events = EventRecorder()
+    events.add_sink(lambda ev: counts.__setitem__(ev.reason, counts.get(ev.reason, 0) + 1))
+    op = BridgeOperator(store, events=events)
+    store.create(BridgeJob(meta=Meta(name="swp1"), spec=_spec()))
+    assert op.sweep(["swp1"]) == []
+    pod = store.get(Pod.KIND, sizecar_name("swp1"))
+    assert pod.spec.role == PodRole.SIZECAR
+    assert pod.spec.demand is not None and pod.spec.demand.script == SCRIPT
+    assert counts.get("PodCreated") == 1
+    # second sweep: sizecar exists, nothing new
+    assert op.sweep(["swp1"]) == []
+    assert counts.get("PodCreated") == 1
+
+
+def test_sweep_routes_unusual_keys_to_oracle():
+    store = ObjectStore()
+    op = BridgeOperator(store, events=EventRecorder())
+    store.create(BridgeJob(meta=Meta(name="Bad_name"), spec=_spec()))
+    finished = BridgeJob(meta=Meta(name="done1"), spec=_spec())
+    finished.status.state = JobState.SUCCEEDED
+    store.create(finished)
+    slow = op.sweep(["Bad_name", "done1", "missing-entirely"])
+    assert slow == ["Bad_name", "done1"]
+    # the oracle settles them
+    for key in slow:
+        op.reconcile(key)
+    assert store.get(BridgeJob.KIND, "Bad_name").status.state == JobState.FAILED
+
+
+def test_sweep_conflict_falls_back_to_oracle(monkeypatch):
+    """A racing writer between the sweep's read and its commit conflicts;
+    the key must come back for the single-key retry, which converges."""
+    store, op, _, _ = ObjectStore(), None, None, None
+    op = BridgeOperator(store, agent_endpoint="test://agent", events=EventRecorder())
+    store.create(BridgeJob(meta=Meta(name="racy"), spec=_spec()))
+    store.create(_sizecar("racy", phase=PodPhase.RUNNING, infos=[_info(9001)]))
+
+    real_update_batch = store.update_batch
+    raced = {"done": False}
+
+    def racing_update_batch(objs):
+        if not raced["done"]:
+            raced["done"] = True
+            # interleaved writer: rewrites the CR (same content, new rv)
+            store.replace_update(
+                BridgeJob.KIND, "racy",
+                lambda j: fast_replace(j, meta=fast_replace(j.meta)),
+            )
+        return real_update_batch(objs)
+
+    monkeypatch.setattr(store, "update_batch", racing_update_batch)
+    slow = op.sweep(["racy"])
+    assert slow == ["racy"]
+    monkeypatch.undo()
+    op.reconcile("racy")
+    job = store.get(BridgeJob.KIND, "racy")
+    assert job.status.state == JobState.RUNNING
+    assert store.try_get(Pod.KIND, worker_name("racy")) is not None
+
+
+# ---- the steady-state satellite: 0 writes, 0 RPCs ----
+
+
+class CountingClient:
+    def __init__(self, inner):
+        self._inner = inner
+        self.calls: dict[str, int] = {}
+
+    def total(self) -> int:
+        return sum(self.calls.values())
+
+    def __getattr__(self, name):
+        fn = getattr(self._inner, name)
+        if not callable(fn):
+            return fn
+
+        def call(*a, **kw):
+            self.calls[name] = self.calls.get(name, 0) + 1
+            return fn(*a, **kw)
+
+        return call
+
+
+def test_no_change_sweep_is_free():
+    """Satellite gate: a no-change operator sweep performs 0 store writes
+    and 0 agent RPCs (counter-asserted against the sim fake)."""
+    clock_now = [0.0]
+    nodes = [SimNode(name=f"n{i}", cpus=16, memory_mb=32000) for i in range(4)]
+    cluster = SimCluster(
+        nodes, {"part0": tuple(n.name for n in nodes)}, clock=lambda: clock_now[0]
+    )
+    client = CountingClient(SimWorkloadClient(cluster))
+    store = ObjectStore()
+    op = BridgeOperator(store, agent_endpoint="sim://agent", events=EventRecorder())
+    provider = VirtualNodeProvider(
+        store, client, "part0", events=EventRecorder(), sync_workers=1,
+        inventory_ttl=3600.0, status_interval=3600.0,
+    )
+    names = [f"st-{i}" for i in range(6)]
+    for n in names:
+        store.create(BridgeJob(meta=Meta(name=n), spec=_spec()))
+    assert op.sweep(names) == []  # creates sizecars
+    # bind them to the virtual node and converge: submit + mirror + sweep
+    node = partition_node_name("part0")
+    for n in names:
+        store.replace_update(
+            Pod.KIND, sizecar_name(n),
+            lambda p: fast_replace(
+                p, meta=fast_replace(p.meta), spec=fast_replace(p.spec, node_name=node)
+            ),
+        )
+    provider.sync()  # submit
+    provider.sync()  # mirror RUNNING
+    for _ in range(3):
+        op.sweep(names)
+    jobs = [store.get(BridgeJob.KIND, n) for n in names]
+    assert all(j.status.state == JobState.RUNNING for j in jobs)
+    assert all(store.try_get(Pod.KIND, worker_name(n)) is not None for n in names)
+
+    # the steady state: nothing changed since the last sweep
+    rv_before = store.changes_since(Pod.KIND, 0)[0]
+    calls_before = client.total()
+    assert op.sweep(names) == []
+    assert store.changes_since(Pod.KIND, 0)[0] == rv_before  # 0 writes
+    assert client.total() == calls_before  # 0 agent RPCs
+
+
+def test_worker_container_rows_are_frozen_in_store():
+    """Regression (PR-4 review): ContainerStatus rows live inside
+    born-frozen PodStatus objects, so they must be born frozen too — an
+    unfrozen child inside a frozen parent would be silently mutable in
+    shared store snapshots."""
+    from slurm_bridge_tpu.bridge.freeze import FrozenInstanceError
+
+    store = ObjectStore()
+    op = BridgeOperator(store, events=EventRecorder())
+    store.create(BridgeJob(meta=Meta(name="frz"), spec=_spec()))
+    store.create(_sizecar("frz", phase=PodPhase.RUNNING, infos=[_info(7001)]))
+    assert op.sweep(["frz"]) == []
+    worker = store.get(Pod.KIND, worker_name("frz"))
+    assert worker.status.containers
+    with pytest.raises(FrozenInstanceError):
+        worker.status.containers[0].exit_code = 42
+    with pytest.raises(FrozenInstanceError):
+        worker.status.containers.append(None)
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_sweep_equivalence_holds_on_bulk_read_branch(seed, monkeypatch):
+    """The ≥threshold bulk-read branch (the one the 50k cold-start
+    actually runs) must satisfy the same equivalence contract as the
+    per-key branch — fuzzed with the threshold dropped to 1."""
+    from slurm_bridge_tpu.bridge import operator as op_mod
+
+    monkeypatch.setattr(op_mod, "_BULK_SWEEP_THRESHOLD", 1)
+    store_a, op_a, names_a, counts_a = _build_fixture(seed)
+    store_b, op_b, names_b, counts_b = _build_fixture(seed)
+    for key in op_a.sweep(names_a):
+        op_a.reconcile(key)
+    _drain(op_a)
+    for name in sorted(set(names_b)):
+        op_b.reconcile(name)
+    _drain(op_b)
+    assert _normalize(store_a) == _normalize(store_b)
+    assert counts_a == counts_b

@@ -11,7 +11,10 @@ from slurm_bridge_tpu.bridge.freeze import (
     FrozenDict,
     FrozenInstanceError,
     FrozenList,
+    fast_replace,
     freeze,
+    frozen_new,
+    frozen_replace,
     is_frozen,
     thaw,
 )
@@ -24,7 +27,12 @@ from slurm_bridge_tpu.bridge.objects import (
     PodSpec,
     PodStatus,
 )
-from slurm_bridge_tpu.bridge.store import Conflict, NotFound, ObjectStore
+from slurm_bridge_tpu.bridge.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+)
 from slurm_bridge_tpu.core.types import JobDemand, JobInfo, JobStatus
 
 
@@ -282,3 +290,83 @@ def test_owned_by_returns_name_sorted():
         "m-pod",
         "z-pod",
     ]
+
+
+# ---- create_batch (PR-4) ----
+
+
+def test_create_batch_commits_all_under_one_pass():
+    s = ObjectStore()
+    q = s.watch((Pod.KIND,))
+    pods = [_pod(f"cb{i}") for i in range(3)]
+    results = s.create_batch(pods)
+    assert [r.meta.name for r in results] == ["cb0", "cb1", "cb2"]
+    assert all(is_frozen(r) for r in results)
+    # rv strictly increasing per item, exactly like N creates
+    rvs = [r.meta.resource_version for r in results]
+    assert rvs == sorted(rvs) and len(set(rvs)) == 3
+    events = [q.get_nowait() for _ in range(3)]
+    assert [(e.type, e.name) for e in events] == [
+        ("ADDED", "cb0"), ("ADDED", "cb1"), ("ADDED", "cb2"),
+    ]
+
+
+def test_create_batch_per_item_already_exists():
+    s = ObjectStore()
+    s.create(_pod("dup"))
+    results = s.create_batch([_pod("new0"), _pod("dup"), _pod("new1")])
+    assert results[0].meta.name == "new0"
+    assert isinstance(results[1], AlreadyExists)
+    assert results[2].meta.name == "new1"
+    # the failed item aborted nothing
+    assert s.try_get(Pod.KIND, "new0") is not None
+    assert s.try_get(Pod.KIND, "new1") is not None
+
+
+def test_create_batch_maintains_node_index():
+    s = ObjectStore()
+    s.create_batch([_pod("ix0", node="vn-a"), _pod("ix1", node="vn-a")])
+    assert [p.name for p in s.list_by_node(Pod.KIND, "vn-a")] == ["ix0", "ix1"]
+
+
+# ---- fastpath constructors (PR-4) ----
+
+
+def test_fast_replace_shares_children_and_stays_writable():
+    s = ObjectStore()
+    stored = s.create(_pod("fr0"))
+    repl = fast_replace(
+        stored, meta=fast_replace(stored.meta), status=PodStatus(phase="Running")
+    )
+    assert repl.spec is stored.spec  # structural sharing
+    repl.meta.resource_version = stored.meta.resource_version  # writable copy
+    updated = s.update(repl)
+    assert updated.status.phase == "Running"
+    assert s.get(Pod.KIND, "fr0").spec is stored.spec
+
+
+def test_frozen_new_is_born_guarded():
+    row = frozen_new(
+        JobInfo,
+        id=1, user_id="", name="x", exit_code="", state=JobStatus.RUNNING,
+        submit_time=None, start_time=None, run_time_s=0, time_limit_s=0,
+        working_dir="", std_out="", std_err="", partition="", node_list="",
+        batch_host="", num_nodes=0, array_id="", reason="",
+    )
+    assert is_frozen(row)
+    with pytest.raises(FrozenInstanceError):
+        row.run_time_s = 99
+    # equality with a normally-constructed twin holds (field-based eq)
+    assert row == JobInfo(id=1, name="x", state=JobStatus.RUNNING)
+    # freeze() short-circuits: same object back, untouched
+    assert freeze(row) is row
+
+
+def test_frozen_replace_shares_and_rejects_mutation():
+    s = ObjectStore()
+    stored = s.create(_pod("fz0"))
+    status2 = frozen_replace(stored.status, phase="Running")
+    assert is_frozen(status2)
+    assert status2.job_infos is stored.status.job_infos
+    with pytest.raises(FrozenInstanceError):
+        status2.phase = "Failed"

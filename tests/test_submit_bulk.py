@@ -1,0 +1,352 @@
+"""PR-4 batched submit path: chunked SubmitJobs RPCs, per-item results,
+the remembered UNIMPLEMENTED fallback, and fault behavior parity with the
+per-pod submit path."""
+
+import grpc
+import pytest
+
+from slurm_bridge_tpu.bridge.objects import (
+    Meta,
+    Pod,
+    PodPhase,
+    PodRole,
+    PodSpec,
+    partition_node_name,
+)
+from slurm_bridge_tpu.bridge.store import ObjectStore
+from slurm_bridge_tpu.bridge import vnode as vnode_mod
+from slurm_bridge_tpu.bridge.vnode import VirtualNodeProvider
+from slurm_bridge_tpu.core.types import JobDemand
+from slurm_bridge_tpu.obs.events import EventRecorder
+from slurm_bridge_tpu.sim.agent import SimCluster, SimNode, SimWorkloadClient
+from slurm_bridge_tpu.sim.faults import Fault, FaultPlan, FaultyClient, SimRpcError
+from slurm_bridge_tpu.agent.cli import SlurmError
+from slurm_bridge_tpu.agent.server import WorkloadServicer
+from slurm_bridge_tpu.wire import pb
+from slurm_bridge_tpu.wire.convert import submit_to_demand
+
+
+class CountingClient:
+    def __init__(self, inner):
+        self._inner = inner
+        self.calls: dict[str, int] = {}
+
+    def total(self) -> int:
+        return sum(self.calls.values())
+
+    def __getattr__(self, name):
+        fn = getattr(self._inner, name)
+        if not callable(fn):
+            return fn
+
+        def call(*a, **kw):
+            self.calls[name] = self.calls.get(name, 0) + 1
+            return fn(*a, **kw)
+
+        return call
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def _cluster(clock) -> SimCluster:
+    nodes = [SimNode(name=f"n{i}", cpus=64, memory_mb=64000) for i in range(4)]
+    return SimCluster(nodes, {"part0": tuple(n.name for n in nodes)}, clock=clock)
+
+
+def _provider(store, client, **kw) -> VirtualNodeProvider:
+    kw.setdefault("sync_workers", 1)
+    kw.setdefault("inventory_ttl", 3600.0)
+    kw.setdefault("status_interval", 3600.0)
+    return VirtualNodeProvider(store, client, "part0", events=EventRecorder(), **kw)
+
+
+def _bound_pod(name: str) -> Pod:
+    return Pod(
+        meta=Meta(name=name),
+        spec=PodSpec(
+            role=PodRole.SIZECAR,
+            partition="part0",
+            node_name=partition_node_name("part0"),
+            demand=JobDemand(
+                partition="part0",
+                script="#!/bin/sh\ntrue\n",
+                cpus_per_task=1,
+                time_limit_s=1000,
+                job_name=name,
+            ),
+        ),
+    )
+
+
+def _setup(n_pods: int, client_wrap=CountingClient, faults: FaultPlan | None = None):
+    clock = _Clock()
+    cluster = _cluster(clock)
+    base = SimWorkloadClient(cluster)
+    if faults is not None:
+        base = FaultyClient(base, faults, seed=1)
+    client = client_wrap(base)
+    store = ObjectStore()
+    provider = _provider(store, client)
+    for i in range(n_pods):
+        store.create(_bound_pod(f"bp{i:03d}"))
+    return clock, cluster, client, store, provider
+
+
+def test_cold_start_uses_one_batched_submit():
+    clock, cluster, client, store, provider = _setup(5)
+    provider.sync()
+    assert client.calls.get("SubmitJobs", 0) == 1
+    assert client.calls.get("SubmitJob", 0) == 0
+    pods = store.list(Pod.KIND)
+    assert all(p.status.job_ids for p in pods)
+    assert all(p.status.phase == PodPhase.PENDING for p in pods)
+    assert all(p.meta.labels.get("jobid") for p in pods)
+    assert cluster.stats.submitted == 5
+    assert provider.submits_batched == 5
+    assert provider.submits_fallback == 0
+
+
+def test_submits_are_chunked(monkeypatch):
+    monkeypatch.setattr(vnode_mod, "_SUBMIT_CHUNK", 2)
+    clock, cluster, client, store, provider = _setup(5)
+    provider.sync()
+    assert client.calls.get("SubmitJobs", 0) == 3  # ceil(5/2)
+    assert cluster.stats.submitted == 5
+
+
+def test_resync_is_idempotent_via_ledger():
+    clock, cluster, client, store, provider = _setup(3)
+    provider.sync()
+    # wipe job_ids (simulates a bridge restart re-observing unsubmitted
+    # pods) — the agent-side ledger must dedupe the resubmission
+    for p in store.list(Pod.KIND):
+        def reset(q):
+            q.status.job_ids = ()
+            q.status.phase = PodPhase.PENDING
+        store.mutate(Pod.KIND, p.name, reset)
+    provider.sync()
+    assert cluster.stats.submitted == 3
+    assert cluster.stats.deduped == 3
+
+
+class NoBatchSubmitClient(CountingClient):
+    """An agent predating SubmitJobs: UNIMPLEMENTED, like a generic
+    handler table without the method."""
+
+    def __getattr__(self, name):
+        if name == "SubmitJobs":
+            def unimplemented(*a, **kw):
+                self.calls["SubmitJobs"] = self.calls.get("SubmitJobs", 0) + 1
+                raise SimRpcError(grpc.StatusCode.UNIMPLEMENTED, "no such method")
+
+            return unimplemented
+        return super().__getattr__(name)
+
+
+def test_unimplemented_falls_back_and_is_remembered():
+    clock, cluster, client, store, provider = _setup(
+        4, client_wrap=NoBatchSubmitClient
+    )
+    provider.sync()
+    assert provider._batch_submit_supported is False
+    assert client.calls.get("SubmitJobs", 0) == 1  # probed exactly once
+    assert client.calls.get("SubmitJob", 0) == 4  # per-pod fallback
+    assert all(p.status.job_ids for p in store.list(Pod.KIND))
+    assert provider.submits_fallback == 4
+    # new pods go straight to the per-pod path — no second probe
+    store.create(_bound_pod("late"))
+    provider.sync()
+    assert client.calls.get("SubmitJobs", 0) == 1
+
+
+def test_whole_rpc_transient_fault_keeps_chunk_pending():
+    plan = FaultPlan(
+        (Fault(kind="rpc_error", start_tick=0, end_tick=1,
+               methods=("SubmitJobs",), rate=1.0, code="UNAVAILABLE"),)
+    )
+    clock, cluster, client, store, provider = _setup(3, faults=plan)
+    client._inner.set_tick(0)
+    provider.sync()
+    assert cluster.stats.submitted == 0
+    assert all(not p.status.job_ids for p in store.list(Pod.KIND))
+    assert all(
+        p.status.phase == PodPhase.PENDING for p in store.list(Pod.KIND)
+    )
+    client._inner.set_tick(1)  # fault window over
+    provider.sync()
+    assert cluster.stats.submitted == 3
+    assert provider._batch_submit_supported is True
+
+
+def test_per_item_transient_faults_retry_without_duplicates():
+    """A unary-path fault plan (methods=("SubmitJob",)) must inject into
+    the batched form per item: victims stay Pending and retry next sync,
+    batch-mates land, and the ledger keeps the retries duplicate-free."""
+    plan = FaultPlan(
+        (Fault(kind="rpc_error", start_tick=0, end_tick=1,
+               methods=("SubmitJob",), rate=0.5, code="UNAVAILABLE"),)
+    )
+    clock, cluster, client, store, provider = _setup(20, faults=plan)
+    client._inner.set_tick(0)
+    provider.sync()
+    injected = client._inner.injected_errors.get("SubmitJob", 0)
+    assert 0 < injected < 20  # rate 0.5: some failed, some landed
+    submitted = [p for p in store.list(Pod.KIND) if p.status.job_ids]
+    assert len(submitted) == 20 - injected
+    client._inner.set_tick(1)
+    provider.sync()
+    assert all(p.status.job_ids for p in store.list(Pod.KIND))
+    assert cluster.stats.submitted == 20  # no duplicates
+
+def test_per_item_fatal_fault_fails_only_its_pod():
+    plan = FaultPlan(
+        (Fault(kind="rpc_error", start_tick=0, end_tick=1,
+               methods=("SubmitJob",), rate=1.0, code="INVALID_ARGUMENT"),)
+    )
+    clock, cluster, client, store, provider = _setup(3, faults=plan)
+    client._inner.set_tick(0)
+    provider.sync()
+    pods = store.list(Pod.KIND)
+    assert all(p.status.phase == PodPhase.FAILED for p in pods)
+    assert all("submit failed" in p.status.reason for p in pods)
+    assert cluster.stats.submitted == 0
+
+
+# ---- the agent servicer's SubmitJobs (wire-level semantics) ----
+
+
+class FakeDriver:
+    def __init__(self):
+        self.next_id = 100
+        self.submitted: list = []
+
+    def submit(self, demand) -> int:
+        if "bad" in demand.script:
+            raise SlurmError(["sbatch"], 1, "rejected script")
+        self.next_id += 1
+        self.submitted.append(demand)
+        return self.next_id
+
+
+def test_agent_submitjobs_per_item_results():
+    servicer = WorkloadServicer(FakeDriver())
+    req = pb.SubmitJobsRequest(
+        requests=[
+            pb.SubmitJobRequest(script="#!/bin/sh\ntrue\n", partition="p",
+                                submitter_id="u1"),
+            pb.SubmitJobRequest(script="bad\n", partition="p",
+                                submitter_id="u2"),
+            pb.SubmitJobRequest(script="#!/bin/sh\ntrue\n", partition="p",
+                                submitter_id="u3"),
+        ]
+    )
+    resp = servicer.SubmitJobs(req, None)
+    assert len(resp.results) == 3
+    ok1, bad, ok2 = resp.results
+    assert ok1.ok and ok1.job_id == 101
+    assert not bad.ok and bad.error_code == "INTERNAL"
+    assert "rejected script" in bad.error
+    assert ok2.ok and ok2.job_id == 102
+    # ledger dedupe: a retried batch returns the SAME ids without resubmit
+    resp2 = servicer.SubmitJobs(req, None)
+    assert [e.job_id for e in resp2.results if e.ok] == [101, 102]
+    assert len(servicer.SubmitJobs(req, None).results) == 3
+
+
+def test_agent_submitjobs_matches_unary_semantics():
+    """One request through the batch == the same request through SubmitJob
+    (shared dedupe ledger)."""
+    servicer = WorkloadServicer(FakeDriver())
+    unary = pb.SubmitJobRequest(
+        script="#!/bin/sh\ntrue\n", partition="p", submitter_id="same"
+    )
+    resp = servicer.SubmitJob(unary, None)
+    batch = servicer.SubmitJobs(pb.SubmitJobsRequest(requests=[unary]), None)
+    assert batch.results[0].ok
+    assert batch.results[0].job_id == resp.job_id
+
+
+def test_sim_fake_submitjobs_answers_from_ground_truth():
+    clock = _Clock()
+    cluster = _cluster(clock)
+    client = SimWorkloadClient(cluster)
+    req = pb.SubmitJobsRequest(
+        requests=[
+            pb.SubmitJobRequest(script="x", partition="part0",
+                                cpus_per_task=1, time_limit_s=60,
+                                submitter_id=f"s{i}")
+            for i in range(3)
+        ]
+    )
+    resp = client.SubmitJobs(req)
+    assert [e.ok for e in resp.results] == [True] * 3
+    ids = [e.job_id for e in resp.results]
+    assert len(set(ids)) == 3
+    assert all(jid in cluster.jobs for jid in ids)
+
+
+class ExplodingDriver(FakeDriver):
+    def submit(self, demand) -> int:
+        if "boom" in demand.script:
+            raise ValueError("not a SlurmError")
+        return super().submit(demand)
+
+
+def test_agent_submitjobs_isolates_non_slurm_errors():
+    """Regression (PR-4 review): ANY per-item exception — not just
+    SlurmError — must fail its own entry, never the whole batch."""
+    servicer = WorkloadServicer(ExplodingDriver())
+    resp = servicer.SubmitJobs(
+        pb.SubmitJobsRequest(
+            requests=[
+                pb.SubmitJobRequest(script="ok\n", partition="p"),
+                pb.SubmitJobRequest(script="boom\n", partition="p"),
+                pb.SubmitJobRequest(script="ok\n", partition="p"),
+            ]
+        ),
+        None,
+    )
+    assert [e.ok for e in resp.results] == [True, False, True]
+    assert resp.results[1].error_code == "INTERNAL"
+    assert "ValueError" in resp.results[1].error
+
+
+def test_fill_info_proto_matches_unary_conversion():
+    """The batched JobsInfo fan-out writes protos in place
+    (SimJob.fill_info_proto); it must stay field-for-field identical to
+    the unary path's job_info_to_proto(info()) — this is the drift guard
+    that docstring points at."""
+    from slurm_bridge_tpu.core.types import JobStatus as JS
+    from slurm_bridge_tpu.sim.agent import SimJob
+    from slurm_bridge_tpu.wire.convert import job_info_to_proto
+
+    jobs = [
+        SimJob(id=1001, name="a", submitter_id="s1", partition="p0",
+               num_nodes=2, cpus_per_node=4, mem_per_node_mb=100,
+               gpus_per_node=0, duration_s=60.0, priority=3,
+               state=JS.RUNNING, start_vt=5.0, end_vt=65.0,
+               assigned=("n1", "n2"), reason="r"),
+        SimJob(id=1002, name="b", submitter_id="s2", partition="p1",
+               num_nodes=1, cpus_per_node=1, mem_per_node_mb=10,
+               gpus_per_node=1, duration_s=10.0, priority=0,
+               state=JS.PENDING, reason="Resources"),
+        SimJob(id=1003, name="c", submitter_id="s3", partition="p0",
+               num_nodes=1, cpus_per_node=1, mem_per_node_mb=10,
+               gpus_per_node=0, duration_s=10.0, priority=0,
+               state=JS.COMPLETED, start_vt=0.0, end_vt=10.0,
+               assigned=("n3",)),
+    ]
+    for now in (None, 0.0, 7.5, 1000.0):
+        for job in jobs:
+            filled = pb.JobInfo()
+            job.fill_info_proto(filled, now=now)
+            expected = job_info_to_proto(job.info(now=now))
+            assert filled.SerializeToString(
+                deterministic=True
+            ) == expected.SerializeToString(deterministic=True), (job.id, now)

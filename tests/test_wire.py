@@ -35,20 +35,22 @@ from slurm_bridge_tpu.wire.rpc import normalize_endpoint
 
 
 def test_contract_covers_reference_rpcs():
-    """All 12 reference RPCs (workload.proto:23-62) plus JobState and the
-    PR-3 batched JobsInfo exist."""
+    """All 12 reference RPCs (workload.proto:23-62) plus JobState, the
+    PR-3 batched JobsInfo, and the PR-4 batched SubmitJobs exist."""
     _, specs = service_methods("WorkloadManager")
     names = {s.name for s in specs}
     assert names == {
-        "SubmitJob", "SubmitJobContainer", "CancelJob", "JobInfo", "JobsInfo",
-        "JobSteps", "JobState", "OpenFile", "TailFile", "Resources",
-        "Partitions", "Partition", "Nodes", "WorkloadInfo",
+        "SubmitJob", "SubmitJobs", "SubmitJobContainer", "CancelJob",
+        "JobInfo", "JobsInfo", "JobSteps", "JobState", "OpenFile",
+        "TailFile", "Resources", "Partitions", "Partition", "Nodes",
+        "WorkloadInfo",
     }
     kinds = {s.name: s.kind for s in specs}
     assert kinds["OpenFile"] == "unary_stream"  # server-stream
     assert kinds["TailFile"] == "stream_stream"  # bidi
     assert kinds["SubmitJob"] == "unary_unary"
     assert kinds["JobsInfo"] == "unary_unary"
+    assert kinds["SubmitJobs"] == "unary_unary"
 
 
 def test_solver_service_exists():
