@@ -17,9 +17,20 @@ its own: a generous dirty-sweep wall budget, and a HARD zero on
 ``steady_writes`` — a no-change sweep writing to the store is a
 structural bug (self-feeding watch loop), not jitter, at any speed.
 
+The tick flight recorder rides with two gates of its own (PR-5): a sim
+scenario run tracing-off and tracing-on must (a) produce byte-identical
+determinism sections — span wiring can never change WHAT the bridge
+does — and (b) keep the tracing-on tick p50 within the overhead budget
+(±3%, plus a small absolute epsilon — the genuine span-machinery floor
+is ~0.3-0.7 ms per tick regardless of scale, which is 5%+ of a ~10 ms
+toy tick but 0.03% of the 5.2 s headline tick where the percentage
+budget is the binding constraint).
+
     SBT_SMOKE_ENCODE_BUDGET_MS     warm encode p50 ceiling    (default 50)
     SBT_SMOKE_MIN_SPEEDUP          encode speedup floor       (default 3)
     SBT_SMOKE_RECONCILE_BUDGET_MS  dirty-sweep ceiling, 500 jobs (default 1000)
+    SBT_SMOKE_TRACE_OVERHEAD_PCT   tracing-on p50 overhead ceiling (default 3)
+    SBT_SMOKE_TRACE_EPS_MS         absolute overhead epsilon  (default 1.5)
 """
 
 from __future__ import annotations
@@ -27,6 +38,79 @@ from __future__ import annotations
 import json
 import os
 import sys
+
+
+def profile_trace_overhead(scale: float = 0.12, rounds: int = 3) -> dict:
+    """Measure tracing-on vs tracing-off tick cost, same seed.
+
+    The workload is deterministic, so tick *i* does identical work in
+    both arms. The estimator: run each arm ``rounds`` times interleaved
+    (off, on, off, on, …), take the PER-TICK MINIMUM across rounds in
+    each arm (noisy-neighbor steal only ever ADDS time, so the min is
+    the clean sample), then the median of the paired per-tick deltas.
+    On a shared CI box absolute p50s swing ±25% with neighbor load; this
+    estimator holds the genuine tracing cost (~0.2-0.5 ms of span
+    machinery per tick, scale-independent) to within a few hundred µs. A
+    discarded warmup run absorbs import/JIT costs first. The digests of
+    the two arms must be byte-identical: span wiring observes the tick,
+    it must never change it.
+    """
+    import dataclasses
+
+    from slurm_bridge_tpu.sim.harness import SimHarness
+    from slurm_bridge_tpu.sim.scenarios import SCENARIOS
+
+    base = SCENARIOS["steady_poisson"](scale=scale)
+    sc_off = dataclasses.replace(base, tracing=False)
+    sc_on = dataclasses.replace(base, tracing=True)
+
+    def run(sc):
+        h = SimHarness(sc)
+        result = h.run()
+        return result, [p["tick"] for p in h._tick_phases]
+
+    run(sc_off)  # warmup, discarded
+    off_runs: list[list[float]] = []
+    on_runs: list[list[float]] = []
+    digest_off = digest_on = ""
+    commits = phase_sum = None
+    for _ in range(rounds):
+        off, o_ticks = run(sc_off)
+        digest_off = off.determinism["digest"]
+        on, n_ticks = run(sc_on)
+        digest_on = on.determinism["digest"]
+        commits = on.flight_record.get("commits_total")
+        phase_sum = on.flight_record.get("phase_sum_p50_ms")
+        off_runs.append(o_ticks)
+        on_runs.append(n_ticks)
+
+    n_ticks_common = min(min(map(len, off_runs)), min(map(len, on_runs)))
+    off_min = [
+        min(r[i] for r in off_runs) for i in range(n_ticks_common)
+    ]
+    on_min = [min(r[i] for r in on_runs) for i in range(n_ticks_common)]
+
+    def p50(xs):
+        xs = sorted(xs)
+        n = len(xs)
+        return xs[n // 2] if n % 2 else (xs[n // 2 - 1] + xs[n // 2]) / 2
+
+    off_p50 = p50(off_min)
+    overhead_ms = p50([n - o for n, o in zip(on_min, off_min)])
+    return {
+        "tick_p50_off_ms": round(off_p50, 3),
+        "ticks_paired": n_ticks_common,
+        "rounds": rounds,
+        "overhead_ms": round(overhead_ms, 3),
+        "overhead_pct": round(
+            overhead_ms / off_p50 * 100.0 if off_p50 else 0.0, 2
+        ),
+        "digest_off": digest_off,
+        "digest_on": digest_on,
+        "digest_identical": digest_off == digest_on,
+        "flight_phase_sum_p50_ms": phase_sum,
+        "flight_commits_total": commits,
+    }
 
 
 def main() -> int:
@@ -38,17 +122,27 @@ def main() -> int:
     rec_budget_ms = float(
         os.environ.get("SBT_SMOKE_RECONCILE_BUDGET_MS", "1000")
     )
+    trace_pct = float(os.environ.get("SBT_SMOKE_TRACE_OVERHEAD_PCT", "3"))
+    trace_eps_ms = float(os.environ.get("SBT_SMOKE_TRACE_EPS_MS", "1.5"))
     out = profile_tick(1_000, 5_000, seed=2)
     rec = profile_reconcile(500)
+    trace = profile_trace_overhead()
     out["reconcile"] = rec
+    out["tracing"] = trace
     out["encode_budget_ms"] = budget_ms
     out["min_speedup"] = min_speedup
     out["reconcile_budget_ms"] = rec_budget_ms
+    out["trace_overhead_budget_pct"] = trace_pct
+    trace_ok = trace["digest_identical"] and (
+        trace["overhead_ms"] <= trace_eps_ms
+        or trace["overhead_pct"] <= trace_pct
+    )
     ok = (
         out["encode_ms"] <= budget_ms
         and out["encode_speedup_vs_loop"] >= min_speedup
         and rec["dirty_sweep_ms"] <= rec_budget_ms
         and rec["steady_writes"] == 0
+        and trace_ok
     )
     out["ok"] = ok
     print(json.dumps(out))
@@ -58,7 +152,10 @@ def main() -> int:
             f"(budget {budget_ms}) / speedup {out['encode_speedup_vs_loop']}x "
             f"(floor {min_speedup}x) / dirty sweep {rec['dirty_sweep_ms']} ms "
             f"(budget {rec_budget_ms}) / steady sweep writes "
-            f"{rec['steady_writes']} (must be 0)",
+            f"{rec['steady_writes']} (must be 0) / tracing overhead "
+            f"{trace['overhead_pct']}% (budget {trace_pct}%, eps "
+            f"{trace_eps_ms} ms) / digest identical "
+            f"{trace['digest_identical']} (must be true)",
             file=sys.stderr,
         )
     return 0 if ok else 1

@@ -16,6 +16,7 @@ from slurm_bridge_tpu.bridge.controller import Ticker
 from slurm_bridge_tpu.bridge.store import ObjectStore
 from slurm_bridge_tpu.bridge.vnode import VirtualNodeProvider
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
+from slurm_bridge_tpu.obs.tracing import TRACER, with_current_span
 from slurm_bridge_tpu.wire import ServiceClient, pb
 
 log = logging.getLogger("sbt.configurator")
@@ -73,11 +74,20 @@ class Configurator:
 
     def reconcile(self) -> None:
         """Diff live partitions vs registered providers (:120-184)."""
-        live = set(self.client.Partitions(pb.PartitionsRequest()).partitions)
-        for partition in sorted(live - self.providers.keys()):
-            self._add_partition(partition)
-        for partition in sorted(self.providers.keys() - live):
-            self._remove_partition(partition)
+        with TRACER.span("configurator.reconcile") as span:
+            live = set(self.client.Partitions(pb.PartitionsRequest()).partitions)
+            added = removed = 0
+            for partition in sorted(live - self.providers.keys()):
+                self._add_partition(partition)
+                added += 1
+            for partition in sorted(self.providers.keys() - live):
+                self._remove_partition(partition)
+                removed += 1
+            span.count("partitions", len(live))
+            if added:
+                span.count("added", added)
+            if removed:
+                span.count("removed", removed)
 
     def sync_now(self) -> None:
         """Force one synchronous provider sync (tests/converge helpers).
@@ -88,21 +98,30 @@ class Configurator:
         ``pod_sync_workers == 1`` (the simulator's deterministic mode)
         the syncs stay serial in sorted-partition order.
         """
-        providers = [self.providers[p] for p in sorted(self.providers)]
-        if len(providers) <= 1 or self.pod_sync_workers == 1:
-            for p in providers:
-                p.sync()
-            return
-        from concurrent.futures import ThreadPoolExecutor
+        with TRACER.span("configurator.sync_now") as span:
+            providers = [self.providers[p] for p in sorted(self.providers)]
+            span.count("providers", len(providers))
+            if len(providers) <= 1 or self.pod_sync_workers == 1:
+                for p in providers:
+                    p.sync()
+                return
+            from concurrent.futures import ThreadPoolExecutor
 
-        # transient pool: sync_now is the forced-converge path, not the
-        # 250 ms ticker (each partition's ticker already runs in its own
-        # thread in steady state) — churn here is irrelevant
-        with ThreadPoolExecutor(
-            max_workers=min(8, len(providers)),
-            thread_name_prefix="partition-sync",
-        ) as pool:
-            list(pool.map(lambda p: p.sync(), providers))
+            def sync_one(p, _parent=span):
+                # pool workers start with an empty contextvar: seed the
+                # sync_now span as parent so each provider's vnode.sync
+                # span lands inside the tick trace
+                with with_current_span(_parent):
+                    p.sync()
+
+            # transient pool: sync_now is the forced-converge path, not
+            # the 250 ms ticker (each partition's ticker already runs in
+            # its own thread in steady state) — churn here is irrelevant
+            with ThreadPoolExecutor(
+                max_workers=min(8, len(providers)),
+                thread_name_prefix="partition-sync",
+            ) as pool:
+                list(pool.map(sync_one, providers))
 
     def _add_partition(self, partition: str) -> None:
         kwargs = {}

@@ -56,6 +56,7 @@ from slurm_bridge_tpu.core.sbatch import extract_batch_resources
 from slurm_bridge_tpu.core.types import JobDemand
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
 from slurm_bridge_tpu.obs.metrics import REGISTRY
+from slurm_bridge_tpu.obs.tracing import TRACER, current_span
 
 log = logging.getLogger("sbt.operator")
 
@@ -230,6 +231,17 @@ class BridgeOperator:
 
     def reconcile(self, job_name: str) -> Result | None:
         t0 = time.perf_counter()
+        # join an active SAMPLED trace only: a controller-thread reconcile
+        # with no ambient span (production steady state) — or one inside a
+        # trace the sampler discarded — pays one contextvar read, not a
+        # span build per reconcile
+        ambient = current_span()
+        if ambient is not None and ambient.sampled:
+            with TRACER.span("operator.reconcile", job=job_name):
+                try:
+                    return self._reconcile(job_name)
+                finally:
+                    _reconcile_seconds.observe(time.perf_counter() - t0)
         try:
             return self._reconcile(job_name)
         finally:
@@ -277,6 +289,10 @@ class BridgeOperator:
         route those to :meth:`reconcile`, the single-key correctness
         oracle and the fallback for everything unusual.
         """
+        with TRACER.span("operator.sweep") as span:
+            return self._sweep(span, names)
+
+    def _sweep(self, span, names) -> list[str]:
         t0 = time.perf_counter()
         _sweeps.inc()
         slow: list[str] = []
@@ -346,7 +362,9 @@ class BridgeOperator:
                 if repl is not None:
                     worker_updates.append(repl)
         if creates:
-            results = self.store.create_batch([pod for pod, _ in creates])
+            results = self.store.create_batch(
+                [pod for pod, _ in creates], site="operator.sweep"
+            )
             for (pod, job), res in zip(creates, results):
                 # AlreadyExists loses the create race exactly like the
                 # single path: silently (and without the event)
@@ -357,7 +375,7 @@ class BridgeOperator:
                     )
         updates = [after for _, after in cr_updates] + worker_updates
         if updates:
-            results = self.store.update_batch(updates)
+            results = self.store.update_batch(updates, site="operator.sweep")
             for (before, _), res in zip(cr_updates, results):
                 if isinstance(res, Exception):
                     # racing writer: the oracle re-reads and retries
@@ -369,6 +387,10 @@ class BridgeOperator:
             for pod, res in zip(worker_updates, results[len(cr_updates):]):
                 if isinstance(res, Exception):
                     slow.append(pod.meta.owner)
+        span.count("owners", len(ordered))
+        span.count("creates", len(creates))
+        span.count("updates", len(updates))
+        span.count("slow", len(set(slow)))
         _reconcile_seconds.observe(time.perf_counter() - t0)
         return sorted(set(slow))
 
@@ -427,7 +449,7 @@ class BridgeOperator:
             return
         pod = self._build_sizecar(job)
         try:
-            self.store.create(pod)
+            self.store.create(pod, site="operator.reconcile")
         except AlreadyExists:
             return
         self.events.event(job, Reason.POD_CREATED, f"sizecar pod {name} created")
@@ -503,7 +525,9 @@ class BridgeOperator:
         try:
             before = self.store.get(BridgeJob.KIND, job_name)
             after = self.store.replace_update(
-                BridgeJob.KIND, job_name, lambda j: self._cr_replacement(j, pod)
+                BridgeJob.KIND, job_name,
+                lambda j: self._cr_replacement(j, pod),
+                site="operator.status",
             )
         except NotFound:
             return
@@ -582,7 +606,10 @@ class BridgeOperator:
         existing = self.store.try_get(Pod.KIND, name)
         if existing is None:
             try:
-                self.store.create(self._build_worker(job, sizecar, containers))
+                self.store.create(
+                    self._build_worker(job, sizecar, containers),
+                    site="operator.worker",
+                )
             except AlreadyExists:
                 pass
             return
@@ -590,6 +617,7 @@ class BridgeOperator:
             self.store.replace_update(
                 Pod.KIND, name,
                 lambda p: self._worker_replacement(p, sizecar, containers),
+                site="operator.worker",
             )
         except NotFound:
             pass
@@ -628,7 +656,7 @@ class BridgeOperator:
                 state=FetchState.PENDING,
             )
             try:
-                self.store.create(fetch)
+                self.store.create(fetch, site="operator.fetch")
             except AlreadyExists:
                 pass
             self._set_fetch_state(job.meta.name, FetchState.PENDING)
@@ -659,7 +687,9 @@ class BridgeOperator:
             job.status.reason = reason
 
         try:
-            self.store.mutate(BridgeJob.KIND, job_name, record)
+            self.store.mutate(
+                BridgeJob.KIND, job_name, record, site="operator.state"
+            )
         except NotFound:
             pass
 
@@ -672,6 +702,8 @@ class BridgeOperator:
                 job.status.reason = reason
 
         try:
-            self.store.mutate(BridgeJob.KIND, job_name, record)
+            self.store.mutate(
+                BridgeJob.KIND, job_name, record, site="operator.state"
+            )
         except NotFound:
             pass

@@ -47,6 +47,7 @@ from slurm_bridge_tpu.core.types import JobDemand, NodeInfo, PartitionInfo
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
 from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.obs.metrics import Histogram
+from slurm_bridge_tpu.obs.tracing import TRACER
 from slurm_bridge_tpu.solver import AuctionConfig, greedy_place
 from slurm_bridge_tpu.solver.encoder import EncodedInventory, JobRowCache
 from slurm_bridge_tpu.solver.session import DeviceSolver
@@ -248,26 +249,42 @@ class PlacementScheduler:
         ]
 
     def tick(self) -> int:
-        """Solve one placement round; returns the number of pods bound."""
-        t_store = time.perf_counter()
+        """Solve one placement round; returns the number of pods bound.
+
+        One root span per tick with one child span per phase — the span
+        durations ARE ``last_phase_ms`` now (the ad-hoc dict is derived
+        from them), and each phase span carries its counts (pods scanned,
+        rows encoded, commits written) so the flight recorder attributes
+        the tick without a second timing system.
+        """
+        with TRACER.span("scheduler.tick") as tick_span:
+            placed = self._tick(tick_span)
+            tick_span.count("placed", placed)
+            return placed
+
+    def _tick(self, tick_span) -> int:
         self.last_phase_ms = {"store": 0.0, "encode": 0.0, "solve": 0.0, "bind": 0.0}
-        self._retry_pending_cancels()
-        pods = self.pending_pods()
+        with TRACER.span("scheduler.store") as store_span:
+            self._retry_pending_cancels()
+            pods = self.pending_pods()
+            store_span.count("pods_pending", len(pods))
+            if pods:
+                # every engine honours incumbent pinning since round 5
+                # (the oracle and indexed packer reserve-first, the
+                # auction by candidate substitution), so preemption is
+                # engine-independent
+                incumbents = self.incumbent_pods() if self.preemption else []
+                store_span.count("incumbents", len(incumbents))
+                t0 = time.perf_counter()
+                partitions, nodes = self.cluster_state()
+                store_span.count("nodes", len(nodes))
+        store_s = store_span.duration
+        self.last_phase_ms["store"] = store_s * 1e3
         if not pods:
             # nothing pending ⇒ nothing can displace anyone; keep the idle
             # tick free (no inventory RPCs, no solve)
             _pods_unplaced.set(0)
-            self.last_phase_ms["store"] = (time.perf_counter() - t_store) * 1e3
             return 0
-        # every engine honours incumbent pinning since round 5 (the oracle
-        # and indexed packer reserve-first, the auction by candidate
-        # substitution), so preemption is engine-independent
-        use_preemption = self.preemption
-        incumbents = self.incumbent_pods() if use_preemption else []
-        t0 = time.perf_counter()
-        partitions, nodes = self.cluster_state()
-        store_s = time.perf_counter() - t_store
-        self.last_phase_ms["store"] = store_s * 1e3
         _store_seconds.observe(store_s)
         all_pods = pods + incumbents
         demands: list[JobDemand] = []
@@ -275,13 +292,13 @@ class PlacementScheduler:
             d = pod.spec.demand or JobDemand(partition=pod.spec.partition)
             demands.append(d)
         n_pending = len(pods)
-        t_solve = time.perf_counter()
         if self._remote is not None:
-            solved = self._solve_remote(
-                partitions, nodes, demands, all_pods, n_pending
-            )
             # the sidecar owns encode+solve; report the RPC as the solve
-            remote_solve_s = time.perf_counter() - t_solve
+            with TRACER.span("scheduler.solve", engine="remote") as solve_span:
+                solved = self._solve_remote(
+                    partitions, nodes, demands, all_pods, n_pending
+                )
+            remote_solve_s = solve_span.duration
             self.last_phase_ms["solve"] = remote_solve_s * 1e3
             _solve_seconds.observe(remote_solve_s)
             if solved is None:
@@ -296,39 +313,42 @@ class PlacementScheduler:
                 partitions, nodes, demands, all_pods, n_pending
             )
 
-        t_bind = time.perf_counter()
-        ready_nodes = {
-            vn.partition
-            for vn in self.store.list(VirtualNode.KIND)
-            if vn.ready and not vn.meta.deleted
-        }
-        binds: list[tuple[Pod, str, tuple[str, ...]]] = []
-        unschedulable: list[tuple[Pod, str]] = []
-        for j, pod in enumerate(pods):
-            names = by_job_names.get(j)
-            partition = demands[j].partition
-            if names and partition in ready_nodes:
-                binds.append((pod, partition_node_name(partition), tuple(names)))
-            else:
-                reason = (
-                    "Unschedulable: insufficient capacity"
-                    if partition in ready_nodes
-                    else f"Unschedulable: no ready virtual node for partition {partition!r}"
-                )
-                unschedulable.append((pod, reason))
-        self._mark_unschedulable_batch(unschedulable)
-        placed = self._bind_batch(binds)
-        preempted = 0
-        for j in lost_jobs:
-            if self._preempt(all_pods[j]):
-                preempted += 1
+        with TRACER.span("scheduler.bind") as bind_span:
+            ready_nodes = {
+                vn.partition
+                for vn in self.store.list(VirtualNode.KIND)
+                if vn.ready and not vn.meta.deleted
+            }
+            binds: list[tuple[Pod, str, tuple[str, ...]]] = []
+            unschedulable: list[tuple[Pod, str]] = []
+            for j, pod in enumerate(pods):
+                names = by_job_names.get(j)
+                partition = demands[j].partition
+                if names and partition in ready_nodes:
+                    binds.append((pod, partition_node_name(partition), tuple(names)))
+                else:
+                    reason = (
+                        "Unschedulable: insufficient capacity"
+                        if partition in ready_nodes
+                        else f"Unschedulable: no ready virtual node for partition {partition!r}"
+                    )
+                    unschedulable.append((pod, reason))
+            self._mark_unschedulable_batch(unschedulable)
+            placed = self._bind_batch(binds)
+            preempted = 0
+            for j in lost_jobs:
+                if self._preempt(all_pods[j]):
+                    preempted += 1
+            bind_span.count("binds", placed)
+            bind_span.count("unschedulable", len(unschedulable))
+            bind_span.count("preempted", preempted)
         if placed or preempted:
             # a state-changing tick invalidates the inventory reuse window:
             # the next tick must see the allocations it just caused. The
             # cache's win is the NO-progress retry loop — an unschedulable
             # backlog re-ticked 5×/s was re-execing the Slurm CLIs each time
             self._inv_cache = None
-        bind_s = time.perf_counter() - t_bind
+        bind_s = bind_span.duration
         self.last_phase_ms["bind"] = bind_s * 1e3
         _bind_seconds.observe(bind_s)
         _tick_seconds.observe(time.perf_counter() - t0)
@@ -345,15 +365,17 @@ class PlacementScheduler:
         Returns (job index → assigned node names, incumbent job indices
         that lost their nodes and must be preempted).
         """
-        t_enc = time.perf_counter()
-        snapshot = self._encoded.refresh(nodes, partitions)
-        batch = self._job_rows.encode(
-            [(p.meta.uid, p.meta.resource_version) for p in all_pods],
-            demands,
-            snapshot,
-            codes_token=self._encoded.codes_token(),
-        )
-        enc_s = time.perf_counter() - t_enc
+        with TRACER.span("scheduler.encode") as enc_span:
+            snapshot = self._encoded.refresh(nodes, partitions)
+            batch = self._job_rows.encode(
+                [(p.meta.uid, p.meta.resource_version) for p in all_pods],
+                demands,
+                snapshot,
+                codes_token=self._encoded.codes_token(),
+            )
+            enc_span.count("rows", int(batch.num_shards))
+            enc_span.count("jobs", len(all_pods))
+        enc_s = enc_span.duration
         self.last_phase_ms["encode"] = enc_s * 1e3
         _encode_seconds.observe(enc_s)
 
@@ -394,9 +416,11 @@ class PlacementScheduler:
             # running work (admission sorts pending rows first otherwise)
             batch.priority[batch.job_of >= n_pending] += 0.5
 
-        t_solve = time.perf_counter()
-        placement = self._solve(snapshot, batch, incumbent_arr)
-        solve_s = time.perf_counter() - t_solve
+        with TRACER.span("scheduler.solve") as solve_span:
+            placement = self._solve(snapshot, batch, incumbent_arr)
+            solve_span.set_tag("engine", self.last_route)
+            solve_span.count("shards", int(batch.num_shards))
+        solve_s = solve_span.duration
         self.last_phase_ms["solve"] = solve_s * 1e3
         _solve_seconds.observe(solve_s)
         by_job = placement.by_job(batch)
@@ -600,7 +624,7 @@ class PlacementScheduler:
             p.status.reason = "Preempted: displaced by higher-priority work"
 
         try:
-            self.store.mutate(Pod.KIND, pod.name, record)
+            self.store.mutate(Pod.KIND, pod.name, record, site="scheduler.preempt")
         except NotFound:
             return False
         if not job_ids:
@@ -650,7 +674,7 @@ class PlacementScheduler:
             )
 
         try:
-            self.store.mutate(Pod.KIND, pod_name, record)
+            self.store.mutate(Pod.KIND, pod_name, record, site="scheduler.cancel")
         except NotFound:
             self._orphan_cancels.update(job_ids)
 
@@ -707,7 +731,7 @@ class PlacementScheduler:
                     p.meta.annotations.pop(PENDING_CANCEL_ANNOTATION, None)
 
             try:
-                self.store.mutate(Pod.KIND, pod.name, record)
+                self.store.mutate(Pod.KIND, pod.name, record, site="scheduler.cancel")
             except NotFound:
                 self._orphan_cancels.update(still)
 
@@ -738,7 +762,7 @@ class PlacementScheduler:
             )
             for pod, node_name, hint in binds
         ]
-        results = self.store.update_batch(updated)
+        results = self.store.update_batch(updated, site="scheduler.bind")
         placed = 0
         for (pod, node_name, hint), res in zip(binds, results):
             if isinstance(res, Exception):
@@ -766,7 +790,7 @@ class PlacementScheduler:
                 p.status.reason = ""
                 bound[0] = True
 
-            self.store.mutate(Pod.KIND, pod.name, record)
+            self.store.mutate(Pod.KIND, pod.name, record, site="scheduler.bind")
         except NotFound:
             return False
         if not bound[0]:
@@ -797,7 +821,8 @@ class PlacementScheduler:
                         status=frozen_replace(pod.status, reason=reason),
                     )
                     for pod, reason in changed
-                ]
+                ],
+                site="scheduler.unschedulable",
             )
             for (pod, reason), res in zip(changed, results):
                 if isinstance(res, NotFound):
@@ -825,7 +850,9 @@ class PlacementScheduler:
                     status=frozen_replace(p.status, reason=reason),
                 )
 
-            self.store.replace_update(Pod.KIND, pod.name, build)
+            self.store.replace_update(
+                Pod.KIND, pod.name, build, site="scheduler.unschedulable"
+            )
         except NotFound:
             return
         self.events.event(pod, Reason.PLACEMENT_FAILED, reason, warning=True)

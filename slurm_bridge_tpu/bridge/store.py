@@ -38,6 +38,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import weakref
 from typing import NamedTuple
 
 from slurm_bridge_tpu.bridge.freeze import (
@@ -46,6 +47,7 @@ from slurm_bridge_tpu.bridge.freeze import (
     thaw,
 )
 from slurm_bridge_tpu.obs.metrics import REGISTRY, Histogram
+from slurm_bridge_tpu.obs.tracing import current_span
 
 __all__ = [
     "AlreadyExists",
@@ -61,6 +63,59 @@ _list_seconds = REGISTRY.histogram(
     "store list/list_by_node wall time per call (copy-on-read path)",
     buckets=Histogram.FAST_BUCKETS,
 )
+
+
+class _CommitsCollector:
+    """``sbt_store_commits_total{kind,site}`` — a scrape-time collector.
+
+    The source of truth is each live store's ``commit_counts`` dict,
+    incremented inline under the store lock (a plain dict add — no metric
+    lock, no label-tuple sort on the 135k-commits-per-tick path); this
+    object only SUMS those dicts when /metrics renders. Counts of
+    garbage-collected stores are folded into ``_retired`` so the exposed
+    counter stays monotonic for the life of the process.
+    """
+
+    name = "sbt_store_commits_total"
+    help = "store create/update commits by object kind and callsite"
+
+    def __init__(self):
+        self._stores: weakref.WeakSet = weakref.WeakSet()
+        self._retired: dict[tuple[str, str], int] = {}
+        # RLock, not Lock: allocations inside totals() can trigger cyclic
+        # GC, which may run a dead store's finalize (_retire) SYNCHRONOUSLY
+        # on this same thread — with a plain lock that self-deadlocks the
+        # /metrics scrape
+        self._lock = threading.RLock()
+
+    def track(self, store: "ObjectStore") -> None:
+        with self._lock:
+            self._stores.add(store)
+        weakref.finalize(store, self._retire, store.commit_counts)
+
+    def _retire(self, counts: dict) -> None:
+        with self._lock:
+            for key, n in counts.items():
+                self._retired[key] = self._retired.get(key, 0) + n
+
+    def totals(self) -> dict[tuple[str, str], int]:
+        with self._lock:
+            stores = list(self._stores)
+            agg = dict(self._retired)
+        for store in stores:
+            for key, n in store.commit_counts_snapshot().items():
+                agg[key] = agg.get(key, 0) + n
+        return agg
+
+    def collect(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for (kind, site), n in sorted(self.totals().items()):
+            out.append(f'{self.name}{{kind="{kind}",site="{site}"}} {n}')
+        return out
+
+
+_COMMITS = _CommitsCollector()
+REGISTRY.register(_COMMITS)
 
 
 class NotFound(KeyError):
@@ -104,6 +159,14 @@ def _node_of(obj) -> str | None:
 class ObjectStore:
     def __init__(self):
         self._lock = threading.RLock()
+        #: ``(kind, site) -> commits`` — the per-kind × per-callsite
+        #: attribution ledger behind ``sbt_store_commits_total`` and the
+        #: flight recorder's commit breakdown. Incremented inline by the
+        #: commit paths (a dict add under the already-held store lock);
+        #: writers name their callsite via the ``site=`` kwarg, anything
+        #: that doesn't lands under "other".
+        self.commit_counts: dict[tuple[str, str], int] = {}
+        _COMMITS.track(self)
         #: kind -> name -> frozen stored object
         self._by_kind: dict[str, dict[str, object]] = {}
         #: kind -> node_name -> set of names bound there (Pods, mostly)
@@ -189,6 +252,26 @@ class ObjectStore:
         if tombs is not None:
             tombs.pop(name, None)
 
+    # ---- commit attribution ----
+
+    def commit_counts_snapshot(self) -> dict[tuple[str, str], int]:
+        """A copy of the commit ledger (small: one entry per kind × site)."""
+        with self._lock:
+            return dict(self.commit_counts)
+
+    def commits_total(self) -> int:
+        with self._lock:
+            return sum(self.commit_counts.values())
+
+    @staticmethod
+    def _span_commits(kind: str, site: str, n: int) -> None:
+        """Attribute ``n`` commits to the active sampled span, if any —
+        the per-phase spans end up carrying exactly the commits their
+        phase caused. One contextvar read when tracing is off."""
+        span = current_span()
+        if span is not None and span.sampled:
+            span.count(f"commits.{kind}.{site}", n)
+
     #: tombstones kept per kind; beyond this the oldest are compacted away
     #: so a long-running bridge's delete churn doesn't grow memory (and
     #: the changes_since scan) forever. A consumer further than this many
@@ -210,13 +293,15 @@ class ObjectStore:
 
     # ---- CRUD ----
 
-    def create(self, obj) -> object:
+    def create(self, obj, *, site: str = "other") -> object:
         """Insert ``obj``; the store takes ownership and freezes it in
         place. The returned object IS the stored (frozen) snapshot."""
         with self._lock:
-            return self._commit_create(obj)
+            stored = self._commit_create(obj, site)
+        self._span_commits(obj.KIND, site, 1)
+        return stored
 
-    def _commit_create(self, obj) -> object:
+    def _commit_create(self, obj, site: str = "other") -> object:
         """One insert; caller holds the lock."""
         kind, name = key = self._key(obj)
         objs = self._by_kind.setdefault(kind, {})
@@ -229,10 +314,12 @@ class ObjectStore:
         self._sorted_names[kind] = None
         self._index_add(kind, name, obj)
         self._record_change(kind, name)
+        ckey = (kind, site)
+        self.commit_counts[ckey] = self.commit_counts.get(ckey, 0) + 1
         self._notify("ADDED", kind, name)
         return obj
 
-    def create_batch(self, objs: list) -> list:
+    def create_batch(self, objs: list, *, site: str = "other") -> list:
         """Insert many objects under ONE lock acquisition (the operator
         sweep's sizecar/worker-pod creates — a cold-start tick used to pay
         45k separate lock round-trips here).
@@ -243,12 +330,22 @@ class ObjectStore:
         stands alone, exactly as if inserted via :meth:`create`.
         """
         out: list = []
+        span = current_span()
+        committed: dict[str, int] | None = (
+            {} if span is not None and span.sampled else None
+        )
         with self._lock:
             for obj in objs:
                 try:
-                    out.append(self._commit_create(obj))
+                    out.append(self._commit_create(obj, site))
                 except AlreadyExists as exc:
                     out.append(exc)
+                    continue
+                if committed is not None:
+                    committed[obj.KIND] = committed.get(obj.KIND, 0) + 1
+        if committed:
+            for kind, n in committed.items():
+                span.count(f"commits.{kind}.{site}", n)
         return out
 
     def get(self, kind: str, name: str) -> object:
@@ -278,15 +375,17 @@ class ObjectStore:
         (pass it back through :meth:`update`)."""
         return thaw(self.get(kind, name))
 
-    def update(self, obj) -> object:
+    def update(self, obj, *, site: str = "other") -> object:
         """Replace; raises Conflict if the caller's copy is stale.
 
         Takes ownership of ``obj`` (freezes it in place) — callers keep
         reading it but can no longer mutate it."""
         with self._lock:
-            return self._commit_update(obj)
+            stored = self._commit_update(obj, site)
+        self._span_commits(obj.KIND, site, 1)
+        return stored
 
-    def _commit_update(self, obj) -> object:
+    def _commit_update(self, obj, site: str = "other") -> object:
         """One optimistic write; caller holds the lock."""
         kind, name = self._key(obj)
         objs = self._by_kind.get(kind, {})
@@ -304,10 +403,12 @@ class ObjectStore:
         objs[name] = obj
         self._index_move(kind, name, current, obj)
         self._record_change(kind, name)
+        ckey = (kind, site)
+        self.commit_counts[ckey] = self.commit_counts.get(ckey, 0) + 1
         self._notify("MODIFIED", kind, name)
         return obj
 
-    def update_batch(self, objs: list) -> list:
+    def update_batch(self, objs: list, *, site: str = "other") -> list:
         """Apply many optimistic-concurrency writes under ONE lock
         acquisition (the scheduler's bind path).
 
@@ -317,12 +418,22 @@ class ObjectStore:
         object stands alone, exactly as if written via :meth:`update`.
         """
         out: list = []
+        span = current_span()
+        committed: dict[str, int] | None = (
+            {} if span is not None and span.sampled else None
+        )
         with self._lock:
             for obj in objs:
                 try:
-                    out.append(self._commit_update(obj))
+                    out.append(self._commit_update(obj, site))
                 except (Conflict, NotFound) as exc:
                     out.append(exc)
+                    continue
+                if committed is not None:
+                    committed[obj.KIND] = committed.get(obj.KIND, 0) + 1
+        if committed:
+            for kind, n in committed.items():
+                span.count(f"commits.{kind}.{site}", n)
         return out
 
     def delete(self, kind: str, name: str) -> None:
@@ -440,7 +551,8 @@ class ObjectStore:
 
     # ---- convenience used by reconcilers ----
 
-    def mutate(self, kind: str, name: str, fn, *, retries: int = 8):
+    def mutate(self, kind: str, name: str, fn, *, retries: int = 8,
+               site: str = "other"):
         """Read-modify-write with conflict retry; fn mutates a private
         thawed copy in place and may return False to skip the write."""
         for _ in range(retries):
@@ -449,12 +561,13 @@ class ObjectStore:
             if fn(obj) is False:
                 return snapshot
             try:
-                return self.update(obj)
+                return self.update(obj, site=site)
             except Conflict:
                 continue
         raise Conflict(f"{kind}/{name}: too many conflicts")
 
-    def replace_update(self, kind: str, name: str, build, *, retries: int = 8):
+    def replace_update(self, kind: str, name: str, build, *, retries: int = 8,
+                       site: str = "other"):
         """Optimistic write without the deep copy: ``build(snapshot)``
         returns a REPLACEMENT object (``dataclasses.replace``-style,
         structurally sharing the snapshot's frozen sub-objects) or None to
@@ -467,7 +580,7 @@ class ObjectStore:
             if obj is None:
                 return snapshot
             try:
-                return self.update(obj)
+                return self.update(obj, site=site)
             except Conflict:
                 continue
         raise Conflict(f"{kind}/{name}: too many conflicts")

@@ -47,6 +47,7 @@ from slurm_bridge_tpu.core.arrays import array_len
 from slurm_bridge_tpu.core.types import JobInfo, JobStatus, NodeInfo, PartitionInfo
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
 from slurm_bridge_tpu.obs.metrics import REGISTRY
+from slurm_bridge_tpu.obs.tracing import TRACER, with_current_span
 from slurm_bridge_tpu.wire import ServiceClient, pb
 from slurm_bridge_tpu.wire.convert import (
     demand_to_submit,
@@ -287,7 +288,7 @@ class VirtualNodeProvider:
                 agent_endpoint=self.agent_endpoint,
             )
             try:
-                node = self.store.create(node)
+                node = self.store.create(node, site="vnode.node")
             except AlreadyExists:
                 # create-on-404 must tolerate losing the race: sync() runs
                 # concurrently (ticker + sync_now callers) and two threads
@@ -315,7 +316,9 @@ class VirtualNodeProvider:
             node.heartbeat = time.time()
             node.conditions = [NodeCondition(type="Ready", status=True)]
 
-        return self.store.mutate(VirtualNode.KIND, self.node_name, refresh)
+        return self.store.mutate(
+            VirtualNode.KIND, self.node_name, refresh, site="vnode.node"
+        )
 
     def close(self) -> None:
         """Shut the pod-sync pool WITHOUT deleting the store node.
@@ -357,27 +360,30 @@ class VirtualNodeProvider:
         exactly its pods, terminal pods cost nothing, and an unchanged pod
         costs zero store writes and no per-pod RPC.
         """
-        t0 = time.perf_counter()
-        self.register()
-        work: list[Pod] = []  # needs per-pod converge (submit/terminate)
-        refresh: list[Pod] = []  # has live jobs: bulk status mirror
-        for p in self.store.list_by_node(Pod.KIND, self.node_name):
-            if p.meta.deleted:
-                work.append(p)
-            elif p.spec.role != PodRole.SIZECAR:
-                continue
-            elif not p.status.job_ids:
-                work.append(p)
-            elif p.status.phase not in PodPhase.TERMINAL:
-                refresh.append(p)
-            # terminal phase with job_ids: nothing left to learn — a dead
-            # pod must not cost one RPC per sync tick forever
-        self._converge(work)
-        t1 = time.perf_counter()
-        self._refresh_statuses(refresh)
-        t2 = time.perf_counter()
-        _status_seconds.observe(t2 - t1)
-        _sync_seconds.observe(t2 - t0)
+        with TRACER.span("vnode.sync", partition=self.partition) as span:
+            t0 = time.perf_counter()
+            self.register()
+            work: list[Pod] = []  # needs per-pod converge (submit/terminate)
+            refresh: list[Pod] = []  # has live jobs: bulk status mirror
+            for p in self.store.list_by_node(Pod.KIND, self.node_name):
+                if p.meta.deleted:
+                    work.append(p)
+                elif p.spec.role != PodRole.SIZECAR:
+                    continue
+                elif not p.status.job_ids:
+                    work.append(p)
+                elif p.status.phase not in PodPhase.TERMINAL:
+                    refresh.append(p)
+                # terminal phase with job_ids: nothing left to learn — a
+                # dead pod must not cost one RPC per sync tick forever
+            span.count("converge_pods", len(work))
+            span.count("refresh_pods", len(refresh))
+            self._converge(work)
+            t1 = time.perf_counter()
+            self._refresh_statuses(refresh)
+            t2 = time.perf_counter()
+            _status_seconds.observe(t2 - t1)
+            _sync_seconds.observe(t2 - t0)
 
     def _converge(self, pods: list[Pod]) -> None:
         """Converge pods needing a per-pod action, partitioned into the
@@ -423,6 +429,18 @@ class VirtualNodeProvider:
         """Run ``fn`` over ``items`` through the shared pod-sync pool —
         in parallel across ``sync_workers`` threads, since each item can
         block on an agent RPC (submit = one sbatch exec)."""
+        parent = TRACER.current()
+        if parent is not None and parent.sampled:
+            # explicit-parent propagation: pool workers run outside the
+            # submitting thread's contextvar, so seed it per item — spans
+            # a chunk opens (submit spans, rpc client spans) then parent
+            # into the sync span instead of starting orphan traces
+            inner = fn
+
+            def fn(item, _parent=parent, _inner=inner):
+                with with_current_span(_parent):
+                    return _inner(item)
+
         if len(items) <= 1 or self.sync_workers == 1:
             for item in items:
                 fn(item)
@@ -543,7 +561,9 @@ class VirtualNodeProvider:
             return
         job_id = int(resp.job_id)
         self.store.replace_update(
-            Pod.KIND, pod.name, lambda p: self._submitted_replacement(p, job_id)
+            Pod.KIND, pod.name,
+            lambda p: self._submitted_replacement(p, job_id),
+            site="vnode.submit",
         )
         with self._count_lock:
             self.submits_fallback += 1
@@ -566,6 +586,11 @@ class VirtualNodeProvider:
         fails its pod — and an agent answering UNIMPLEMENTED flips the
         provider to the per-pod pool path for good (remembered, like the
         JobsInfo fallback)."""
+        with TRACER.span("vnode.submit_chunk") as span:
+            span.count("pods", len(pods))
+            self._submit_chunk_traced(pods, span)
+
+    def _submit_chunk_traced(self, pods: list[Pod], span) -> None:
         items: list[Pod] = []
         reqs: list[pb.SubmitJobRequest] = []
         for pod in pods:
@@ -637,7 +662,8 @@ class VirtualNodeProvider:
                 [
                     self._submitted_replacement(pod, job_id)
                     for pod, job_id in accepted
-                ]
+                ],
+                site="vnode.submit",
             )
             for (pod, job_id), res in zip(accepted, results):
                 if isinstance(res, NotFound):
@@ -649,6 +675,7 @@ class VirtualNodeProvider:
                         self.store.replace_update(
                             Pod.KIND, pod.name,
                             lambda p, j=job_id: self._submitted_replacement(p, j),
+                            site="vnode.submit",
                         )
                     except NotFound:
                         continue
@@ -657,6 +684,7 @@ class VirtualNodeProvider:
                 )
             with self._count_lock:
                 self.submits_batched += len(accepted)
+            span.count("accepted", len(accepted))
         for pod, code_name in pending:
             self.events.event(
                 pod, Reason.POD_PENDING,
@@ -692,6 +720,11 @@ class VirtualNodeProvider:
         state did not change costs zero store writes."""
         if not pods:
             return
+        with TRACER.span("vnode.status") as span:
+            span.count("pods", len(pods))
+            self._refresh_statuses_traced(pods, span)
+
+    def _refresh_statuses_traced(self, pods: list[Pod], span) -> None:
         if not self._bulk_supported:
             # pre-PR-3 agent: per-pod queries, but still through the
             # sync_workers pool — the serial form would be a ~10× sync
@@ -735,6 +768,8 @@ class VirtualNodeProvider:
                 if not entry.found or not infos:
                     infos = [_unknown_info(jid)]
                 by_id[jid] = infos
+        span.count("jobs_queried", len(ids))
+        span.count("rows_decoded", sum(len(v) for v in by_id.values()))
         # diff against the snapshots we already hold, then commit every
         # changed pod under ONE store lock acquisition; a conflict (racing
         # writer) falls back to the per-pod optimistic retry
@@ -750,13 +785,15 @@ class VirtualNodeProvider:
             ):
                 continue  # zero store writes on the steady path
             changed.append((pod, queried, infos, phase))
+        span.count("writes", len(changed))
         if not changed:
             return
         results = self.store.update_batch(
             [
                 _status_replacement(pod, infos, phase)
                 for pod, _, infos, phase in changed
-            ]
+            ],
+            site="vnode.status",
         )
         for (pod, queried, infos, phase), res in zip(changed, results):
             if isinstance(res, Exception):
@@ -777,7 +814,9 @@ class VirtualNodeProvider:
             return _status_replacement(p, infos, phase)
 
         try:
-            self.store.replace_update(Pod.KIND, pod.name, build)
+            self.store.replace_update(
+                Pod.KIND, pod.name, build, site="vnode.status"
+            )
         except NotFound:
             pass
 
@@ -799,7 +838,7 @@ class VirtualNodeProvider:
             p.status.phase = PodPhase.FAILED
             p.status.reason = reason
 
-        self.store.mutate(Pod.KIND, pod.name, record)
+        self.store.mutate(Pod.KIND, pod.name, record, site="vnode.fail")
 
     # ---- logs ----
 

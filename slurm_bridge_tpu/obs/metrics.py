@@ -36,6 +36,12 @@ class Counter:
         with self._lock:
             return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def total(self) -> float:
+        """Sum over every label set — the flight recorder's counter-delta
+        reads, without enumerating label combinations."""
+        with self._lock:
+            return sum(self._values.values())
+
     def collect(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -147,6 +153,21 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.append(metric)
         return metric
+
+    def register(self, collector):
+        """Register a custom collector: any object with a ``collect() ->
+        list[str]`` of exposition lines (and optionally a ``name``). Used
+        by metrics whose source of truth lives elsewhere — the store's
+        commit counters are plain dicts incremented under the store lock,
+        and the collector reads them only at scrape time."""
+        return self._register(collector)
+
+    def counter_totals(self) -> dict[str, float]:
+        """``{name: summed value}`` for every Counter — the flight
+        recorder snapshots this per tick and reports the deltas."""
+        with self._lock:
+            metrics = list(self._metrics)
+        return {m.name: m.total() for m in metrics if isinstance(m, Counter)}
 
     def render(self) -> str:
         lines: list[str] = []

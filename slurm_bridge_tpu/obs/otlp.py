@@ -21,9 +21,23 @@ import urllib.error
 import urllib.request
 from collections import deque
 
+from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.obs.tracing import Span, register_exporter
 
 log = logging.getLogger("sbt.otlp")
+
+#: exporter health on /metrics — a dead collector is a warning log today
+#: and silence tomorrow; these make it a visible, alertable signal
+_exported_total = REGISTRY.counter(
+    "sbt_otlp_exported_spans_total", "spans delivered to the OTLP collector"
+)
+_dropped_total = REGISTRY.counter(
+    "sbt_otlp_dropped_spans_total",
+    "spans dropped (queue overflow or failed POST to the collector)",
+)
+_queue_depth = REGISTRY.gauge(
+    "sbt_otlp_queue_depth", "spans waiting in the OTLP export queue"
+)
 
 #: standard OTel env var, same spelling the collector ecosystem uses
 ENDPOINT_ENV = "OTEL_EXPORTER_OTLP_ENDPOINT"
@@ -124,7 +138,9 @@ class OtlpHttpExporter:
         with self._cv:
             if len(self._queue) == self._queue.maxlen:
                 self.dropped += 1
+                _dropped_total.inc()
             self._queue.append(span)
+            _queue_depth.set(len(self._queue))
             if len(self._queue) >= self.batch_size:
                 self._cv.notify()
 
@@ -144,6 +160,7 @@ class OtlpHttpExporter:
         with self._cv:
             batch = list(self._queue)
             self._queue.clear()
+            _queue_depth.set(0)
         return batch
 
     def _run(self) -> None:
@@ -167,8 +184,10 @@ class OtlpHttpExporter:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 resp.read()
             self.sent += len(batch)
+            _exported_total.inc(len(batch))
         except (urllib.error.URLError, OSError) as e:
             self.dropped += len(batch)
+            _dropped_total.inc(len(batch))
             log.warning(
                 "OTLP export of %d spans to %s failed: %s",
                 len(batch), self.url, e,

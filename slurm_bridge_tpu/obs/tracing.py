@@ -14,6 +14,7 @@ operations in spans.
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import json
 import logging
@@ -30,26 +31,50 @@ _current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
     "sbt_current_span", default=None
 )
 
+#: per-thread id generator, seeded ONCE from os.urandom — span/trace id
+#: generation used to be one urandom syscall per id, the same per-object
+#: cost PR-4 removed from ``new_uid`` (a 45k-bind tick with tracing on
+#: would have paid 90k+ syscalls just for ids)
+_id_local = threading.local()
+
 
 def _new_id(nbytes: int) -> str:
-    return os.urandom(nbytes).hex()
+    rng = getattr(_id_local, "rng", None)
+    if rng is None:
+        rng = _id_local.rng = random.Random(os.urandom(16))
+    return rng.getrandbits(nbytes * 8).to_bytes(nbytes, "big").hex()
 
 
-@dataclass
+@dataclass(slots=True)
 class Span:
+    """One span. ``slots=True`` and lazy tag/annotation dicts keep
+    construction cheap — the flight recorder opens spans inside the hot
+    tick phases, so per-span cost is tick overhead."""
+
     name: str
     trace_id: str
     span_id: str
     parent_id: str | None = None
     start: float = 0.0
     end: float = 0.0
-    tags: dict[str, str] = field(default_factory=dict)
-    annotations: list[tuple[float, str]] = field(default_factory=list)
+    tags: dict = field(default_factory=dict)
+    annotations: list = field(default_factory=list)
     status: str = "OK"
     sampled: bool = True
+    #: numeric payload (rows decoded, commits written, pods scanned) —
+    #: kept apart from the string ``tags`` so the flight recorder can
+    #: aggregate without parsing, and ``count()`` stays a dict add
+    counters: dict = field(default_factory=dict)
+    #: monotonic start/stop pair — ``start``/``end`` stay wall-clock for
+    #: OTLP export, but durations come from perf_counter so the flight
+    #: recorder's phase arithmetic matches the perf-timed tick headline
+    _mono0: float = 0.0
+    _mono1: float = 0.0
 
     @property
     def duration(self) -> float:
+        if self._mono0:
+            return (self._mono1 or time.perf_counter()) - self._mono0
         return (self.end or time.time()) - self.start
 
     def annotate(self, message: str) -> None:
@@ -57,6 +82,10 @@ class Span:
 
     def set_tag(self, key: str, value) -> None:
         self.tags[key] = str(value)
+
+    def count(self, key: str, n: float = 1.0) -> None:
+        """Accumulate a numeric attribute on this span."""
+        self.counters[key] = self.counters.get(key, 0.0) + n
 
     def to_dict(self) -> dict:
         return {
@@ -67,6 +96,7 @@ class Span:
             "start": self.start,
             "durationMs": round(self.duration * 1e3, 3),
             "tags": self.tags,
+            "counters": self.counters,
             "annotations": [
                 {"t": t, "msg": m} for t, m in self.annotations
             ],
@@ -176,6 +206,8 @@ def make_exporter(name: str, **kwargs):
 class _SpanContext:
     """Context manager produced by Tracer.span()."""
 
+    __slots__ = ("_tracer", "_span", "_token")
+
     def __init__(self, tracer: "Tracer", span: Span):
         self._tracer = tracer
         self._span = span
@@ -183,16 +215,94 @@ class _SpanContext:
 
     def __enter__(self) -> Span:
         self._span.start = time.time()
+        self._span._mono0 = time.perf_counter()
         self._token = _current_span.set(self._span)
         return self._span
 
     def __exit__(self, exc_type, exc, tb) -> None:
+        self._span._mono1 = time.perf_counter()
         self._span.end = time.time()
         if exc is not None:
             self._span.status = f"ERROR: {exc_type.__name__}: {exc}"
         _current_span.reset(self._token)
         self._tracer._finish(self._span)
         return None  # never swallow
+
+
+def current_span() -> Span | None:
+    """The ambient span of this thread/context (tracer-independent)."""
+    return _current_span.get()
+
+
+@contextlib.contextmanager
+def with_current_span(span: Span | None):
+    """Make ``span`` the ambient parent in THIS thread/context.
+
+    The explicit-parent half of cross-thread propagation: a pool worker
+    runs its items under the submitting thread's span so any spans the
+    item opens (via the contextvar) parent correctly. No span is created
+    and nothing is exported — this only seeds the contextvar.
+    """
+    token = _current_span.set(span)
+    try:
+        yield span
+    finally:
+        _current_span.reset(token)
+
+
+# --------------------------------------------------------------------------
+# W3C-style traceparent propagation — the process-boundary wire format.
+# --------------------------------------------------------------------------
+
+#: gRPC metadata key (lowercase per gRPC rules; same spelling the W3C
+#: Trace Context spec and every OTel SDK use)
+TRACEPARENT_KEY = "traceparent"
+
+
+def format_traceparent(span: Span) -> str:
+    """``00-<32 hex trace>-<16 hex span>-<flags>`` for one span."""
+    flags = "01" if span.sampled else "00"
+    return f"00-{span.trace_id.zfill(32)}-{span.span_id.zfill(16)}-{flags}"
+
+
+def current_traceparent() -> str | None:
+    """The active span's traceparent header value, or None outside a span."""
+    span = _current_span.get()
+    return format_traceparent(span) if span is not None else None
+
+
+def parse_traceparent(value: str) -> Span | None:
+    """A remote-parent stub Span from a traceparent header, or None.
+
+    The stub carries trace id / span id / sampled flag only — it is never
+    entered or exported; it exists so ``Tracer.span(parent=stub)`` parents
+    a server-side span into the caller's trace.
+    """
+    parts = value.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _, trace_id, span_id, flags = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16), int(flags, 16)
+    except ValueError:
+        return None
+    return Span(
+        name="remote-parent",
+        trace_id=trace_id,
+        span_id=span_id,
+        sampled=bool(int(flags, 16) & 1),
+    )
+
+
+def parent_from_metadata(metadata) -> Span | None:
+    """Extract the remote parent from gRPC invocation metadata (a
+    sequence of (key, value) pairs), or None when absent/malformed."""
+    for key, value in metadata or ():
+        if key == TRACEPARENT_KEY:
+            return parse_traceparent(value)
+    return None
 
 
 class Tracer:
@@ -215,6 +325,10 @@ class Tracer:
         self.service_tags = dict(tags or {})
         self._sampler = parse_sampler(sample)
         self._exporters: list = []
+        #: immutable snapshot for the _finish hot path: no lock, no
+        #: defensive copy per finished span (the flight recorder finishes
+        #: dozens of spans inside every tick phase)
+        self._exporters_snapshot: tuple = ()
         self._recent = deque(maxlen=256)  # tracez ring, sampled spans only
         self._lock = threading.Lock()
 
@@ -237,11 +351,36 @@ class Tracer:
     def add_exporter(self, exporter) -> "Tracer":
         with self._lock:
             self._exporters.append(exporter)
+            self._exporters_snapshot = tuple(self._exporters)
         return self
+
+    def remove_exporter(self, exporter) -> None:
+        with self._lock:
+            self._exporters = [e for e in self._exporters if e is not exporter]
+            self._exporters_snapshot = tuple(self._exporters)
 
     def clear_exporters(self) -> None:
         with self._lock:
             self._exporters.clear()
+            self._exporters_snapshot = ()
+
+    @contextlib.contextmanager
+    def recording(self, sink):
+        """Temporarily force sampling on and fan spans out to ``sink``
+        (an exporter) — the flight recorder's per-tick capture window.
+        Restores the previous sampler and removes the sink on exit."""
+        with self._lock:
+            prev_sampler = self._sampler
+            self._sampler = lambda: True
+            self._exporters.append(sink)
+            self._exporters_snapshot = tuple(self._exporters)
+        try:
+            yield sink
+        finally:
+            with self._lock:
+                self._sampler = prev_sampler
+                self._exporters = [e for e in self._exporters if e is not sink]
+                self._exporters_snapshot = tuple(self._exporters)
 
     # -- span creation ----------------------------------------------------
     def span(
@@ -253,12 +392,20 @@ class Tracer:
             trace_id, parent_id, sampled = parent.trace_id, parent.span_id, parent.sampled
         else:
             trace_id, parent_id, sampled = _new_id(16), None, self._sampler()
+        if self.service_tags:
+            merged = dict(self.service_tags)
+            for k, v in tags.items():
+                merged[k] = str(v)
+        elif tags:
+            merged = {k: str(v) for k, v in tags.items()}
+        else:
+            merged = {}
         span = Span(
             name=name,
             trace_id=trace_id,
             span_id=_new_id(8),
             parent_id=parent_id,
-            tags={**self.service_tags, **{k: str(v) for k, v in tags.items()}},
+            tags=merged,
             sampled=sampled,
         )
         return _SpanContext(self, span)
@@ -269,10 +416,10 @@ class Tracer:
     def _finish(self, span: Span) -> None:
         if not span.sampled:
             return
-        with self._lock:
-            self._recent.append(span)
-            exporters = list(self._exporters)
-        for e in exporters:
+        # deque.append is atomic under the GIL; the exporter snapshot is
+        # immutable — no lock on the per-span finish path
+        self._recent.append(span)
+        for e in self._exporters_snapshot:
             try:
                 e.export(span)
             except Exception:
@@ -304,7 +451,48 @@ class Tracer:
                 f"  {s.name:38s} trace={s.trace_id[:8]} {s.duration*1e3:8.2f}ms "
                 f"{s.status}"
             )
+        lines.extend(self._render_recent_ticks(recent))
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _render_recent_ticks(recent: list[Span], limit: int = 3) -> list[str]:
+        """The per-tick view: the newest root ``*.tick`` spans rendered as
+        indented trees (children by parent id, insertion order), with
+        durations and counters — a flight-record glance without pulling
+        the JSON artifact."""
+        roots = [
+            s for s in recent if s.parent_id is None and s.name.endswith(".tick")
+        ][-limit:]
+        if not roots:
+            return []
+        by_parent: dict[str, list[Span]] = {}
+        for s in recent:
+            if s.parent_id:
+                by_parent.setdefault((s.trace_id, s.parent_id), []).append(s)
+        lines = ["", "recent ticks:"]
+        for root in roots:
+            header = f"tick trace={root.trace_id[:8]}"
+            tick_no = root.tags.get("tick")
+            if tick_no is not None:
+                header += f" tick={tick_no}"
+            lines.append(header)
+            stack = [(root, 1)]
+            budget = 40  # a storm of rpc spans must not flood the page
+            while stack and budget:
+                span, depth = stack.pop()
+                budget -= 1
+                counters = " ".join(
+                    f"{k}={v:g}" for k, v in sorted(span.counters.items())
+                )
+                lines.append(
+                    f"{'  ' * depth}{span.name:{max(1, 40 - 2 * depth)}s} "
+                    f"{span.duration * 1e3:9.2f}ms"
+                    + (f"  {counters}" if counters else "")
+                )
+                children = by_parent.get((span.trace_id, span.span_id), [])
+                for child in reversed(children):
+                    stack.append((child, depth + 1))
+        return lines
 
 
 #: process-wide default tracer (never-sampled until configured, so unwired
@@ -337,7 +525,10 @@ def setup_tracing(
 
 # --------------------------------------------------------------------------
 # gRPC server interceptor — one span per RPC, the process-boundary hook the
-# reference gets from the virtual-kubelet library's span wrappers.
+# reference gets from the virtual-kubelet library's span wrappers. Incoming
+# ``traceparent`` metadata (injected by the ServiceClient) parents the RPC
+# span into the caller's trace, so an agent-side SubmitJobs span hangs off
+# the bridge's scheduler tick instead of starting a trace of its own.
 # --------------------------------------------------------------------------
 
 def tracing_interceptor(tracer: Tracer | None = None):
@@ -351,16 +542,19 @@ def tracing_interceptor(tracer: Tracer | None = None):
             if handler is None:
                 return None
             method = handler_call_details.method.rsplit("/", 1)[-1]
+            parent = parent_from_metadata(
+                getattr(handler_call_details, "invocation_metadata", ())
+            )
 
             def wrap_unary(behavior):
                 def inner(request, context):
-                    with tracer.span(f"rpc.{method}"):
+                    with tracer.span(f"rpc.{method}", parent=parent):
                         return behavior(request, context)
                 return inner
 
             def wrap_stream(behavior):
                 def inner(request_or_iter, context):
-                    with tracer.span(f"rpc.{method}") as span:
+                    with tracer.span(f"rpc.{method}", parent=parent) as span:
                         n = 0
                         for item in behavior(request_or_iter, context):
                             n += 1

@@ -411,10 +411,39 @@ class SimWorkloadClient:
     wall-clock in the simulator). Unknown-partition/unknown-file errors
     surface as :class:`SimRpcError` so the bridge's grpc error handling
     runs for real.
+
+    Tracing parity with the real wire: where the gRPC stub would inject
+    ``traceparent`` metadata and the agent's server interceptor would open
+    an ``rpc.<Method>`` span under it, the in-process fake honors the
+    SAME contract through the ambient contextvar (the in-process
+    equivalent of the metadata) — each RPC named in ``TRACED_RPCS`` opens
+    an agent-side span that parents into the caller's tick trace, so sim
+    flight records are end-to-end. Outside an active sampled trace the
+    wrapper costs one contextvar read.
     """
+
+    #: RPCs wrapped in agent-side spans (the surface the bridge dials)
+    TRACED_RPCS = (
+        "Partitions", "Partition", "Nodes", "SubmitJob", "SubmitJobs",
+        "CancelJob", "JobInfo", "JobsInfo", "JobState",
+    )
 
     def __init__(self, cluster: SimCluster):
         self.cluster = cluster
+        from slurm_bridge_tpu.obs.tracing import TRACER, current_span
+
+        def traced(name, fn):
+            def call(request, timeout=None):
+                parent = current_span()
+                if parent is None or not parent.sampled:
+                    return fn(request, timeout=timeout)
+                with TRACER.span(f"rpc.{name}", agent="sim"):
+                    return fn(request, timeout=timeout)
+
+            return call
+
+        for name in self.TRACED_RPCS:
+            setattr(self, name, traced(name, getattr(self, name)))
 
     def close(self) -> None:  # ServiceClient parity
         pass

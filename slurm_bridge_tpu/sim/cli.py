@@ -55,7 +55,42 @@ def _headline(result) -> dict:
         "submits_batched": result.determinism["submits_batched"],
         "submits_fallback": result.determinism["submits_fallback"],
         "invariant_violations": len(result.determinism["invariant_violations"]),
+        # the tick flight record: span-tree p50s, top self-time, per-kind
+        # × per-callsite commit breakdown — the attribution dataset the
+        # store decision (ROADMAP) needs
+        "flight_record": result.flight_record,
     }
+
+
+def _write_flight_diagnostics(result) -> str | None:
+    """Per-tick flight records for the slow headline run →
+    ``diagnostics/sim_flight_<scenario>.json`` (repo-relative when run
+    from a checkout, cwd otherwise)."""
+    import os
+
+    if not result.flight_ticks:
+        return None
+    out_dir = "diagnostics"
+    path = os.path.join(out_dir, f"sim_flight_{result.scenario.name}.json")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "scenario": result.scenario.name,
+                    "seed": result.scenario.seed,
+                    "aggregate": result.flight_record,
+                    "per_tick": result.flight_ticks,
+                },
+                f,
+                indent=1,
+                sort_keys=True,
+            )
+    except OSError:
+        # read-only checkout: the diagnostics artifact degrades to the
+        # in-JSON aggregate; never abort the run over it
+        return None
+    return path
 
 
 def _smoke() -> int:
@@ -75,6 +110,10 @@ def _smoke() -> int:
             "pending_final": a.determinism["pending_final"],
             "recovery_ticks": a.determinism["recovery_ticks"],
             "tick_p50_ms": a.timing["tick_p50_ms"],
+            # flight-record glance: span-derived phase sum should track
+            # tick_p50_ms (the ±5% reconciliation the tests enforce)
+            "flight_phase_sum_p50_ms": a.flight_record.get("phase_sum_p50_ms"),
+            "flight_commits_total": a.flight_record.get("commits_total"),
         }
         print(json.dumps(line))
         if det_a != det_b:
@@ -140,6 +179,9 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(result.as_dict()), flush=True)
         if name == "full_50kx10k":
             print(json.dumps(_headline(result)), flush=True)
+            path = _write_flight_diagnostics(result)
+            if path:
+                print(f"# flight record: {path}", file=sys.stderr)
     if args.out:
         with open(args.out, "w") as f:
             json.dump([r.as_dict() for r in results], f, indent=1, sort_keys=True)

@@ -53,7 +53,9 @@ from slurm_bridge_tpu.bridge.scheduler import PlacementScheduler
 from slurm_bridge_tpu.bridge.store import AlreadyExists, ObjectStore
 from slurm_bridge_tpu.core.types import JobStatus
 from slurm_bridge_tpu.obs.events import EventRecorder
+from slurm_bridge_tpu.obs.flight import FlightRecorder
 from slurm_bridge_tpu.obs.metrics import REGISTRY
+from slurm_bridge_tpu.obs.tracing import TRACER
 from slurm_bridge_tpu.sim.agent import SimCluster, SimWorkloadClient
 from slurm_bridge_tpu.sim.faults import FaultPlan, FaultyClient
 from slurm_bridge_tpu.sim.invariants import (
@@ -76,8 +78,11 @@ _tick_seconds = REGISTRY.histogram(
     "sbt_sim_tick_seconds", "full simulated reconcile tick wall time"
 )
 
-#: the five phases the full-tick headline decomposes into
-PHASES = ("store", "encode", "solve", "bind", "mirror")
+#: the phases the full-tick headline decomposes into. ``other`` is the
+#: scheduler-tick time OUTSIDE the four named phases (RPC-fault aborts,
+#: remote skips, any new cost a future change adds) — an explicit bucket
+#: so the numbers stop lying by silently folding it into "store"
+PHASES = ("store", "encode", "solve", "bind", "mirror", "other")
 
 
 @dataclass(frozen=True)
@@ -100,6 +105,9 @@ class Scenario:
     drain_grace_ticks: int = 60
     description: str = ""
     slow: bool = False
+    #: the tick flight recorder (span capture + attribution records);
+    #: off is the control arm of the bench-smoke overhead gate
+    tracing: bool = True
 
 
 @dataclass
@@ -108,6 +116,12 @@ class ScenarioResult:
     determinism: dict
     timing: dict
     shape: dict
+    #: run-level flight record (span tree p50s, top self-time, commit
+    #: breakdown); {} when the scenario ran with tracing off
+    flight_record: dict = field(default_factory=dict)
+    #: per-tick flight records — written to diagnostics/ for the slow
+    #: headline run, kept off the one-line scenario JSON otherwise
+    flight_ticks: list = field(default_factory=list)
 
     def as_dict(self) -> dict:
         return {
@@ -117,6 +131,7 @@ class ScenarioResult:
             "faults": self.scenario.faults.describe(),
             "determinism": self.determinism,
             "timing": self.timing,
+            "flight_record": self.flight_record,
         }
 
     def determinism_json(self) -> str:
@@ -224,6 +239,12 @@ class SimHarness:
             inventory_ttl=0.0,  # virtual time: always take a fresh snapshot
         )
         self._pod_watch = self.store.watch((Pod.KIND,))
+        #: the tick flight recorder — always-on unless the scenario opts
+        #: out (the overhead gate's control arm); every run_tick is one
+        #: capture window rooted at a "sim.tick" span
+        self.flight = FlightRecorder(
+            tracer=TRACER, store=self.store, enabled=scenario.tracing
+        )
         self.rpc_failures: dict[str, int] = {}
         self.violations: list[Violation] = []
         self._digest = hashlib.sha256()
@@ -283,7 +304,7 @@ class SimHarness:
             # the trace's virtual duration rides the demand's time limit —
             # the sim agent runs each job for exactly that long
             try:
-                self.store.create(job)
+                self.store.create(job, site="sim.arrive")
             except AlreadyExists:
                 continue
             self.operator.reconcile(a.name)
@@ -304,36 +325,39 @@ class SimHarness:
                         ),
                     )
 
-                self.store.replace_update(Pod.KIND, pod.name, stamp)
+                self.store.replace_update(
+                    Pod.KIND, pod.name, stamp, site="sim.arrive"
+                )
         return len(arrivals)
 
     def _mirror(self) -> None:
         """Partition diff + provider sync + event-driven operator sync —
         the production mirror half of the reconcile loop."""
-        try:
-            self.configurator.reconcile()
-        except grpc.RpcError:
-            self._rpc_fail("configurator.reconcile")
-        for partition in sorted(self.configurator.providers):
-            provider = self.configurator.providers[partition]
+        with TRACER.span("sim.mirror"):
             try:
-                provider.sync()
+                self.configurator.reconcile()
             except grpc.RpcError:
-                self._rpc_fail(f"provider.sync:{partition}")
-        # drain the pod watch queue and sweep owners of changed pods in
-        # batch — exactly what the operator's _pump_events thread does,
-        # made synchronous (and therefore deterministic); keys the sweep
-        # can't settle go through the single-key oracle, like the pump's
-        # controller queue would
-        owners: set[str] = set()
-        while True:
-            try:
-                ev = self._pod_watch.get_nowait()
-            except Exception:
-                break
-            self.operator._collect_owner(ev, owners)
-        for owner in self.operator.sweep(owners) if owners else ():
-            self.operator.reconcile(owner)
+                self._rpc_fail("configurator.reconcile")
+            for partition in sorted(self.configurator.providers):
+                provider = self.configurator.providers[partition]
+                try:
+                    provider.sync()
+                except grpc.RpcError:
+                    self._rpc_fail(f"provider.sync:{partition}")
+            # drain the pod watch queue and sweep owners of changed pods
+            # in batch — exactly what the operator's _pump_events thread
+            # does, made synchronous (and therefore deterministic); keys
+            # the sweep can't settle go through the single-key oracle,
+            # like the pump's controller queue would
+            owners: set[str] = set()
+            while True:
+                try:
+                    ev = self._pod_watch.get_nowait()
+                except Exception:
+                    break
+                self.operator._collect_owner(ev, owners)
+            for owner in self.operator.sweep(owners) if owners else ():
+                self.operator.reconcile(owner)
 
     def _free_now(self) -> dict[str, tuple[float, float, float]]:
         out = {}
@@ -348,13 +372,19 @@ class SimHarness:
         return out
 
     def run_tick(self, tick: int, *, arrivals: bool = True) -> dict[str, float]:
+        with self.flight.tick(tick):
+            return self._run_tick(tick, arrivals=arrivals)
+
+    def _run_tick(self, tick: int, *, arrivals: bool = True) -> dict[str, float]:
         cpu0 = time.process_time()
         if isinstance(self.client, FaultyClient):
             self.client.set_tick(tick)
         self._apply_fault_boundaries(tick)
 
         t0 = time.perf_counter()
-        n_arrived = self._arrive(tick) if arrivals else 0
+        with TRACER.span("sim.arrive") as arrive_span:
+            n_arrived = self._arrive(tick) if arrivals else 0
+            arrive_span.count("arrivals", n_arrived)
         self._arrive_ms.append((time.perf_counter() - t0) * 1e3)
 
         stale = bool(self.scenario.faults.active("stale_snapshot", tick))
@@ -379,9 +409,10 @@ class SimHarness:
         self._mirror()
         phases["mirror"] = (time.perf_counter() - t2) * 1e3
         # anything tick() spent outside its own phase decomposition
-        # (RPC-fault aborts, remote skips) lands in "store"
+        # (RPC-fault aborts, remote skips, future costs) gets its own
+        # explicit bucket instead of silently inflating "store"
         accounted = sum(phases.get(k, 0.0) for k in ("store", "encode", "solve", "bind"))
-        phases["store"] = phases.get("store", 0.0) + max(0.0, sched_ms - accounted)
+        phases["other"] = max(0.0, sched_ms - accounted)
 
         self.cluster.step()
 
@@ -471,7 +502,8 @@ class SimHarness:
             f"{phases.get('encode', 0.0):.0f} / solve "
             f"{phases.get('solve', 0.0):.0f} / bind "
             f"{phases.get('bind', 0.0):.0f} / mirror "
-            f"{phases.get('mirror', 0.0):.0f}; cpu "
+            f"{phases.get('mirror', 0.0):.0f} / other "
+            f"{phases.get('other', 0.0):.0f}; cpu "
             f"{phases.get('cpu', 0.0):.0f}), pending "
             f"{self._pending_by_tick[-1] if self._pending_by_tick else 0}",
             file=sys.stderr,
@@ -590,7 +622,12 @@ class SimHarness:
             "ticks": total_ticks,
         }
         return ScenarioResult(
-            scenario=sc, determinism=determinism, timing=timing, shape=shape
+            scenario=sc,
+            determinism=determinism,
+            timing=timing,
+            shape=shape,
+            flight_record=self.flight.aggregate(),
+            flight_ticks=list(self.flight.records),
         )
 
 

@@ -73,6 +73,38 @@ def dial(endpoint: str) -> grpc.Channel:
     return grpc.insecure_channel(normalize_endpoint(endpoint))
 
 
+def _traced_call(method_name: str, multicallable, unary: bool):
+    """Wrap a multicallable with trace propagation: when the caller is
+    inside an active span, a ``traceparent`` metadata entry rides the RPC
+    (so the server-side interceptor parents its span into the caller's
+    trace) and — for unary RPCs — a client-side ``rpc.client.<Method>``
+    span records the round-trip. Outside a trace the wrapper is a
+    pass-through: no metadata, no span, one attribute read of overhead."""
+    from slurm_bridge_tpu.obs.tracing import TRACER, format_traceparent
+
+    def call(request, timeout=None, metadata=None):
+        parent = TRACER.current()
+        if parent is None or not parent.sampled:
+            # outside a trace — or inside one the sampler discarded (the
+            # whole trace exports or none of it): true pass-through, no
+            # span build, no metadata tuple, on e.g. 45k fallback RPCs
+            return multicallable(request, timeout=timeout, metadata=metadata)
+        if not unary:
+            # streams outlive the call frame: propagate context only
+            md = tuple(metadata or ()) + (
+                ("traceparent", format_traceparent(parent)),
+            )
+            return multicallable(request, timeout=timeout, metadata=md)
+        with TRACER.span(f"rpc.client.{method_name}") as span:
+            # the server parents under the CLIENT span, not the tick span
+            md = tuple(metadata or ()) + (
+                ("traceparent", format_traceparent(span)),
+            )
+            return multicallable(request, timeout=timeout, metadata=md)
+
+    return call
+
+
 class ServiceClient:
     """Dynamic client stub: one callable attribute per RPC.
 
@@ -85,13 +117,16 @@ class ServiceClient:
         full_name, specs = service_methods(service_name)
         for spec in specs:
             factory = getattr(channel, spec.kind)
+            multicallable = factory(
+                f"/{full_name}/{spec.name}",
+                request_serializer=spec.req_cls.SerializeToString,
+                response_deserializer=spec.resp_cls.FromString,
+            )
             setattr(
                 self,
                 spec.name,
-                factory(
-                    f"/{full_name}/{spec.name}",
-                    request_serializer=spec.req_cls.SerializeToString,
-                    response_deserializer=spec.resp_cls.FromString,
+                _traced_call(
+                    spec.name, multicallable, unary=spec.kind == "unary_unary"
                 ),
             )
 

@@ -1,0 +1,465 @@
+"""Tick flight recorder + cross-layer span wiring + trace propagation.
+
+The PR-5 observability surface: store commit attribution (per-kind ×
+per-callsite), the flight recorder's span-tree/commit records, W3C-style
+traceparent propagation over the workload RPC wire, scheduler/operator/
+provider span wiring, and the determinism contract (tracing on/off must
+produce byte-identical digests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import pytest
+
+from slurm_bridge_tpu.bridge.store import ObjectStore
+from slurm_bridge_tpu.obs.flight import FlightRecorder
+from slurm_bridge_tpu.obs.tracing import (
+    TRACER,
+    InMemoryExporter,
+    Tracer,
+    current_span,
+    format_traceparent,
+    parent_from_metadata,
+    parse_traceparent,
+    with_current_span,
+)
+from slurm_bridge_tpu.sim.harness import PHASES, SimHarness, run_scenario
+from slurm_bridge_tpu.sim.trace import ClusterSpec, WorkloadSpec
+from slurm_bridge_tpu.sim.harness import Scenario
+
+
+def _tiny(name="flight-tiny", *, jobs=40, nodes=16, ticks=6, seed=11, **kw):
+    return Scenario(
+        name=name,
+        cluster=ClusterSpec(num_nodes=nodes),
+        workload=WorkloadSpec(
+            jobs=jobs, arrival="poisson", spread_ticks=3,
+            duration_range=(5.0, 15.0),
+        ),
+        ticks=ticks,
+        seed=seed,
+        drain_grace_ticks=40,
+        **kw,
+    )
+
+
+class _Obj:
+    KIND = "Thing"
+
+    class _Meta:
+        def __init__(self, name):
+            self.name = name
+            self.resource_version = 0
+            self.owner = ""
+            self.deleted = False
+            self.labels = {}
+            self.annotations = {}
+
+    def __init__(self, name):
+        self.meta = self._Meta(name)
+
+
+# ---------------------------------------------------------------- store
+
+
+class TestCommitAttribution:
+    def test_sites_recorded_per_kind(self):
+        store = ObjectStore()
+        store.create(_Obj("a"), site="test.create")
+        store.create(_Obj("b"))  # unlabeled → "other"
+        obj = store.get_for_update("Thing", "a")
+        store.update(obj, site="test.update")
+        counts = store.commit_counts_snapshot()
+        assert counts[("Thing", "test.create")] == 1
+        assert counts[("Thing", "other")] == 1
+        assert counts[("Thing", "test.update")] == 1
+        assert store.commits_total() == 3
+
+    def test_batch_sites_and_failures_not_counted(self):
+        store = ObjectStore()
+        store.create(_Obj("a"), site="seed")
+        res = store.create_batch([_Obj("a"), _Obj("b")], site="batch")
+        assert isinstance(res[0], Exception)  # AlreadyExists not counted
+        counts = store.commit_counts_snapshot()
+        assert counts[("Thing", "batch")] == 1
+        # stale update in a batch is not a commit either
+        stale = store.get_for_update("Thing", "b")
+        fresh = store.get_for_update("Thing", "b")
+        store.update(fresh, site="w1")  # bumps the stored rv past stale's
+        res = store.update_batch([stale], site="w2")
+        assert isinstance(res[0], Exception)
+        assert ("Thing", "w2") not in store.commit_counts_snapshot()
+
+    def test_metric_collector_renders_breakdown(self):
+        from slurm_bridge_tpu.obs.metrics import REGISTRY
+
+        store = ObjectStore()
+        store.create(_Obj("a"), site="metric.site")
+        text = REGISTRY.render()
+        assert (
+            'sbt_store_commits_total{kind="Thing",site="metric.site"} 1' in text
+        )
+
+    def test_commits_attributed_to_active_span(self):
+        mem = InMemoryExporter()
+        tracer = Tracer("t", sample="always").add_exporter(mem)
+        store = ObjectStore()
+        with tracer.span("writer") as span:
+            store.create(_Obj("a"), site="span.site")
+            store.create_batch([_Obj("b"), _Obj("c")], site="span.site")
+        assert span.counters["commits.Thing.span.site"] == 3
+
+
+# ---------------------------------------------------------- traceparent
+
+
+class TestTraceparent:
+    def test_roundtrip(self):
+        tracer = Tracer("t", sample="always")
+        with tracer.span("root") as root:
+            header = format_traceparent(root)
+        assert header.startswith("00-")
+        stub = parse_traceparent(header)
+        assert stub.trace_id == root.trace_id.zfill(32)
+        assert stub.span_id == root.span_id.zfill(16)
+        assert stub.sampled
+
+    def test_unsampled_flag(self):
+        tracer = Tracer("t", sample="never")
+        with tracer.span("root") as root:
+            stub = parse_traceparent(format_traceparent(root))
+        assert not stub.sampled
+
+    @pytest.mark.parametrize(
+        "bad", ["", "junk", "00-abc-def-01", "zz-" + "0" * 32 + "-" + "0" * 16]
+    )
+    def test_malformed_returns_none(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_parent_from_metadata(self):
+        md = (("other", "x"), ("traceparent", "00-" + "a" * 32 + "-" + "b" * 16 + "-01"))
+        stub = parent_from_metadata(md)
+        assert stub is not None and stub.trace_id == "a" * 32
+        assert parent_from_metadata((("k", "v"),)) is None
+        assert parent_from_metadata(None) is None
+
+    def test_propagation_over_real_grpc_wire(self):
+        """A client call made inside a span carries traceparent metadata;
+        the server interceptor parents its rpc span into the SAME trace —
+        the agent/solver side of the tick trace."""
+        from slurm_bridge_tpu.obs.tracing import tracing_interceptor
+        from slurm_bridge_tpu.wire import ServiceClient, dial, serve
+        from slurm_bridge_tpu.wire import workload_pb2 as pb
+
+        server_mem = InMemoryExporter()
+        server_tracer = Tracer("agent", sample="never").add_exporter(server_mem)
+
+        class Servicer:
+            def WorkloadInfo(self, request, context):
+                return pb.WorkloadInfoResponse(name="slurm", version="1.0")
+
+        server = serve(
+            {"WorkloadManager": Servicer()}, "127.0.0.1:0",
+            interceptors=(tracing_interceptor(server_tracer),),
+        )
+        client_mem = InMemoryExporter()
+        prev_sampler = TRACER._sampler
+        TRACER.add_exporter(client_mem)
+        TRACER._sampler = lambda: True
+        try:
+            with ServiceClient(
+                dial(f"127.0.0.1:{server.bound_port}"), "WorkloadManager"
+            ) as client:
+                with TRACER.span("tick") as tick:
+                    client.WorkloadInfo(pb.WorkloadInfoRequest())
+        finally:
+            TRACER._sampler = prev_sampler
+            TRACER.remove_exporter(client_mem)
+            server.stop(grace=None)
+        [rpc_span] = [s for s in server_mem.spans if s.name == "rpc.WorkloadInfo"]
+        [client_span] = [
+            s for s in client_mem.spans if s.name == "rpc.client.WorkloadInfo"
+        ]
+        assert rpc_span.trace_id == tick.trace_id
+        assert client_span.trace_id == tick.trace_id
+        assert rpc_span.parent_id == client_span.span_id
+        assert client_span.parent_id == tick.span_id
+
+    def test_no_span_no_metadata_no_client_span(self):
+        """Outside a trace — or inside an UNSAMPLED one — the client
+        wrapper is a pass-through: no metadata, no client span."""
+        from slurm_bridge_tpu.wire import ServiceClient, dial, serve
+        from slurm_bridge_tpu.wire import workload_pb2 as pb
+
+        seen = []
+
+        class Servicer:
+            def WorkloadInfo(self, request, context):
+                seen.append(dict(context.invocation_metadata()))
+                return pb.WorkloadInfoResponse(name="slurm", version="1.0")
+
+        server = serve({"WorkloadManager": Servicer()}, "127.0.0.1:0")
+        try:
+            with ServiceClient(
+                dial(f"127.0.0.1:{server.bound_port}"), "WorkloadManager"
+            ) as client:
+                client.WorkloadInfo(pb.WorkloadInfoRequest())
+                # default TRACER samples never: ambient span is unsampled
+                with TRACER.span("unsampled-tick") as span:
+                    assert not span.sampled
+                    client.WorkloadInfo(pb.WorkloadInfoRequest())
+        finally:
+            server.stop(grace=None)
+        assert "traceparent" not in seen[0]
+        assert "traceparent" not in seen[1]
+
+
+# ------------------------------------------------------- context helpers
+
+
+class TestCrossThread:
+    def test_with_current_span_seeds_worker_context(self):
+        mem = InMemoryExporter()
+        tracer = Tracer("t", sample="always").add_exporter(mem)
+        with tracer.span("root") as root:
+            done = threading.Event()
+
+            def worker():
+                assert current_span() is None  # fresh thread: empty context
+                with with_current_span(root):
+                    with tracer.span("child"):
+                        pass
+                assert current_span() is None  # token reset
+                done.set()
+
+            threading.Thread(target=worker).start()
+            assert done.wait(2)
+        child = next(s for s in mem.spans if s.name == "child")
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+
+
+class TestIdGeneration:
+    def test_ids_are_hex_of_requested_width(self):
+        from slurm_bridge_tpu.obs.tracing import _new_id
+
+        assert len(_new_id(16)) == 32
+        assert len(_new_id(8)) == 16
+        int(_new_id(16), 16)  # parses as hex
+
+    def test_ids_unique_across_threads(self):
+        from slurm_bridge_tpu.obs.tracing import _new_id
+
+        out: list[str] = []
+        lock = threading.Lock()
+
+        def gen():
+            ids = [_new_id(8) for _ in range(500)]
+            with lock:
+                out.extend(ids)
+
+        threads = [threading.Thread(target=gen) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(out)) == len(out)
+
+
+class TestSamplerPolicies:
+    def test_percentage_is_probabilistic(self, monkeypatch):
+        from slurm_bridge_tpu.obs import tracing
+        from slurm_bridge_tpu.obs.tracing import parse_sampler
+
+        sampler = parse_sampler("25")
+        monkeypatch.setattr(tracing.random, "random", lambda: 0.2)
+        assert sampler()
+        monkeypatch.setattr(tracing.random, "random", lambda: 0.3)
+        assert not sampler()
+
+
+# ----------------------------------------------------------- tracez view
+
+
+class TestTracezTickView:
+    def test_recent_ticks_tree_rendered(self):
+        tracer = Tracer("svc", sample="always")
+        with tracer.span("sim.tick", tick=3) as root:
+            root.count("arrivals", 7)
+            with tracer.span("scheduler.tick"):
+                with tracer.span("scheduler.store"):
+                    pass
+        page = tracer.render_tracez()
+        assert "recent ticks:" in page
+        assert "tick=3" in page
+        assert "scheduler.store" in page
+        # counters ride the per-tick view
+        assert "arrivals=7" in page
+
+
+# ----------------------------------------------------- OTLP health gauge
+
+
+class TestOtlpHealthMetrics:
+    def test_drops_surface_on_metrics(self):
+        from slurm_bridge_tpu.obs.metrics import REGISTRY
+        from slurm_bridge_tpu.obs.otlp import OtlpHttpExporter, _dropped_total
+
+        before = _dropped_total.total()
+        exporter = OtlpHttpExporter(
+            "http://127.0.0.1:1", service="x", flush_interval=60.0, timeout=0.2
+        )
+        tracer = Tracer("x").add_exporter(exporter)
+        with tracer.span("doomed"):
+            pass
+        exporter.flush()
+        exporter.close()
+        assert _dropped_total.total() == before + 1
+        text = REGISTRY.render()
+        assert "sbt_otlp_dropped_spans_total" in text
+        assert "sbt_otlp_queue_depth" in text
+        assert "sbt_otlp_exported_spans_total" in text
+
+
+# -------------------------------------------------------- flight records
+
+
+class TestFlightRecorder:
+    def test_record_tree_and_self_times(self):
+        store = ObjectStore()
+        rec = FlightRecorder(tracer=TRACER, store=store, root_name="sim.tick")
+        with rec.tick(0):
+            with TRACER.span("scheduler.tick"):
+                with TRACER.span("scheduler.store"):
+                    store.create(_Obj("a"), site="scheduler.bind")
+        [record] = rec.records
+        root = record["tree"]["sim.tick"]
+        sched = root["children"]["scheduler.tick"]
+        assert "scheduler.store" in sched["children"]
+        assert record["commits"] == {"Thing.scheduler.bind": 1}
+        assert record["commits_total"] == 1
+        names = {row["name"] for row in record["top_self_ms"]}
+        assert "scheduler.store" in names
+        # store span carries the commit it caused
+        store_node = sched["children"]["scheduler.store"]
+        assert store_node["counters"]["commits.Thing.scheduler.bind"] == 1
+
+    def test_overflow_keeps_newest_spans_phase_tree_intact(self):
+        """A front-loaded cold tick floods the window with per-arrival
+        reconcile spans; the ring must evict THOSE and keep the phase
+        spans that close near tick end — the attribution the record
+        exists for."""
+        rec = FlightRecorder(tracer=TRACER, root_name="sim.tick", capacity=50)
+        with rec.tick(0):
+            for _ in range(200):  # the arrive flood
+                with TRACER.span("operator.reconcile"):
+                    pass
+            with TRACER.span("scheduler.tick"):
+                with TRACER.span("scheduler.store"):
+                    pass
+        [record] = rec.records
+        # 203 exported (200 reconciles + 2 scheduler + the root) over cap 50
+        assert record["spans_dropped"] == 153
+        sched = record["tree"]["sim.tick"]["children"]["scheduler.tick"]
+        assert "scheduler.store" in sched["children"]
+        assert rec.phases_ms(record)["store"] >= 0.0
+
+    def test_aggregate_self_times_not_truncated_to_top_n(self):
+        """A name outside every tick's top-N display list still reaches
+        the run aggregate (it sums the untruncated by-name table)."""
+        rec = FlightRecorder(tracer=TRACER, root_name="sim.tick", top_n=1)
+        with rec.tick(0):
+            with TRACER.span("big"):
+                with TRACER.span("small"):
+                    pass
+        [record] = rec.records
+        assert len(record["top_self_ms"]) == 1
+        assert "small" in record["self_ms_by_name"]
+        agg = rec.aggregate()
+        # top_n still truncates the display, but from full data
+        assert {r["name"] for r in agg["top_self_ms"]} <= {
+            "big", "small", "sim.tick"
+        }
+
+    def test_disabled_recorder_is_noop(self):
+        rec = FlightRecorder(enabled=False)
+        with rec.tick(0) as root:
+            assert root is None
+        assert rec.records == []
+        assert rec.aggregate() == {}
+
+    def test_sampler_restored_after_window(self):
+        rec = FlightRecorder(tracer=TRACER, root_name="sim.tick")
+        with rec.tick(0):
+            pass
+        with TRACER.span("after") as span:
+            assert not span.sampled  # default TRACER samples never
+
+
+class TestHarnessFlightRecord:
+    @pytest.fixture(scope="class")
+    def runs(self):
+        on = run_scenario(_tiny())
+        off = run_scenario(dataclasses.replace(_tiny(), tracing=False))
+        return on, off
+
+    def test_digest_identical_with_tracing(self, runs):
+        on, off = runs
+        assert on.determinism["digest"] == off.determinism["digest"]
+        assert on.determinism_json() == off.determinism_json()
+        assert off.flight_record == {}
+
+    def test_phase_tree_reconciles_with_tick_p50(self, runs):
+        """Acceptance: span-tree phase durations reconcile with the
+        timing headline within ±5% (both decompose the same ticks)."""
+        on, _ = runs
+        fr = on.flight_record
+        assert fr["ticks"] == on.shape["ticks"]
+        tick_p50 = on.timing["tick_p50_ms"]
+        assert fr["phase_sum_p50_ms"] == pytest.approx(tick_p50, rel=0.05)
+        for phase in PHASES:
+            assert phase in fr["phases_p50_ms"]
+
+    def test_commit_breakdown_sums_to_store_total(self):
+        h = SimHarness(_tiny())
+        result = h.run()
+        fr = result.flight_record
+        assert fr["commits_total"] == h.store.commits_total()
+        # attribution is real: the known hot sites appear
+        sites = set(fr["commits"])
+        assert "Pod.scheduler.bind" in sites
+        assert "Pod.vnode.submit" in sites
+        assert "BridgeJob.sim.arrive" in sites
+        # per-tick records each sum to their own total
+        for rec in result.flight_ticks:
+            assert sum(rec["commits"].values()) == rec["commits_total"]
+
+    def test_span_tree_is_end_to_end(self, runs):
+        """Sim traces cross the fake wire: agent-side rpc spans parent
+        under the provider/scheduler spans inside the tick trace."""
+        on, _ = runs
+        paths = set(on.flight_record["span_tree_p50_ms"])
+        assert "sim.tick/scheduler.tick/scheduler.store" in paths
+        assert "sim.tick/sim.mirror/vnode.sync" in paths
+        assert any(p.endswith("rpc.SubmitJobs") for p in paths)
+        assert any(p.endswith("rpc.JobsInfo") for p in paths)
+        assert any("operator.sweep" in p for p in paths)
+
+    def test_scheduler_phase_dict_derived_from_spans(self):
+        h = SimHarness(_tiny(ticks=3))
+        h.run_tick(0)
+        phases = h.scheduler.last_phase_ms
+        assert set(phases) == {"store", "encode", "solve", "bind"}
+        assert phases["store"] > 0.0
+        rec = h.flight.records[-1]
+        lifted = h.flight.phases_ms(rec)
+        for k in ("store", "encode", "solve", "bind"):
+            assert lifted[k] == pytest.approx(phases[k], rel=0.05, abs=0.05)
+
+    def test_counter_deltas_recorded(self, runs):
+        on, _ = runs
+        counters = on.flight_record["counters"]
+        assert counters.get("sbt_operator_reconciles_total", 0) > 0

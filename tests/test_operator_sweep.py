@@ -238,7 +238,7 @@ def test_sweep_conflict_falls_back_to_oracle(monkeypatch):
     real_update_batch = store.update_batch
     raced = {"done": False}
 
-    def racing_update_batch(objs):
+    def racing_update_batch(objs, **kw):
         if not raced["done"]:
             raced["done"] = True
             # interleaved writer: rewrites the CR (same content, new rv)
@@ -246,7 +246,7 @@ def test_sweep_conflict_falls_back_to_oracle(monkeypatch):
                 BridgeJob.KIND, "racy",
                 lambda j: fast_replace(j, meta=fast_replace(j.meta)),
             )
-        return real_update_batch(objs)
+        return real_update_batch(objs, **kw)
 
     monkeypatch.setattr(store, "update_batch", racing_update_batch)
     slow = op.sweep(["racy"])

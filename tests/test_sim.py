@@ -284,7 +284,7 @@ def test_harness_deterministic_and_drains():
     assert a.determinism["pending_final"] == 0
     assert a.determinism["drained_at_tick"] is not None
     # phase breakdown present and the tick is the sum of its phases
-    for k in ("store", "encode", "solve", "bind", "mirror"):
+    for k in ("store", "encode", "solve", "bind", "mirror", "other"):
         assert k in a.timing["phases_p50_ms"]
 
 
@@ -408,7 +408,7 @@ def test_cli_runs_scenario_json(tmp_path, capsys):
     assert obj["scenario"] == "steady_poisson"
     assert "digest" in obj["determinism"]
     assert set(obj["timing"]["phases_p50_ms"]) == {
-        "store", "encode", "solve", "bind", "mirror"
+        "store", "encode", "solve", "bind", "mirror", "other"
     }
     saved = json.loads(out_file.read_text())
     assert saved[0]["determinism"]["digest"] == obj["determinism"]["digest"]
