@@ -346,6 +346,7 @@ def profile_reconcile(n_jobs: int = 2_000, *, iters: int = 3) -> dict:
     logging.getLogger("sbt.events").setLevel(logging.CRITICAL)
     create_ms, dirty_ms, steady_ms = [], [], []
     steady_writes = 0
+    steady_views = 0
     for _ in range(iters):
         store = ObjectStore()
         op = BridgeOperator(
@@ -393,10 +394,12 @@ def profile_reconcile(n_jobs: int = 2_000, *, iters: int = 3) -> dict:
         op.sweep(names)
         dirty_ms.append((time.perf_counter() - t0) * 1e3)
         rv_before = store.changes_since(Pod.KIND, 0)[0]
+        views_before = store.view_builds_total()
         t0 = time.perf_counter()
         op.sweep(names)
         steady_ms.append((time.perf_counter() - t0) * 1e3)
         steady_writes += store.changes_since(Pod.KIND, 0)[0] - rv_before
+        steady_views += store.view_builds_total() - views_before
     dirty = float(np.median(dirty_ms))
     return {
         "jobs": n_jobs,
@@ -405,6 +408,10 @@ def profile_reconcile(n_jobs: int = 2_000, *, iters: int = 3) -> dict:
         "steady_sweep_ms": round(float(np.median(steady_ms)), 2),
         "per_job_us": round(dirty * 1e3 / n_jobs, 2),
         "steady_writes": steady_writes,
+        # PR-6: a no-change sweep over columnar kinds must materialize
+        # ZERO frozen views — reads that sneak back onto the object path
+        # are a structural regression, asserted hard by bench-smoke
+        "steady_views": steady_views,
     }
 
 

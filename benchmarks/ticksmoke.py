@@ -26,9 +26,16 @@ is ~0.3-0.7 ms per tick regardless of scale, which is 5%+ of a ~10 ms
 toy tick but 0.03% of the 5.2 s headline tick where the percentage
 budget is the binding constraint).
 
+The columnar hot-state store (PR-6) adds two gates: the dirty-sweep
+budget tightened to the columnar cost (500 ms for 500 jobs, still ~20×
+the measured steady state), and a HARD zero on ``steady_views`` — a
+no-change sweep that materializes even one frozen dataclass view for a
+columnar kind means a read snuck back onto the object path, which is a
+structural regression however fast it happens to run today.
+
     SBT_SMOKE_ENCODE_BUDGET_MS     warm encode p50 ceiling    (default 50)
     SBT_SMOKE_MIN_SPEEDUP          encode speedup floor       (default 3)
-    SBT_SMOKE_RECONCILE_BUDGET_MS  dirty-sweep ceiling, 500 jobs (default 1000)
+    SBT_SMOKE_RECONCILE_BUDGET_MS  dirty-sweep ceiling, 500 jobs (default 500)
     SBT_SMOKE_TRACE_OVERHEAD_PCT   tracing-on p50 overhead ceiling (default 3)
     SBT_SMOKE_TRACE_EPS_MS         absolute overhead epsilon  (default 1.5)
 """
@@ -120,7 +127,7 @@ def main() -> int:
     budget_ms = float(os.environ.get("SBT_SMOKE_ENCODE_BUDGET_MS", "50"))
     min_speedup = float(os.environ.get("SBT_SMOKE_MIN_SPEEDUP", "3"))
     rec_budget_ms = float(
-        os.environ.get("SBT_SMOKE_RECONCILE_BUDGET_MS", "1000")
+        os.environ.get("SBT_SMOKE_RECONCILE_BUDGET_MS", "500")
     )
     trace_pct = float(os.environ.get("SBT_SMOKE_TRACE_OVERHEAD_PCT", "3"))
     trace_eps_ms = float(os.environ.get("SBT_SMOKE_TRACE_EPS_MS", "1.5"))
@@ -142,6 +149,7 @@ def main() -> int:
         and out["encode_speedup_vs_loop"] >= min_speedup
         and rec["dirty_sweep_ms"] <= rec_budget_ms
         and rec["steady_writes"] == 0
+        and rec["steady_views"] == 0
         and trace_ok
     )
     out["ok"] = ok
@@ -152,7 +160,8 @@ def main() -> int:
             f"(budget {budget_ms}) / speedup {out['encode_speedup_vs_loop']}x "
             f"(floor {min_speedup}x) / dirty sweep {rec['dirty_sweep_ms']} ms "
             f"(budget {rec_budget_ms}) / steady sweep writes "
-            f"{rec['steady_writes']} (must be 0) / tracing overhead "
+            f"{rec['steady_writes']} (must be 0) / steady sweep frozen "
+            f"views {rec['steady_views']} (must be 0) / tracing overhead "
             f"{trace['overhead_pct']}% (budget {trace_pct}%, eps "
             f"{trace_eps_ms} ms) / digest identical "
             f"{trace['digest_identical']} (must be true)",

@@ -174,24 +174,31 @@ class BridgeJob:
 def validate_bridge_job(job: BridgeJob) -> None:
     """Name must be DNS1035, partition and script required
     (slurmbridgejob_validation.go:8-26)."""
-    if not _DNS1035.match(job.meta.name or ""):
+    validate_job_fields(job.meta.name, job.spec)
+
+
+def validate_job_fields(name: str, spec: BridgeJobSpec) -> None:
+    """The validation body over (name, spec) — validation is a pure
+    function of exactly these two, which is what lets the columnar sweep
+    validate from columns without materializing a view."""
+    if not _DNS1035.match(name or ""):
         raise ValidationError(
-            f"invalid job name {job.meta.name!r}: must be a DNS-1035 label"
+            f"invalid job name {name!r}: must be a DNS-1035 label"
         )
-    if len(job.meta.name) > 63:
-        raise ValidationError(f"job name {job.meta.name!r} longer than 63 chars")
-    if not job.spec.partition:
+    if len(name) > 63:
+        raise ValidationError(f"job name {name!r} longer than 63 chars")
+    if not spec.partition:
         raise ValidationError("spec.partition is required")
-    if not job.spec.sbatch_script.strip():
+    if not spec.sbatch_script.strip():
         raise ValidationError("spec.sbatchScript is required")
-    if job.spec.array:
+    if spec.array:
         # reject malformed/oversized specs at ingress: raised deeper (the
         # sizing path) the ValueError would spin the reconcile-retry loop
         # forever instead of failing the job with a reason
         from slurm_bridge_tpu.core.arrays import array_len
 
         try:
-            array_len(job.spec.array)
+            array_len(spec.array)
         except ValueError as exc:
             raise ValidationError(f"invalid spec.array: {exc}") from None
 

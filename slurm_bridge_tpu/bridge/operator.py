@@ -19,6 +19,15 @@ import os
 import queue
 import time
 
+import numpy as np
+
+from slurm_bridge_tpu.bridge.columns import (
+    CR_STATE_OF_PHASE,
+    JOBSTATUS_BY_CODE,
+    STATE_CODE,
+    STATE_STRS,
+    heap_iso,
+)
 from slurm_bridge_tpu.bridge.controller import Controller, Result
 from slurm_bridge_tpu.bridge.freeze import (
     FrozenDict,
@@ -45,6 +54,7 @@ from slurm_bridge_tpu.bridge.objects import (
     ValidationError,
     new_uid,
     validate_bridge_job,
+    validate_job_fields,
 )
 from slurm_bridge_tpu.bridge.statusmap import (
     container_status_for,
@@ -81,6 +91,16 @@ _STATE_REASONS = {
 #: shared empty job_infos for worker pods — immutable, so aliasing across
 #: 45k creates per sweep is safe and skips a FrozenList build each
 _EMPTY_FROZEN_LIST = FrozenList()
+
+#: CR-state int8 codes the columnar sweep uses
+_ST_RUNNING = STATE_CODE[JobState.RUNNING]
+_ST_SUCCEEDED = STATE_CODE[JobState.SUCCEEDED]
+_ST_FAILED = STATE_CODE[JobState.FAILED]
+_POD_PHASE_PENDING = 0  # columns.PHASE_CODE[PodPhase.PENDING]
+#: JobStatus display names by code (container reasons)
+_STATUS_NAME = tuple(s.name for s in JOBSTATUS_BY_CODE)
+_STATUS_NAME_ARR = np.empty(len(_STATUS_NAME), dtype=object)
+_STATUS_NAME_ARR[:] = _STATUS_NAME
 
 #: dirty sets at least this large AND covering ≥¼ of the stored CRs read
 #: via two bulk list() dict builds instead of per-key try_get (3 locked
@@ -290,6 +310,10 @@ class BridgeOperator:
         oracle and the fallback for everything unusual.
         """
         with TRACER.span("operator.sweep") as span:
+            jt = self.store.table(BridgeJob.KIND)
+            pt = self.store.table(Pod.KIND)
+            if jt is not None and pt is not None:
+                return self._sweep_cols(span, names, jt, pt)
             return self._sweep(span, names)
 
     def _sweep(self, span, names) -> list[str]:
@@ -390,6 +414,410 @@ class BridgeOperator:
         span.count("owners", len(ordered))
         span.count("creates", len(creates))
         span.count("updates", len(updates))
+        span.count("slow", len(set(slow)))
+        _reconcile_seconds.observe(time.perf_counter() - t0)
+        return sorted(set(slow))
+
+    # ---- the columnar sweep (PR-6) ----
+
+    def _worker_labels(self, partition: str) -> FrozenDict:
+        """Interned per-partition worker labels — immutable, so aliasing
+        across 45k creates per sweep is safe (content-equal to the
+        oracle's per-pod dict)."""
+        cache = getattr(self, "_worker_label_cache", None)
+        if cache is None:
+            cache = self._worker_label_cache = {}
+        fd = cache.get(partition)
+        if fd is None:
+            fd = cache[partition] = FrozenDict(
+                {"role": PodRole.WORKER, "partition": partition}
+            )
+        return fd
+
+    def _sweep_cols(self, span, names, jt, pt) -> list[str]:
+        """The sweep on columns, vectorized: one locked scan classifies
+        every owner with NumPy column masks (the per-owner Python loop is
+        gone — raw-field compares instead of 45k SubjobStatus/
+        ContainerStatus builds + dict equality), captures the values for
+        changed rows as gathered arrays (copies — heap indices would go
+        stale if a concurrent writer compacts a heap), then commits land
+        as batched row-writes. Owners with shapes the fast path doesn't
+        model (multi-sub-job arrays) re-enter :meth:`_sweep`, the
+        object-path oracle, at the end — so the two can never drift on
+        the unusual cases either.
+        """
+        from slurm_bridge_tpu.bridge.colstore import object_array as oarr
+        from slurm_bridge_tpu.bridge.colstore import object_full
+
+        t0 = time.perf_counter()
+        _sweeps.inc()
+        slow: list[str] = []
+        sizecar_creates: list[tuple[Pod, str]] = []  # (pod, owner name)
+        ordered = sorted(set(names))
+        n = len(ordered)
+        validated = self._validated_specs
+        jc, pc = jt.cols, pt.cols
+        h = pt.adapter.infos
+        sh = jt.adapter.subjobs
+        ch = pt.adapter.containers
+
+        with self.store.locked():
+            jrows = jt.rows_for(ordered)
+            found = jrows >= 0
+            jr = np.where(found, jrows, 0)
+            alive = found & ~jc.deleted[jr]
+            # validation gate (python: identity-cached per name)
+            ok = np.zeros(n, bool)
+            vget, vpop = validated.get, validated.pop
+            spec_col = jc.spec
+            for i in np.nonzero(alive)[0].tolist():
+                name = ordered[i]
+                spec = spec_col[jr[i]]
+                if vget(name) is not spec:
+                    try:
+                        validate_job_fields(name, spec)
+                    except ValidationError:
+                        slow.append(name)
+                        continue
+                    validated[name] = spec
+                ok[i] = True
+            for i in np.nonzero(~alive)[0].tolist():
+                vpop(ordered[i], None)
+            state = jc.state[jr]
+            terminal = (state == _ST_SUCCEEDED) | (state == _ST_FAILED)
+            slow.extend(ordered[i] for i in np.nonzero(ok & terminal)[0].tolist())
+            act0 = ok & ~terminal
+            slen = jc.slen[jr].astype(np.int64)
+            srows = pt.rows_for([sizecar_name(nm) for nm in ordered])
+            has_s = srows >= 0
+            sr = np.where(has_s, srows, 0)
+            missing = act0 & ~has_s
+            m_slow = missing & (slen > 0)
+            slow.extend(ordered[i] for i in np.nonzero(m_slow)[0].tolist())
+            m_create = missing & (slen == 0)
+            for i in np.nonzero(m_create)[0].tolist():
+                sizecar_creates.append(
+                    (self._build_sizecar(jt.view(int(jr[i]))), ordered[i])
+                )
+            act = (act0 & has_s) | m_create
+            pod_phase = np.where(has_s, pc.phase[sr], _POD_PHASE_PENDING)
+            ilen = np.where(has_s, pc.ilen[sr], 0).astype(np.int64)
+            pod_reason = np.where(has_s, pc.reason[sr], "")
+            srow_node = np.where(has_s, pc.node[sr], "")
+            fb = act & ((ilen > 1) | (slen > 1))
+            obj_fallback = [ordered[i] for i in np.nonzero(fb)[0].tolist()]
+            act &= ~fb
+            new_state = CR_STATE_OF_PHASE[pod_phase]
+            old_reason = jc.reason[jr]
+            reason_changed = (
+                act & (pod_reason != "") & (old_reason != pod_reason)
+            )
+            new_reason = np.where(reason_changed, pod_reason, old_reason)
+            old_ep = jc.endpoint[jr]
+            if self.agent_endpoint:
+                ep_changed = act & (old_ep == "")
+                new_ep = np.where(ep_changed, self.agent_endpoint, old_ep)
+            else:
+                ep_changed = np.zeros(n, bool)
+                new_ep = old_ep
+            one = act & (ilen == 1)
+            ii = np.where(one, pc.istart[sr], 0)
+            fresh = one & (slen == 0)
+            both = one & (slen == 1)
+            si = np.where(both, jc.sstart[jr], 0)
+            neq = both & (
+                (sh.id[si] != h.id[ii])
+                | (sh.state[si] != h.state[ii])
+                | (sh.run_time[si] != h.run_time[ii])
+                | (sh.array_id[si] != h.array_id[ii])
+                | (sh.exit_code[si] != h.exit_code[ii])
+                | (sh.stdout[si] != h.stdout[ii])
+                | (sh.stderr[si] != h.stderr[ii])
+                | (sh.reason[si] != h.reason[ii])
+            )
+            sub_changed = fresh | neq
+            # timestamp residual: the sub stores ISO strings, the info
+            # heap datetime objects — compare per row only where every
+            # cheap field already matched
+            for i in np.nonzero(both & ~neq)[0].tolist():
+                sv, iv = int(si[i]), int(ii[i])
+                if (
+                    sh.submit[sv] != heap_iso(h, "submit", iv)
+                    or sh.start[sv] != heap_iso(h, "start", iv)
+                ):
+                    sub_changed[i] = True
+            state_changed = act & (new_state != state)
+            cr_mask = act & (
+                sub_changed | state_changed | reason_changed | ep_changed
+            )
+            has_sub = act & (sub_changed | (slen > 0))
+
+            # ---- CR update capture (value copies) ----
+            cr_idx = np.nonzero(cr_mask)[0]
+            cr_names = [ordered[i] for i in cr_idx.tolist()]
+            cr_exp = jc.rv[jr[cr_idx]].astype(np.int64)
+            cr_state_new = new_state[cr_idx].astype(np.int8)
+            cr_reason_arr = new_reason[cr_idx]
+            cr_ep_arr = new_ep[cr_idx]
+            cr_before = state[cr_idx].astype(np.int64)
+            cr_subflag = sub_changed[cr_idx]
+            sub_of_cr = np.cumsum(cr_subflag) - 1  # cr pos -> sub pos
+            sub_idx = cr_idx[cr_subflag]
+            iiv = ii[sub_idx]
+            sub_id = h.id[iiv].astype(np.int64)
+            sub_aid = h.array_id[iiv]
+            sub_state = h.state[iiv].astype(np.int8)
+            sub_exit = h.exit_code[iiv]
+            sub_rt = h.run_time[iiv].astype(np.int64)
+            sub_out = h.stdout[iiv]
+            sub_err = h.stderr[iiv]
+            sub_rsn = h.reason[iiv]
+            sub_submit = oarr([
+                heap_iso(h, "submit", int(i)) for i in iiv.tolist()
+            ])
+            sub_start = oarr([
+                heap_iso(h, "start", int(i)) for i in iiv.tolist()
+            ])
+            sub_keys = oarr([
+                (a if a else str(int(b)),)
+                for a, b in zip(sub_aid.tolist(), sub_id.tolist())
+            ])
+
+            # ---- worker capture ----
+            hs_idx = np.nonzero(has_sub)[0]
+            w_names = [worker_name(ordered[i]) for i in hs_idx.tolist()]
+            wrows = pt.rows_for(w_names)
+            w_has = wrows >= 0
+            w1 = ilen[hs_idx] == 1  # a derivable container exists
+            # derive container fields for every has-sub row with one info
+            k = len(hs_idx)
+            c_name = object_full(k, "")
+            c_state = object_full(k, "")
+            c_exit = np.zeros(k, np.int32)
+            c_reason = object_full(k, "")
+            d_idx = np.nonzero(w1)[0]
+            if d_idx.size:
+                div = ii[hs_idx[d_idx]]
+                dst = h.state[div].astype(np.int64)
+                dids = h.id[div]
+                daid = h.array_id[div]
+                decs = h.exit_code[div]
+                c_name[d_idx] = [
+                    f"job-{a if a else str(int(b))}"
+                    for a, b in zip(daid.tolist(), dids.tolist())
+                ]
+                term = dst <= 3
+                run = dst == 5
+                cs = object_full(int(d_idx.size), "waiting")
+                cs[term] = "terminated"
+                cs[run] = "running"
+                c_state[d_idx] = cs
+                snames = _STATUS_NAME_ARR[dst]
+                snames[run] = ""
+                c_reason[d_idx] = snames
+                ce = np.zeros(int(d_idx.size), np.int32)
+                for t in np.nonzero(term)[0].tolist():
+                    code = 0
+                    ec = decs[t]
+                    if ec:
+                        try:
+                            code = int(ec.split(":")[0])
+                        except ValueError:
+                            code = 0
+                    if code == 0 and dst[t] in (1, 2, 3):  # the bad ends
+                        code = 1
+                    ce[t] = code
+                c_exit[d_idx] = ce
+            w_phase = pod_phase[hs_idx].astype(np.int8)
+            # creates: no worker row yet
+            wc_pos = np.nonzero(~w_has)[0]
+            wc_names = [w_names[p] for p in wc_pos.tolist()]
+            wc_owner = oarr([ordered[hs_idx[p]] for p in wc_pos.tolist()])
+            wc_partition = oarr([
+                spec_col[jr[hs_idx[p]]].partition for p in wc_pos.tolist()
+            ])
+            wc_node = srow_node[hs_idx[wc_pos]]
+            wc_phase = w_phase[wc_pos]
+            wc_hasc = w1[wc_pos]
+            wc_cname = c_name[wc_pos]
+            wc_cstate = c_state[wc_pos]
+            wc_cexit = c_exit[wc_pos]
+            wc_creason = c_reason[wc_pos]
+            # updates: worker exists and stored container/phase differ
+            we_pos = np.nonzero(w_has)[0]
+            wr = wrows[we_pos]
+            stored_n = pc.clen[wr].astype(np.int64)
+            want1 = w1[we_pos]
+            same_n = stored_n == want1.astype(np.int64)
+            ci0 = np.where(stored_n == 1, pc.cstart[wr], 0)
+            fields_same = (
+                (ch.cname[ci0] == c_name[we_pos])
+                & (ch.cstate[ci0] == c_state[we_pos])
+                & (ch.cexit[ci0] == c_exit[we_pos])
+                & (ch.creason[ci0] == c_reason[we_pos])
+            )
+            phase_same = pc.phase[wr] == w_phase[we_pos]
+            skip = same_n & (~want1 | fields_same) & phase_same
+            wu = we_pos[~skip]
+            wu_names = [w_names[p] for p in wu.tolist()]
+            wu_owner = [ordered[hs_idx[p]] for p in wu.tolist()]
+            wu_exp = pc.rv[wrows[wu]].astype(np.int64)
+            wu_phase = w_phase[wu]
+            wu_hasc = w1[wu]
+            wu_cname = c_name[wu]
+            wu_cstate = c_state[wu]
+            wu_cexit = c_exit[wu]
+            wu_creason = c_reason[wu]
+
+        # ---- commits: creates first, then updates (oracle order) ----
+        if sizecar_creates:
+            results = self.store.create_batch(
+                [pod for pod, _ in sizecar_creates], site="operator.sweep"
+            )
+            for (pod, owner), res in zip(sizecar_creates, results):
+                if not isinstance(res, Exception):
+                    self.events.emit(
+                        BridgeJob.KIND, owner, Reason.POD_CREATED,
+                        f"sizecar pod {pod.meta.name} created",
+                    )
+        if wc_names:
+            empty_fd = FrozenDict()
+
+            def builder(rows, sel):
+                m = len(sel)
+                pc.name[rows] = oarr([wc_names[p] for p in sel.tolist()])
+                pc.uid[rows] = oarr([new_uid() for _ in range(m)])
+                pc.labels[rows] = oarr([
+                    self._worker_labels(p) for p in wc_partition[sel].tolist()
+                ])
+                pc.ann[rows] = object_full(m, empty_fd)
+                pc.owner[rows] = wc_owner[sel]
+                pc.deleted[rows] = False
+                pc.role[rows] = object_full(m, PodRole.WORKER)
+                pc.partition[rows] = wc_partition[sel]
+                pc.demand[rows] = object_full(m, None)
+                pc.node[rows] = wc_node[sel]
+                pc.hint[rows] = object_full(m, ())
+                pc.phase[rows] = wc_phase[sel]
+                pc.reason[rows] = object_full(m, "")
+                pc.job_ids[rows] = object_full(m, ())
+                pc.njobs[rows] = 0
+                pc.istart[rows] = 0
+                pc.ilen[rows] = 0
+                hasc = wc_hasc[sel]
+                rows_c = rows[hasc]
+                kk = int(rows_c.size)
+                if kk:
+                    start = ch.alloc(kk)
+                    tgt = np.arange(start, start + kk, dtype=np.int64)
+                    src = sel[hasc]
+                    ch.cname[tgt] = wc_cname[src]
+                    ch.cstate[tgt] = wc_cstate[src]
+                    ch.cexit[tgt] = wc_cexit[src]
+                    ch.creason[tgt] = wc_creason[src]
+                    pc.cstart[rows_c] = tgt
+                    pc.clen[rows_c] = 1
+                rows_n = rows[~hasc]
+                pc.cstart[rows_n] = 0
+                pc.clen[rows_n] = 0
+
+            self.store.create_rows(
+                Pod.KIND, wc_names, builder, site="operator.sweep"
+            )
+        if cr_names:
+
+            def cr_writer(rws, sel):
+                jc.state[rws] = cr_state_new[sel]
+                jc.reason[rws] = cr_reason_arr[sel]
+                jc.endpoint[rws] = cr_ep_arr[sel]
+                m = cr_subflag[sel]
+                rows_sub = rws[m]
+                if not rows_sub.size:
+                    return
+                sh.retire(int(jc.slen[rows_sub].sum()))
+                kk = int(rows_sub.size)
+                start = sh.alloc(kk)
+                tgt = np.arange(start, start + kk, dtype=np.int64)
+                src = sub_of_cr[sel[m]]
+                sh.id[tgt] = sub_id[src]
+                sh.array_id[tgt] = sub_aid[src]
+                sh.state[tgt] = sub_state[src]
+                sh.exit_code[tgt] = sub_exit[src]
+                sh.submit[tgt] = sub_submit[src]
+                sh.start[tgt] = sub_start[src]
+                sh.run_time[tgt] = sub_rt[src]
+                sh.stdout[tgt] = sub_out[src]
+                sh.stderr[tgt] = sub_err[src]
+                sh.reason[tgt] = sub_rsn[src]
+                jc.sstart[rows_sub] = tgt
+                jc.slen[rows_sub] = 1
+                jc.skeys[rows_sub] = sub_keys[src]
+                jt.adapter._maybe_compact_subjobs(jt)
+
+            results = self.store.update_rows(
+                BridgeJob.KIND, cr_names, cr_exp, cr_writer,
+                site="operator.sweep",
+            )
+            before_l = cr_before.tolist()
+            after_l = cr_state_new.tolist()
+            ev_groups: dict[tuple[str, bool], list[tuple[str, str]]] = {}
+            for p, rc in enumerate(results.tolist()):
+                name = cr_names[p]
+                if rc <= 0:
+                    # racing writer / vanished: the oracle re-reads
+                    slow.append(name)
+                    continue
+                before, after = before_l[p], after_l[p]
+                if before == after:
+                    continue
+                r = _STATE_REASONS.get(STATE_STRS[after])
+                if r:
+                    ev_groups.setdefault((r, after == _ST_FAILED), []).append(
+                        (name,
+                         f"state {STATE_STRS[before]} -> {STATE_STRS[after]}")
+                    )
+                if after in (_ST_SUCCEEDED, _ST_FAILED):
+                    slow.append(name)  # just finished: result pass
+            for (r, warn), pairs in ev_groups.items():
+                self.events.emit_batch(
+                    BridgeJob.KIND, r, pairs, warning=warn
+                )
+        if wu_names:
+
+            def w_writer(rws, sel):
+                pc.phase[rws] = wu_phase[sel]
+                hasc = wu_hasc[sel]
+                ch.retire(int(pc.clen[rws].sum()))
+                rows_c = rws[hasc]
+                kk = int(rows_c.size)
+                if kk:
+                    start = ch.alloc(kk)
+                    tgt = np.arange(start, start + kk, dtype=np.int64)
+                    src = sel[hasc]
+                    ch.cname[tgt] = wu_cname[src]
+                    ch.cstate[tgt] = wu_cstate[src]
+                    ch.cexit[tgt] = wu_cexit[src]
+                    ch.creason[tgt] = wu_creason[src]
+                    pc.cstart[rows_c] = tgt
+                    pc.clen[rows_c] = 1
+                rows_n = rws[~hasc]
+                pc.cstart[rows_n] = 0
+                pc.clen[rows_n] = 0
+                pt.adapter._maybe_compact_containers(pt)
+
+            results = self.store.update_rows(
+                Pod.KIND, wu_names, wu_exp, w_writer, site="operator.sweep"
+            )
+            for owner, rc in zip(wu_owner, results.tolist()):
+                if rc <= 0:
+                    slow.append(owner)
+        if obj_fallback:
+            # shapes the fast path doesn't model take the object-path
+            # sweep — the same oracle the fuzzed equivalence test pins
+            slow.extend(self._sweep(span, obj_fallback))
+        span.count("owners", len(ordered))
+        span.count("creates", len(sizecar_creates) + len(wc_names))
+        span.count("updates", len(cr_names) + len(wu_names))
         span.count("slow", len(set(slow)))
         _reconcile_seconds.observe(time.perf_counter() - t0)
         return sorted(set(slow))

@@ -1,5 +1,16 @@
 """In-process object store — the API-server seam of the control plane.
 
+Columnar hot state (PR-6): the high-churn kinds (``Pod``, ``BridgeJob``)
+are stored as column-oriented tables (:mod:`bridge.colstore` machinery,
+:mod:`bridge.columns` schemas) instead of frozen object graphs. Every
+caller keeps this class's contract — ``get``/``list`` still hand out
+immutable frozen snapshots (materialized lazily, cached per resource
+version), writers still pay optimistic concurrency, watches/indexes/
+``changes_since``/commit attribution behave identically — but the hot
+write paths (:meth:`update_rows`, :meth:`create_rows`) commit straight
+to rows, so a cold-start tick's ~135k commits build zero frozen objects
+for anything nothing reads.
+
 The reference's controllers converge on the K8s API server: optimistic
 concurrency via resourceVersion, label-selector lists, watches feeding
 level-triggered reconcilers, owner references for cascade behavior.
@@ -35,12 +46,17 @@ a 409 from the API server (controllers retry via requeue).
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
 import weakref
 from typing import NamedTuple
 
+import numpy as np
+
+from slurm_bridge_tpu.bridge import columns as _columns
+from slurm_bridge_tpu.bridge.colstore import ROWS_GAUGE
 from slurm_bridge_tpu.bridge.freeze import (
     FrozenInstanceError,
     freeze,
@@ -116,6 +132,7 @@ class _CommitsCollector:
 
 _COMMITS = _CommitsCollector()
 REGISTRY.register(_COMMITS)
+REGISTRY.register(ROWS_GAUGE)
 
 
 class NotFound(KeyError):
@@ -157,8 +174,14 @@ def _node_of(obj) -> str | None:
 
 
 class ObjectStore:
-    def __init__(self):
+    def __init__(self, *, columnar: tuple[str, ...] | None = None):
+        """``columnar`` selects which kinds live in column tables;
+        defaults to :data:`bridge.columns.DEFAULT_COLUMNAR`. Pass ``()``
+        for the pure frozen-object store (the equivalence oracle)."""
         self._lock = threading.RLock()
+        kinds = _columns.DEFAULT_COLUMNAR if columnar is None else tuple(columnar)
+        #: kind -> KindTable for the columnar kinds
+        self._tables = {k: _columns.make_table(k) for k in kinds}
         #: ``(kind, site) -> commits`` — the per-kind × per-callsite
         #: attribution ledger behind ``sbt_store_commits_total`` and the
         #: flight recorder's commit breakdown. Incremented inline by the
@@ -211,6 +234,10 @@ class ObjectStore:
                 if kinds is None or kind in kinds:
                     for name in objs:
                         q.put(StoreEvent("ADDED", kind, name))
+            for kind, table in self._tables.items():
+                if kinds is None or kind in kinds:
+                    for name in table.row_of:
+                        q.put(StoreEvent("ADDED", kind, name))
             self._watchers.append((q, kinds))
             self._watchers_snapshot = tuple(self._watchers)
         return q
@@ -223,13 +250,17 @@ class ObjectStore:
     # ---- index maintenance (call with the lock held) ----
 
     def _index_add(self, kind: str, name: str, obj) -> None:
-        node = _node_of(obj)
+        self._index_add_node(kind, name, _node_of(obj))
+
+    def _index_add_node(self, kind: str, name: str, node) -> None:
         if node is not None:
             self._by_node.setdefault(kind, {}).setdefault(node, set()).add(name)
             self._node_sorted[(kind, node)] = None
 
     def _index_remove(self, kind: str, name: str, obj) -> None:
-        node = _node_of(obj)
+        self._index_remove_node(kind, name, _node_of(obj))
+
+    def _index_remove_node(self, kind: str, name: str, node) -> None:
         if node is None:
             return
         bucket = self._by_node.get(kind, {}).get(node)
@@ -304,15 +335,25 @@ class ObjectStore:
     def _commit_create(self, obj, site: str = "other") -> object:
         """One insert; caller holds the lock."""
         kind, name = key = self._key(obj)
-        objs = self._by_kind.setdefault(kind, {})
-        if name in objs:
-            raise AlreadyExists(f"{key[0]}/{key[1]} already exists")
-        self._rv += 1
-        obj.meta.resource_version = self._rv
-        freeze(obj)
-        objs[name] = obj
+        table = self._tables.get(kind)
+        if table is not None:
+            if name in table.row_of:
+                raise AlreadyExists(f"{key[0]}/{key[1]} already exists")
+            self._rv += 1
+            obj.meta.resource_version = self._rv
+            freeze(obj)
+            row = table.insert(name, obj)
+            self._index_add_node(kind, name, table.adapter.node_value(table, row))
+        else:
+            objs = self._by_kind.setdefault(kind, {})
+            if name in objs:
+                raise AlreadyExists(f"{key[0]}/{key[1]} already exists")
+            self._rv += 1
+            obj.meta.resource_version = self._rv
+            freeze(obj)
+            objs[name] = obj
+            self._index_add(kind, name, obj)
         self._sorted_names[kind] = None
-        self._index_add(kind, name, obj)
         self._record_change(kind, name)
         ckey = (kind, site)
         self.commit_counts[ckey] = self.commit_counts.get(ckey, 0) + 1
@@ -350,8 +391,17 @@ class ObjectStore:
 
     def get(self, kind: str, name: str) -> object:
         """The current frozen snapshot — shared, zero-copy. To modify,
-        use :meth:`mutate` or :meth:`get_for_update`."""
+        use :meth:`mutate` or :meth:`get_for_update`. For columnar kinds
+        the snapshot is a lazily-materialized view, cached per resource
+        version, so repeated reads share one object exactly like the
+        object-backed kinds."""
         with self._lock:
+            table = self._tables.get(kind)
+            if table is not None:
+                row = table.row_of.get(name)
+                if row is None:
+                    raise NotFound(f"{kind}/{name}")
+                return table.view(row)
             try:
                 return self._by_kind[kind][name]
             except KeyError:
@@ -368,6 +418,9 @@ class ObjectStore:
         bulk-read consumers (the operator sweep) decide between per-key
         lookups and a full list() by dirty-set FRACTION, not just size."""
         with self._lock:
+            table = self._tables.get(kind)
+            if table is not None:
+                return len(table.row_of)
             return len(self._by_kind.get(kind, {}))
 
     def get_for_update(self, kind: str, name: str) -> object:
@@ -388,20 +441,41 @@ class ObjectStore:
     def _commit_update(self, obj, site: str = "other") -> object:
         """One optimistic write; caller holds the lock."""
         kind, name = self._key(obj)
-        objs = self._by_kind.get(kind, {})
-        current = objs.get(name)
-        if current is None:
-            raise NotFound(f"{kind}/{name}")
-        if current.meta.resource_version != obj.meta.resource_version:
-            raise Conflict(
-                f"{kind}/{name}: stale resource_version "
-                f"{obj.meta.resource_version} != {current.meta.resource_version}"
-            )
-        self._rv += 1
-        obj.meta.resource_version = self._rv
-        freeze(obj)
-        objs[name] = obj
-        self._index_move(kind, name, current, obj)
+        table = self._tables.get(kind)
+        if table is not None:
+            row = table.row_of.get(name)
+            if row is None:
+                raise NotFound(f"{kind}/{name}")
+            current_rv = int(table.cols.rv[row])
+            if current_rv != obj.meta.resource_version:
+                raise Conflict(
+                    f"{kind}/{name}: stale resource_version "
+                    f"{obj.meta.resource_version} != {current_rv}"
+                )
+            old_node = table.adapter.node_value(table, row)
+            self._rv += 1
+            obj.meta.resource_version = self._rv
+            freeze(obj)
+            table.replace(row, obj)
+            new_node = table.adapter.node_value(table, row)
+            if old_node != new_node:
+                self._index_remove_node(kind, name, old_node)
+                self._index_add_node(kind, name, new_node)
+        else:
+            objs = self._by_kind.get(kind, {})
+            current = objs.get(name)
+            if current is None:
+                raise NotFound(f"{kind}/{name}")
+            if current.meta.resource_version != obj.meta.resource_version:
+                raise Conflict(
+                    f"{kind}/{name}: stale resource_version "
+                    f"{obj.meta.resource_version} != {current.meta.resource_version}"
+                )
+            self._rv += 1
+            obj.meta.resource_version = self._rv
+            freeze(obj)
+            objs[name] = obj
+            self._index_move(kind, name, current, obj)
         self._record_change(kind, name)
         ckey = (kind, site)
         self.commit_counts[ckey] = self.commit_counts.get(ckey, 0) + 1
@@ -442,17 +516,30 @@ class ObjectStore:
         semantics — one level was not enough, a BridgeJob→Pod→owned-object
         chain leaked the leaves)."""
         with self._lock:
-            objs = self._by_kind.get(kind, {})
-            if name not in objs:
+            table = self._tables.get(kind)
+            exists = (
+                name in table.row_of
+                if table is not None
+                else name in self._by_kind.get(kind, {})
+            )
+            if not exists:
                 raise NotFound(f"{kind}/{name}")
             self._delete_one(kind, name)
             frontier = {name}
             while frontier:
                 owned = sorted(
-                    (k, n)
-                    for k, kobjs in self._by_kind.items()
-                    for n, o in kobjs.items()
-                    if getattr(o.meta, "owner", "") in frontier
+                    itertools.chain(
+                        (
+                            (k, n)
+                            for k, kobjs in self._by_kind.items()
+                            for n, o in kobjs.items()
+                            if getattr(o.meta, "owner", "") in frontier
+                        ),
+                        *(
+                            t.names_owned_by(frontier)
+                            for t in self._tables.values()
+                        ),
+                    )
                 )
                 frontier = set()
                 for okind, oname in owned:
@@ -460,9 +547,16 @@ class ObjectStore:
                     frontier.add(oname)
 
     def _delete_one(self, kind: str, name: str) -> None:
-        obj = self._by_kind[kind].pop(name)
+        table = self._tables.get(kind)
+        if table is not None:
+            row = table.row_of[name]
+            node = table.adapter.node_value(table, row)
+            table.release(name)
+            self._index_remove_node(kind, name, node)
+        else:
+            obj = self._by_kind[kind].pop(name)
+            self._index_remove(kind, name, obj)
         self._sorted_names[kind] = None
-        self._index_remove(kind, name, obj)
         self._rv += 1
         self._record_delete(kind, name)
         self._notify("DELETED", kind, name)
@@ -472,7 +566,9 @@ class ObjectStore:
     def _names(self, kind: str) -> list[str]:
         names = self._sorted_names.get(kind)
         if names is None:
-            names = sorted(self._by_kind.get(kind, {}))
+            table = self._tables.get(kind)
+            source = table.row_of if table is not None else self._by_kind.get(kind, {})
+            names = sorted(source)
             self._sorted_names[kind] = names
         return names
 
@@ -480,8 +576,13 @@ class ObjectStore:
         """Name-sorted frozen snapshots of every object of ``kind``."""
         t0 = time.perf_counter()
         with self._lock:
-            objs = self._by_kind.get(kind, {})
-            out = [objs[n] for n in self._names(kind)]
+            table = self._tables.get(kind)
+            if table is not None:
+                row_of = table.row_of
+                out = [table.view(row_of[n]) for n in self._names(kind)]
+            else:
+                objs = self._by_kind.get(kind, {})
+                out = [objs[n] for n in self._names(kind)]
         if labels:
             out = [
                 o
@@ -506,8 +607,13 @@ class ObjectStore:
             if names is None:
                 names = sorted(bucket)
                 self._node_sorted[(kind, node_name)] = names
-            objs = self._by_kind.get(kind, {})
-            out = [objs[n] for n in names]
+            table = self._tables.get(kind)
+            if table is not None:
+                row_of = table.row_of
+                out = [table.view(row_of[n]) for n in names]
+            else:
+                objs = self._by_kind.get(kind, {})
+                out = [objs[n] for n in names]
         _list_seconds.observe(time.perf_counter() - t0)
         return out
 
@@ -515,6 +621,13 @@ class ObjectStore:
         """Name-sorted (same order as :meth:`list` — reconcilers iterating
         owned sets must be deterministic) frozen snapshots."""
         with self._lock:
+            table = self._tables.get(kind)
+            if table is not None:
+                owner_col = table.cols.owner
+                names = sorted(
+                    n for n, r in table.row_of.items() if owner_col[r] == owner
+                )
+                return [table.view(table.row_of[n]) for n in names]
             return sorted(
                 (
                     o
@@ -548,6 +661,185 @@ class ObjectStore:
                 if r > since_rv
             )
         return rv, changed, deleted
+
+    # ---- columnar row access (the PR-6 hot paths) ----
+
+    def table(self, kind: str):
+        """The :class:`~bridge.colstore.KindTable` backing ``kind``, or
+        None when the kind is object-backed. Consumers that read columns
+        directly must hold :meth:`locked` while touching them."""
+        return self._tables.get(kind)
+
+    def locked(self):
+        """The store lock, for column readers: ``with store.locked():``."""
+        return self._lock
+
+    def rows_by_node(self, kind: str, node_name: str) -> tuple[list[str], np.ndarray]:
+        """``(names, rows)`` of the node-index bucket, name-sorted — the
+        column-level sibling of :meth:`list_by_node` (no views built)."""
+        table = self._tables[kind]
+        with self._lock:
+            bucket = self._by_node.get(kind, {}).get(node_name)
+            if not bucket:
+                return [], np.empty(0, np.int64)
+            names = self._node_sorted.get((kind, node_name))
+            if names is None:
+                names = sorted(bucket)
+                self._node_sorted[(kind, node_name)] = names
+            return names, table.rows_for(names)
+
+    def update_rows(
+        self,
+        kind: str,
+        names: list[str],
+        expected_rv,
+        writer,
+        *,
+        site: str = "other",
+        node_to=None,
+    ) -> np.ndarray:
+        """Batch optimistic row-commit for a columnar kind.
+
+        Resolves ``names`` → rows under ONE lock acquisition, drops
+        entries that vanished (NotFound) or whose row rv moved past
+        ``expected_rv`` (Conflict; pass None to skip the check), then
+        calls ``writer(rows, sel)`` once — ``rows`` are the surviving row
+        indices, ``sel`` their positions in ``names`` — to scatter column
+        values. The store does everything :meth:`update_batch` would per
+        object: sequential resource versions in caller order, dirty-set
+        records, MODIFIED watch events, node-index moves (via
+        ``node_to``, an array of new node keys aligned with ``names`` —
+        writers must NOT touch the node column themselves), commit
+        attribution. View caches invalidate by construction (the rv
+        moves past the cached one).
+
+        Returns an int64 array aligned with ``names``: the new rv on
+        success, 0 for NotFound, -1 for Conflict.
+        """
+        table = self._tables[kind]
+        n = len(names)
+        out = np.zeros(n, np.int64)
+        with self._lock:
+            rows = table.rows_for(names)
+            found = rows >= 0
+            ok = found.copy()
+            if expected_rv is not None and n:
+                cur = table.cols.rv[np.where(found, rows, 0)]
+                ok &= cur == np.asarray(expected_rv, np.int64)
+            out[found & ~ok] = -1
+            sel = np.nonzero(ok)[0]
+            if not sel.size:
+                return out
+            okrows = rows[sel]
+            writer(okrows, sel)
+            if node_to is not None:
+                node_col = table.cols.col(table.adapter.node_col)
+                for pos, row in zip(sel.tolist(), okrows.tolist()):
+                    old = node_col[row]
+                    new = node_to[pos]
+                    if old != new:
+                        name = names[pos]
+                        self._index_remove_node(
+                            kind, name, old if isinstance(old, str) else None
+                        )
+                        self._index_add_node(
+                            kind, name, new if isinstance(new, str) else None
+                        )
+                        node_col[row] = new
+            base = self._rv
+            new_rvs = base + 1 + np.arange(sel.size, dtype=np.int64)
+            table.cols.rv[okrows] = new_rvs
+            self._rv = int(base + sel.size)
+            out[sel] = new_rvs
+            changed = self._changed.setdefault(kind, {})
+            tombs = self._tombstones.get(kind)
+            names_sel = (
+                list(names)
+                if sel.size == n
+                else [names[p] for p in sel.tolist()]
+            )
+            changed.update(zip(names_sel, new_rvs.tolist()))
+            if tombs:
+                for name in names_sel:
+                    tombs.pop(name, None)
+            # per-queue event order matches the per-name loop (queues are
+            # independent); hoisting the watcher filter halves the tail
+            for q, kinds in self._watchers_snapshot:
+                if kinds is None or kind in kinds:
+                    put = q.put
+                    for name in names_sel:
+                        put(StoreEvent("MODIFIED", kind, name))
+            table.rows_written += int(sel.size)
+            ckey = (kind, site)
+            self.commit_counts[ckey] = self.commit_counts.get(ckey, 0) + int(sel.size)
+        self._span_commits(kind, site, int(sel.size))
+        return out
+
+    def create_rows(
+        self, kind: str, names: list[str], builder, *, site: str = "other"
+    ) -> np.ndarray:
+        """Batch row-insert for a columnar kind (:meth:`create_batch`'s
+        row-level sibling). Names already present are skipped
+        (AlreadyExists semantics, 0 in the result); ``builder(rows,
+        sel)`` must fill EVERY schema column for the fresh rows
+        (segments via the adapter's heaps) except ``rv``, which the
+        store assigns. Returns new rv per name (0 = already existed)."""
+        table = self._tables[kind]
+        n = len(names)
+        out = np.zeros(n, np.int64)
+        with self._lock:
+            row_of = table.row_of
+            sel_list: list[int] = []
+            fresh: list[str] = []
+            seen: set[str] = set()
+            for i, name in enumerate(names):
+                if name in row_of or name in seen:
+                    continue
+                seen.add(name)
+                sel_list.append(i)
+                fresh.append(name)
+            if not sel_list:
+                return out
+            sel = np.asarray(sel_list, np.int64)
+            rows = table.alloc_bulk(fresh)
+            row_list = rows.tolist()
+            builder(rows, sel)
+            base = self._rv
+            new_rvs = base + 1 + np.arange(sel.size, dtype=np.int64)
+            table.cols.rv[rows] = new_rvs
+            self._rv = int(base + sel.size)
+            out[sel] = new_rvs
+            self._sorted_names[kind] = None
+            changed = self._changed.setdefault(kind, {})
+            tombs = self._tombstones.get(kind)
+            adapter = table.adapter
+            names_sel = [names[p] for p in sel_list]
+            for name, row in zip(names_sel, row_list):
+                self._index_add_node(kind, name, adapter.node_value(table, row))
+            changed.update(zip(names_sel, new_rvs.tolist()))
+            if tombs:
+                for name in names_sel:
+                    tombs.pop(name, None)
+            for q, kinds in self._watchers_snapshot:
+                if kinds is None or kind in kinds:
+                    put = q.put
+                    for name in names_sel:
+                        put(StoreEvent("ADDED", kind, name))
+            table.rows_written += int(sel.size)
+            ckey = (kind, site)
+            self.commit_counts[ckey] = self.commit_counts.get(ckey, 0) + int(sel.size)
+        self._span_commits(kind, site, int(sel.size))
+        return out
+
+    def view_builds_total(self) -> int:
+        """Frozen views materialized across columnar kinds — the
+        view-materialization pressure diagnostic (``decoded_views_total``
+        in the sim headline)."""
+        return sum(t.view_builds for t in self._tables.values())
+
+    def rows_written_total(self) -> int:
+        """Commits that went through the columnar row path."""
+        return sum(t.rows_written for t in self._tables.values())
 
     # ---- convenience used by reconcilers ----
 

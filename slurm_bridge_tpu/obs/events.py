@@ -66,11 +66,26 @@ class EventRecorder:
         self._sinks.append(fn)
 
     def event(self, obj, reason: str, message: str, *, warning: bool = False) -> Event:
+        return self.emit(
+            type(obj).__name__ if obj is not None else "",
+            getattr(obj, "name", "") if obj is not None else "",
+            reason,
+            message,
+            warning=warning,
+        )
+
+    def emit(
+        self, kind: str, name: str, reason: str, message: str,
+        *, warning: bool = False,
+    ) -> Event:
+        """The object-free form: columnar hot paths (bind, batched
+        submit, sweep) record events without materializing a frozen view
+        just to read its kind and name."""
         ev = Event(
             reason=reason,
             message=message,
-            kind=type(obj).__name__ if obj is not None else "",
-            name=getattr(obj, "name", "") if obj is not None else "",
+            kind=kind,
+            name=name,
             type="Warning" if warning else "Normal",
             ts=time.time(),
         )
@@ -87,6 +102,41 @@ class EventRecorder:
         for sink in self._sinks:
             sink(ev)
         return ev
+
+    def emit_batch(
+        self,
+        kind: str,
+        reason: str,
+        pairs: list[tuple[str, str]],
+        *,
+        warning: bool = False,
+    ) -> None:
+        """Many events of one (kind, reason) in one pass — ONE lock
+        acquisition, one logger-level probe, one timestamp (the batch is
+        one logical commit; consumers key on reason/name, not ts). The
+        columnar hot paths emit 45k+ events per cold tick; the per-event
+        lock/log overhead was a visible slice of the bind phase."""
+        if not pairs:
+            return
+        t = "Warning" if warning else "Normal"
+        now = time.time()
+        evs = [
+            Event(reason=reason, message=msg, kind=kind, name=nm,
+                  type=t, ts=now)
+            for nm, msg in pairs
+        ]
+        with self._lock:
+            self._events.extend(evs)
+        level = logging.WARNING if warning else logging.INFO
+        if self._log.isEnabledFor(level):
+            for ev in evs:
+                self._log.log(
+                    level, "%s %s/%s: %s",
+                    ev.reason, ev.kind, ev.name, ev.message,
+                )
+        for sink in self._sinks:
+            for ev in evs:
+                sink(ev)
 
     def events(self, *, name: str | None = None) -> list[Event]:
         with self._lock:

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import logging
 import math
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from slurm_bridge_tpu.core.arrays import array_len
 from slurm_bridge_tpu.core.fastpath import frozen_new
@@ -121,6 +121,9 @@ class SimJob:
     end_vt: float = -1.0
     assigned: tuple[str, ...] = ()
     reason: str = ""
+    #: (entry, info_msg, signature) — the JobsInfo response cache; see
+    #: SimAgent.JobsInfo. Excluded from comparison/repr: pure memo.
+    pb_cache: tuple | None = field(default=None, repr=False, compare=False)
 
     def _run_time(self, now: float | None) -> int:
         # elapsed runtime like Slurm's RunTime: virtual now, capped at the
@@ -505,20 +508,35 @@ class SimWorkloadClient:
         """Batched JobInfo — agent/server.py parity: unknown ids come back
         found=false, the batch never aborts on one bad id.
 
-        Rows are written in place (``jobs.add()`` + ``fill_info_proto``):
-        the kwargs form built each 45k-row response out of intermediate
-        dataclasses and then COPIED every message into the response."""
+        Each job keeps a cached, pre-filled ``JobsInfoEntry``: a call
+        refills it only when the job's mutable state (state machine,
+        assignment, reason) moved, patches the always-ticking
+        ``run_time_s``, and C-level-copies it into the response
+        (``jobs.append`` copies, so no mutable message ever escapes —
+        the FaultyClient's lost_status freeze keeps true snapshots).
+        Byte-identical to the 18-Python-setattr in-place fill it
+        replaces, ~3× cheaper on the steady 45k-row mirror tick."""
         now = self.cluster.clock()
         jobs = self.cluster.jobs
         resp = pb.JobsInfoResponse()
         add = resp.jobs.add
+        append = resp.jobs.append
         for job_id in request.job_ids:
             job = jobs.get(int(job_id))
             if job is None:
                 add(job_id=job_id, found=False)
                 continue
-            entry = add(job_id=job_id, found=True)
-            job.fill_info_proto(entry.info.add(), now=now)
+            cache = job.pb_cache
+            sig = (job.state, job.assigned, job.reason)
+            if cache is None or cache[2] != sig:
+                e = pb.JobsInfoEntry(job_id=job.id, found=True)
+                m = e.info.add()
+                job.fill_info_proto(m, now=now)
+                job.pb_cache = (e, m, sig)
+            else:
+                e, m, _ = cache
+                m.run_time_s = job._run_time(now)
+            append(e)
         return resp
 
     def JobState(self, request, timeout=None) -> pb.JobStateResponse:

@@ -609,6 +609,12 @@ class SimHarness:
                 k: round(float(np.percentile(phase_arr[k], 95)), 3) for k in PHASES
             },
             "arrive_p50_ms": round(float(np.median(self._arrive_ms)), 3),
+            # view-materialization pressure (PR-6): frozen views built /
+            # commits through the columnar row path over the whole run,
+            # so re-anchors can see whether reads are eating the columnar
+            # win without re-running the flight recorder
+            "decoded_views_total": self.store.view_builds_total(),
+            "rows_written_total": self.store.rows_written_total(),
             "injected_latency_ms": round(
                 self.client.injected_latency_ms, 3
             )

@@ -243,12 +243,13 @@ class JobRowCache:
                     nm: np.zeros(n, dtype=dt) for nm, _, dt in _JOB_COLS
                 }
             if misses:
-                rows = np.array(
-                    [job_scalars(demands[p], snapshot) for p in miss_pos],
-                    dtype=np.float64,
-                ).reshape(-1, len(_JOB_COLS))
+                from slurm_bridge_tpu.solver.snapshot import job_scalars_batch
+
+                miss_cols = job_scalars_batch(
+                    [demands[p] for p in miss_pos.tolist()], snapshot
+                )
                 for nm, slot, dt in _JOB_COLS:
-                    cols[nm][miss_pos] = rows[:, slot].astype(dt)
+                    cols[nm][miss_pos] = miss_cols[slot].astype(dt)
             self._cols = cols
             self._keys = list(keys)
             self._index = {k: i for i, k in enumerate(keys)}

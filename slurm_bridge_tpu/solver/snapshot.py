@@ -370,6 +370,48 @@ def job_scalars(
     )
 
 
+def job_scalars_batch(
+    demands: list[JobDemand], snapshot: ClusterSnapshot
+) -> tuple[np.ndarray, ...]:
+    """:func:`job_scalars` over a demand list, vectorized — the encode
+    cache's miss path (a 50k-pod cold tick is 50k first encodes). One
+    Python pass touches only the stringy fields (array spec, gres), the
+    arithmetic is NumPy; held value-identical to the scalar oracle by a
+    fuzz test. Returns arrays in ``_JOB_COLS`` slot order:
+    (cpu, mem, gpu, part, feat, nshards, prio)."""
+    n = len(demands)
+    cpt = np.fromiter((d.cpus_per_task for d in demands), np.int64, n)
+    ntk = np.fromiter((d.ntasks for d in demands), np.int64, n)
+    nod = np.fromiter((d.nodes for d in demands), np.int64, n)
+    mpc = np.fromiter((d.mem_per_cpu_mb for d in demands), np.float64, n)
+    prio = np.fromiter((float(d.priority) for d in demands), np.float64, n)
+    arr = np.ones(n, np.int64)
+    gres_rows: list[int] = []
+    for i, d in enumerate(demands):
+        if d.array:
+            arr[i] = array_len(d.array)
+        if d.gres:
+            gres_rows.append(i)
+    nshards = np.maximum(1, nod)
+    total = (
+        np.maximum(1, cpt) * np.maximum(1, ntk) * np.maximum(1, arr)
+    ).astype(np.float64)
+    cpu = total / nshards
+    mem = cpu * np.where(mpc != 0, mpc, 1024.0)
+    gpu = np.zeros(n, np.float64)
+    feat = np.zeros(n, np.uint32)
+    fc = snapshot.feature_codes
+    for i in gres_rows:
+        d = demands[i]
+        gpu[i] = float(_gres_gpu_count(d.gres)) * max(1, int(arr[i]))
+        feat[i] = _required_features(d, fc)
+    pc = snapshot.partition_codes
+    part = np.fromiter(
+        (pc.get(d.partition, -1) for d in demands), np.int32, n
+    )
+    return cpu, mem, gpu, part, feat, nshards, prio
+
+
 def batch_from_scalars(
     scalars: list[tuple[float, float, float, int, int, int, float]],
     *,

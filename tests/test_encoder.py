@@ -277,3 +277,35 @@ def test_feature_mask_overflow_counts_and_warns(caplog):
         encode_cluster(nodes, parts)
     assert not caplog.records
     assert snap_mod._features_dropped.value() == before + 2
+
+
+def test_job_scalars_batch_matches_scalar_oracle():
+    """The vectorized miss path (PR-6) must be value-identical to the
+    per-demand job_scalars the loop oracle and cache share."""
+    import random
+
+    from slurm_bridge_tpu.solver.snapshot import job_scalars, job_scalars_batch
+
+    partitions, nodes, demands = random_inventory(
+        200, 500, seed=9, load=0.7, gpu_fraction=0.3, gang_fraction=0.2
+    )
+    inv = EncodedInventory()
+    snap = inv.refresh(nodes, partitions)
+    rng = random.Random(9)
+    import dataclasses
+
+    spiced = []
+    for d in demands:
+        kw = {}
+        if rng.random() < 0.3:
+            kw["array"] = rng.choice(["", "0-3", "1,5,9", "0-99:2"])
+        if rng.random() < 0.3:
+            kw["gres"] = rng.choice(["", "gpu:2", "gpu:a100:4", "fpga:1"])
+        if rng.random() < 0.2:
+            kw["mem_per_cpu_mb"] = 0
+        spiced.append(dataclasses.replace(d, **kw) if kw else d)
+    batch = job_scalars_batch(spiced, snap)
+    for i, d in enumerate(spiced):
+        oracle = job_scalars(d, snap)
+        got = tuple(col[i] for col in batch)
+        assert got == oracle, (i, d, got, oracle)

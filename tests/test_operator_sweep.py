@@ -236,9 +236,10 @@ def test_sweep_conflict_falls_back_to_oracle(monkeypatch):
     store.create(_sizecar("racy", phase=PodPhase.RUNNING, infos=[_info(9001)]))
 
     real_update_batch = store.update_batch
+    real_update_rows = store.update_rows
     raced = {"done": False}
 
-    def racing_update_batch(objs, **kw):
+    def interleave():
         if not raced["done"]:
             raced["done"] = True
             # interleaved writer: rewrites the CR (same content, new rv)
@@ -246,9 +247,18 @@ def test_sweep_conflict_falls_back_to_oracle(monkeypatch):
                 BridgeJob.KIND, "racy",
                 lambda j: fast_replace(j, meta=fast_replace(j.meta)),
             )
+
+    def racing_update_batch(objs, **kw):
+        interleave()
         return real_update_batch(objs, **kw)
 
+    def racing_update_rows(kind, names, expected_rv, writer, **kw):
+        if kind == BridgeJob.KIND:
+            interleave()
+        return real_update_rows(kind, names, expected_rv, writer, **kw)
+
     monkeypatch.setattr(store, "update_batch", racing_update_batch)
+    monkeypatch.setattr(store, "update_rows", racing_update_rows)
     slow = op.sweep(["racy"])
     assert slow == ["racy"]
     monkeypatch.undo()
