@@ -1,0 +1,23 @@
+"""Sim scenario: the bridge process dies mid-run and recovers from WAL.
+
+At tick 6 the whole control plane (store, operator, configurator,
+scheduler) is dropped WITHOUT a graceful flush; a fresh stack reloads
+from snapshot+WAL and level-triggered sync re-converges against the sim
+agent's live ground truth — zero invariant violations, zero VirtualNode
+deletions, and a final state byte-identical to the fault-free run
+(docs/persistence.md).
+
+    python -m benchmarks.scenarios.sim_crash_restart [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.crash_restart``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import crash_restart as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "crash_restart"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

@@ -1,0 +1,22 @@
+"""Sim scenario: leadership changes hands twice without node flap.
+
+A graceful step-down at tick 4 (lease released; the standby takes over
+the same tick) and a silent leader crash at tick 10 (the standby must
+wait out lease expiry — a real leaderless window in which arrivals queue
+and replay). Both takeovers rebuild the stack from snapshot+WAL with
+ZERO VirtualNode deletions (docs/persistence.md).
+
+    python -m benchmarks.scenarios.sim_leader_failover [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.leader_failover``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import leader_failover as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "leader_failover"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

@@ -326,10 +326,15 @@ def profile_reconcile(n_jobs: int = 2_000, *, iters: int = 3) -> dict:
     - **dirty** — sweep after every sizecar went Running: N CR status
       replacements + N worker-pod creates, two lock acquisitions total;
     - **steady** — the no-change sweep, which must perform ZERO store
-      writes (``steady_writes`` is asserted by ``make bench-smoke``).
+      writes (``steady_writes`` is asserted by ``make bench-smoke``),
+      and — with WAL persistence attached (PR-7) — a steady flush must
+      append ZERO records and build ZERO frozen views
+      (``steady_wal_records`` rides the same hard gate).
     """
     import dataclasses as dc
     import logging
+    import os
+    import tempfile
 
     from slurm_bridge_tpu.bridge.objects import (
         BridgeJob,
@@ -343,63 +348,82 @@ def profile_reconcile(n_jobs: int = 2_000, *, iters: int = 3) -> dict:
     from slurm_bridge_tpu.core.types import JobInfo, JobStatus
     from slurm_bridge_tpu.obs.events import EventRecorder
 
+    from slurm_bridge_tpu.bridge.persist import StorePersistence
+
     logging.getLogger("sbt.events").setLevel(logging.CRITICAL)
     create_ms, dirty_ms, steady_ms = [], [], []
     steady_writes = 0
     steady_views = 0
-    for _ in range(iters):
-        store = ObjectStore()
-        op = BridgeOperator(
-            store, agent_endpoint="bench://agent", events=EventRecorder()
-        )
-        names = [f"bench-{i:05d}" for i in range(n_jobs)]
-        for n in names:
-            store.create(
-                BridgeJob(
-                    meta=Meta(name=n),
-                    spec=BridgeJobSpec(
-                        partition="debug", sbatch_script="#!/bin/sh\ntrue\n"
-                    ),
-                )
+    steady_wal_records = 0
+    tmpdir = tempfile.mkdtemp(prefix="sbt-stages-wal-")
+    try:
+        for it in range(iters):
+            store = ObjectStore()
+            # WAL persistence rides along in manual-flush mode: the
+            # dirty-aware skip means a steady-state flush is a changes_since
+            # probe and NOTHING else — no file I/O, no frozen views
+            persist = StorePersistence(
+                store,
+                os.path.join(tmpdir, f"state-{it}.json"),
+                auto_flush=False,
             )
-        t0 = time.perf_counter()
-        op.sweep(names)
-        create_ms.append((time.perf_counter() - t0) * 1e3)
-        # what a mirrored submit tick leaves behind: every sizecar Running
-        # with one live job info
-        store.update_batch(
-            [
-                Pod(
-                    meta=dc.replace(p.meta),
-                    spec=p.spec,
-                    status=dc.replace(
-                        p.status,
-                        phase=PodPhase.RUNNING,
-                        job_ids=(1000 + i,),
-                        job_infos=[
-                            JobInfo(
-                                id=1000 + i,
-                                state=JobStatus.RUNNING,
-                                name=p.meta.owner,
-                            )
-                        ],
-                    ),
+            op = BridgeOperator(
+                store, agent_endpoint="bench://agent", events=EventRecorder()
+            )
+            names = [f"bench-{i:05d}" for i in range(n_jobs)]
+            for n in names:
+                store.create(
+                    BridgeJob(
+                        meta=Meta(name=n),
+                        spec=BridgeJobSpec(
+                            partition="debug", sbatch_script="#!/bin/sh\ntrue\n"
+                        ),
+                    )
                 )
-                for i, p in enumerate(
-                    store.get(Pod.KIND, sizecar_name(n)) for n in names
-                )
-            ]
-        )
-        t0 = time.perf_counter()
-        op.sweep(names)
-        dirty_ms.append((time.perf_counter() - t0) * 1e3)
-        rv_before = store.changes_since(Pod.KIND, 0)[0]
-        views_before = store.view_builds_total()
-        t0 = time.perf_counter()
-        op.sweep(names)
-        steady_ms.append((time.perf_counter() - t0) * 1e3)
-        steady_writes += store.changes_since(Pod.KIND, 0)[0] - rv_before
-        steady_views += store.view_builds_total() - views_before
+            t0 = time.perf_counter()
+            op.sweep(names)
+            create_ms.append((time.perf_counter() - t0) * 1e3)
+            # what a mirrored submit tick leaves behind: every sizecar Running
+            # with one live job info
+            store.update_batch(
+                [
+                    Pod(
+                        meta=dc.replace(p.meta),
+                        spec=p.spec,
+                        status=dc.replace(
+                            p.status,
+                            phase=PodPhase.RUNNING,
+                            job_ids=(1000 + i,),
+                            job_infos=[
+                                JobInfo(
+                                    id=1000 + i,
+                                    state=JobStatus.RUNNING,
+                                    name=p.meta.owner,
+                                )
+                            ],
+                        ),
+                    )
+                    for i, p in enumerate(
+                        store.get(Pod.KIND, sizecar_name(n)) for n in names
+                    )
+                ]
+            )
+            t0 = time.perf_counter()
+            op.sweep(names)
+            dirty_ms.append((time.perf_counter() - t0) * 1e3)
+            persist.flush()  # drain the create/dirty backlog into the WAL
+            rv_before = store.changes_since(Pod.KIND, 0)[0]
+            views_before = store.view_builds_total()
+            t0 = time.perf_counter()
+            op.sweep(names)
+            steady_wal_records += persist.flush()  # steady flush: must be 0
+            steady_ms.append((time.perf_counter() - t0) * 1e3)
+            steady_writes += store.changes_since(Pod.KIND, 0)[0] - rv_before
+            steady_views += store.view_builds_total() - views_before
+    finally:
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
     dirty = float(np.median(dirty_ms))
     return {
         "jobs": n_jobs,
@@ -411,7 +435,12 @@ def profile_reconcile(n_jobs: int = 2_000, *, iters: int = 3) -> dict:
         # PR-6: a no-change sweep over columnar kinds must materialize
         # ZERO frozen views — reads that sneak back onto the object path
         # are a structural regression, asserted hard by bench-smoke
+        # (the steady WAL flush happens INSIDE the measured window, so
+        # a flush that builds views trips this gate too)
         "steady_views": steady_views,
+        # PR-7: a steady-state WAL flush must append ZERO records — the
+        # dirty-aware skip is what keeps durability off the idle path
+        "steady_wal_records": steady_wal_records,
     }
 
 

@@ -33,11 +33,20 @@ no-change sweep that materializes even one frozen dataclass view for a
 columnar kind means a read snuck back onto the object path, which is a
 structural regression however fast it happens to run today.
 
+The WAL persistence layer (PR-7) adds three gates: ``steady_wal_records``
+must be HARD zero (a no-change flush appending records means the
+dirty-aware skip broke), and a sim scenario run WAL-off and WAL-on must
+(a) produce byte-identical determinism digests — durability observes the
+tick, it must never change it — and (b) keep the WAL-on tick p50 within
+the same ≤3%-or-small-epsilon overhead budget as tracing.
+
     SBT_SMOKE_ENCODE_BUDGET_MS     warm encode p50 ceiling    (default 50)
     SBT_SMOKE_MIN_SPEEDUP          encode speedup floor       (default 3)
     SBT_SMOKE_RECONCILE_BUDGET_MS  dirty-sweep ceiling, 500 jobs (default 500)
     SBT_SMOKE_TRACE_OVERHEAD_PCT   tracing-on p50 overhead ceiling (default 3)
     SBT_SMOKE_TRACE_EPS_MS         absolute overhead epsilon  (default 1.5)
+    SBT_SMOKE_WAL_OVERHEAD_PCT     WAL-on p50 overhead ceiling (default 3)
+    SBT_SMOKE_WAL_EPS_MS           absolute WAL epsilon       (default 1.5)
 """
 
 from __future__ import annotations
@@ -47,8 +56,8 @@ import os
 import sys
 
 
-def profile_trace_overhead(scale: float = 0.12, rounds: int = 3) -> dict:
-    """Measure tracing-on vs tracing-off tick cost, same seed.
+def _paired_overhead(sc_off, sc_on, rounds: int = 3) -> dict:
+    """Measure the on-arm's tick cost over the off-arm's, same seed.
 
     The workload is deterministic, so tick *i* does identical work in
     both arms. The estimator: run each arm ``rounds`` times interleaved
@@ -56,20 +65,12 @@ def profile_trace_overhead(scale: float = 0.12, rounds: int = 3) -> dict:
     each arm (noisy-neighbor steal only ever ADDS time, so the min is
     the clean sample), then the median of the paired per-tick deltas.
     On a shared CI box absolute p50s swing ±25% with neighbor load; this
-    estimator holds the genuine tracing cost (~0.2-0.5 ms of span
-    machinery per tick, scale-independent) to within a few hundred µs. A
+    estimator holds genuine per-tick costs to within a few hundred µs. A
     discarded warmup run absorbs import/JIT costs first. The digests of
-    the two arms must be byte-identical: span wiring observes the tick,
-    it must never change it.
+    the two arms must be byte-identical: both tracing and WAL
+    persistence OBSERVE the tick, they must never change it.
     """
-    import dataclasses
-
     from slurm_bridge_tpu.sim.harness import SimHarness
-    from slurm_bridge_tpu.sim.scenarios import SCENARIOS
-
-    base = SCENARIOS["steady_poisson"](scale=scale)
-    sc_off = dataclasses.replace(base, tracing=False)
-    sc_on = dataclasses.replace(base, tracing=True)
 
     def run(sc):
         h = SimHarness(sc)
@@ -80,14 +81,13 @@ def profile_trace_overhead(scale: float = 0.12, rounds: int = 3) -> dict:
     off_runs: list[list[float]] = []
     on_runs: list[list[float]] = []
     digest_off = digest_on = ""
-    commits = phase_sum = None
+    on_result = None
     for _ in range(rounds):
         off, o_ticks = run(sc_off)
         digest_off = off.determinism["digest"]
         on, n_ticks = run(sc_on)
         digest_on = on.determinism["digest"]
-        commits = on.flight_record.get("commits_total")
-        phase_sum = on.flight_record.get("phase_sum_p50_ms")
+        on_result = on
         off_runs.append(o_ticks)
         on_runs.append(n_ticks)
 
@@ -115,9 +115,50 @@ def profile_trace_overhead(scale: float = 0.12, rounds: int = 3) -> dict:
         "digest_off": digest_off,
         "digest_on": digest_on,
         "digest_identical": digest_off == digest_on,
-        "flight_phase_sum_p50_ms": phase_sum,
-        "flight_commits_total": commits,
+        "_on_result": on_result,
     }
+
+
+def profile_trace_overhead(scale: float = 0.12, rounds: int = 3) -> dict:
+    """Tracing-on vs tracing-off tick cost, same seed (PR-5 gate)."""
+    import dataclasses
+
+    from slurm_bridge_tpu.sim.scenarios import SCENARIOS
+
+    base = SCENARIOS["steady_poisson"](scale=scale)
+    out = _paired_overhead(
+        dataclasses.replace(base, tracing=False),
+        dataclasses.replace(base, tracing=True),
+        rounds,
+    )
+    on = out.pop("_on_result")
+    out["flight_phase_sum_p50_ms"] = on.flight_record.get("phase_sum_p50_ms")
+    out["flight_commits_total"] = on.flight_record.get("commits_total")
+    return out
+
+
+def profile_wal_overhead(scale: float = 0.12, rounds: int = 3) -> dict:
+    """WAL-persistence-on vs -off tick cost, same seed (PR-7 gate).
+
+    The on arm flushes the write-ahead log at every tick boundary and
+    compacts periodically; the steady-state cost it is allowed to add is
+    the same ≤3%-or-epsilon budget tracing gets, and determinism must be
+    untouched (flushes only READ the store).
+    """
+    import dataclasses
+
+    from slurm_bridge_tpu.sim.scenarios import SCENARIOS
+
+    base = SCENARIOS["steady_poisson"](scale=scale)
+    out = _paired_overhead(
+        dataclasses.replace(base, persistence=False),
+        dataclasses.replace(base, persistence=True),
+        rounds,
+    )
+    on = out.pop("_on_result")
+    out["wal_records_total"] = on.timing.get("wal_records_total")
+    out["wal_snapshots_total"] = on.timing.get("wal_snapshots_total")
+    return out
 
 
 def main() -> int:
@@ -131,18 +172,27 @@ def main() -> int:
     )
     trace_pct = float(os.environ.get("SBT_SMOKE_TRACE_OVERHEAD_PCT", "3"))
     trace_eps_ms = float(os.environ.get("SBT_SMOKE_TRACE_EPS_MS", "1.5"))
+    wal_pct = float(os.environ.get("SBT_SMOKE_WAL_OVERHEAD_PCT", "3"))
+    wal_eps_ms = float(os.environ.get("SBT_SMOKE_WAL_EPS_MS", "1.5"))
     out = profile_tick(1_000, 5_000, seed=2)
     rec = profile_reconcile(500)
     trace = profile_trace_overhead()
+    wal = profile_wal_overhead()
     out["reconcile"] = rec
     out["tracing"] = trace
+    out["wal"] = wal
     out["encode_budget_ms"] = budget_ms
     out["min_speedup"] = min_speedup
     out["reconcile_budget_ms"] = rec_budget_ms
     out["trace_overhead_budget_pct"] = trace_pct
+    out["wal_overhead_budget_pct"] = wal_pct
     trace_ok = trace["digest_identical"] and (
         trace["overhead_ms"] <= trace_eps_ms
         or trace["overhead_pct"] <= trace_pct
+    )
+    wal_ok = wal["digest_identical"] and (
+        wal["overhead_ms"] <= wal_eps_ms
+        or wal["overhead_pct"] <= wal_pct
     )
     ok = (
         out["encode_ms"] <= budget_ms
@@ -150,7 +200,9 @@ def main() -> int:
         and rec["dirty_sweep_ms"] <= rec_budget_ms
         and rec["steady_writes"] == 0
         and rec["steady_views"] == 0
+        and rec["steady_wal_records"] == 0
         and trace_ok
+        and wal_ok
     )
     out["ok"] = ok
     print(json.dumps(out))
@@ -161,10 +213,13 @@ def main() -> int:
             f"(floor {min_speedup}x) / dirty sweep {rec['dirty_sweep_ms']} ms "
             f"(budget {rec_budget_ms}) / steady sweep writes "
             f"{rec['steady_writes']} (must be 0) / steady sweep frozen "
-            f"views {rec['steady_views']} (must be 0) / tracing overhead "
+            f"views {rec['steady_views']} (must be 0) / steady WAL records "
+            f"{rec['steady_wal_records']} (must be 0) / tracing overhead "
             f"{trace['overhead_pct']}% (budget {trace_pct}%, eps "
-            f"{trace_eps_ms} ms) / digest identical "
-            f"{trace['digest_identical']} (must be true)",
+            f"{trace_eps_ms} ms) / WAL overhead {wal['overhead_pct']}% "
+            f"(budget {wal_pct}%, eps {wal_eps_ms} ms) / digests identical "
+            f"trace={trace['digest_identical']} wal={wal['digest_identical']} "
+            "(must be true)",
             file=sys.stderr,
         )
     return 0 if ok else 1

@@ -90,7 +90,17 @@ def build_container_script(req: pb.SubmitJobContainerRequest) -> str:
 
 
 class SubmitLedger:
-    """Idempotency map submitter_id → job id, optionally persisted."""
+    """Idempotency map submitter_id → job id, optionally persisted.
+
+    The state file is the dedupe token that makes SubmitJob idempotent
+    across AGENT restarts, so its durability matters: writes go through
+    :func:`utils.files.atomic_write` (tempfile + fsync + rename — a
+    crash mid-write can never tear it), and a truncated/corrupt/
+    wrong-shape file on load degrades to an empty ledger with a warning
+    instead of killing the agent — losing dedupe history is recoverable
+    (the bridge's resume tokens still prevent resubmission storms),
+    a crash-looping agent is not.
+    """
 
     def __init__(self, state_file: str | None = None):
         self._lock = threading.Lock()
@@ -99,25 +109,32 @@ class SubmitLedger:
         if state_file and os.path.exists(state_file):
             try:
                 with open(state_file) as f:
-                    self._by_submitter = {
-                        str(k): int(v) for k, v in json.load(f).items()
-                    }
-            except (OSError, ValueError, json.JSONDecodeError):
-                log.warning("could not load submit ledger %s", state_file)
+                    raw = json.load(f)
+                if not isinstance(raw, dict):
+                    raise ValueError(f"ledger is {type(raw).__name__}, not a map")
+                self._by_submitter = {
+                    str(k): int(v) for k, v in raw.items()
+                }
+            except (OSError, ValueError, TypeError, json.JSONDecodeError) as exc:
+                log.warning(
+                    "could not load submit ledger %s (%s); starting empty",
+                    state_file, exc,
+                )
 
     def get(self, submitter_id: str) -> int | None:
         with self._lock:
             return self._by_submitter.get(submitter_id)
 
     def put(self, submitter_id: str, job_id: int) -> None:
+        from slurm_bridge_tpu.utils.files import atomic_write
+
         with self._lock:
             self._by_submitter[submitter_id] = job_id
             if self._state_file:
-                tmp = f"{self._state_file}.tmp"
                 try:
-                    with open(tmp, "w") as f:
-                        json.dump(self._by_submitter, f)
-                    os.replace(tmp, self._state_file)
+                    atomic_write(
+                        self._state_file, json.dumps(self._by_submitter)
+                    )
                 except OSError:
                     log.warning("could not persist submit ledger")
 

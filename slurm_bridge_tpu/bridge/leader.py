@@ -50,9 +50,14 @@ class LeaderElector:
         retry_interval: float = 2.0,
         on_started=None,
         on_stopped=None,
+        clock=time.time,
     ):
         self.lock_path = lock_path
         self.identity = identity or default_identity()
+        #: injectable time source — the simulator passes its virtual
+        #: clock so lease expiry is deterministic (no sleeps); production
+        #: keeps wall time
+        self._clock = clock
         self.lease_duration = lease_duration
         self.renew_interval = renew_interval
         self.retry_interval = retry_interval
@@ -87,7 +92,7 @@ class LeaderElector:
         guard = os.open(self.lock_path + ".flock", os.O_CREAT | os.O_RDWR, 0o644)
         try:
             fcntl.flock(guard, fcntl.LOCK_EX)
-            now = time.time()
+            now = self._clock()
             rec = self._read()
             if rec is not None and rec.get("holder") != self.identity:
                 if now < float(rec.get("expires", 0)):
@@ -137,7 +142,7 @@ class LeaderElector:
             try:
                 # pre-request stamp, for the same reason as the renewal
                 # loop below: expiry must be measured from what rivals see
-                acquired_at = time.time()
+                acquired_at = self._clock()
                 if self.try_acquire():
                     break
             except OSError as exc:
@@ -152,7 +157,7 @@ class LeaderElector:
             self.on_started()
         deadline = acquired_at + self.lease_duration
         while not self._stop.wait(self.renew_interval):
-            if time.time() > deadline:
+            if self._clock() > deadline:
                 # check BEFORE attempting: a slow failing attempt must not
                 # extend how long a stale holder keeps acting past expiry
                 log.error("lease expired before renewal could complete")
@@ -163,14 +168,14 @@ class LeaderElector:
                 # a post-return stamp would let a stale holder act up to
                 # ~2×request_timeout past the takeover (ADVICE r4) —
                 # client-go's leaderelection does the same
-                t0 = time.time()
+                t0 = self._clock()
                 if self.try_acquire():
                     deadline = t0 + self.lease_duration
                     continue
                 log.warning("lease stolen; stepping down")
                 break
             except OSError as exc:
-                if time.time() > deadline:
+                if self._clock() > deadline:
                     log.error("lease renewal failing past deadline: %s", exc)
                     break
                 log.warning("lease renewal error (retrying): %s", exc)
@@ -304,7 +309,7 @@ class KubeLeaseElector(LeaderElector):
     # -- the two primitives the state machine needs --
 
     def try_acquire(self) -> bool:
-        now = time.time()
+        now = self._clock()
         obj = self._get()
         if obj is None:
             return self._send(

@@ -125,6 +125,10 @@ class Bridge:
             from slurm_bridge_tpu.bridge.persist import StorePersistence
 
             self._persistence = StorePersistence(self.store, self.state_file)
+            # rebase: fold any restored snapshot+WAL into a fresh snapshot
+            # under THIS incarnation, so the previous process's WAL tail
+            # can never replay over state this process writes
+            self._persistence.compact()
         self.configurator.start()
         self.operator.start()
         self._sched_ticker.start()

@@ -294,6 +294,21 @@ class ObjectStore:
         with self._lock:
             return sum(self.commit_counts.values())
 
+    def current_rv(self) -> int:
+        """The store's global resource-version counter — the watermark
+        write-behind consumers (WAL persistence) flush up to."""
+        with self._lock:
+            return self._rv
+
+    def contains(self, kind: str, name: str) -> bool:
+        """Existence probe that builds NO view for columnar kinds (a
+        ``try_get`` would materialize one just to throw it away)."""
+        with self._lock:
+            table = self._tables.get(kind)
+            if table is not None:
+                return name in table.row_of
+            return name in self._by_kind.get(kind, {})
+
     @staticmethod
     def _span_commits(kind: str, site: str, n: int) -> None:
         """Attribute ``n`` commits to the active sampled span, if any —

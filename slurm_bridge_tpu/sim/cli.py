@@ -94,6 +94,8 @@ def _write_flight_diagnostics(result) -> str | None:
 
 
 def _smoke() -> int:
+    from slurm_bridge_tpu.sim.faults import BRIDGE_KINDS, FaultPlan
+
     failures: list[str] = []
     for name in SMOKE_SCENARIOS:
         runs = []
@@ -102,6 +104,9 @@ def _smoke() -> int:
             runs.append(run_scenario(sc))
         a, b = runs
         det_a, det_b = a.determinism_json(), b.determinism_json()
+        bridge_faulted = any(
+            f.kind in BRIDGE_KINDS for f in a.scenario.faults.faults
+        )
         line = {
             "scenario": name,
             "deterministic": det_a == det_b,
@@ -109,6 +114,8 @@ def _smoke() -> int:
             "bound_total": a.determinism["bound_total"],
             "pending_final": a.determinism["pending_final"],
             "recovery_ticks": a.determinism["recovery_ticks"],
+            "restarts": a.determinism["restarts"],
+            "vnode_deletions": a.determinism["vnode_deletions"],
             "tick_p50_ms": a.timing["tick_p50_ms"],
             # flight-record glance: span-derived phase sum should track
             # tick_p50_ms (the ±5% reconciliation the tests enforce)
@@ -122,8 +129,44 @@ def _smoke() -> int:
             first = a.determinism["invariant_violations"][0]
             failures.append(f"{name}: invariant violated: {first}")
         if a.scenario.faults and a.scenario.expect_drain:
-            if a.determinism["recovery_ticks"] is None:
+            rec = a.determinism["recovery_ticks"]
+            bound = a.scenario.max_recovery_ticks
+            if rec is None:
                 failures.append(f"{name}: never recovered after fault window")
+            elif bound is not None and rec > bound:
+                failures.append(
+                    f"{name}: recovery_ticks {rec} over the scenario "
+                    f"bound {bound}"
+                )
+        if bridge_faulted:
+            # a restart/failover may NEVER flap virtual nodes (ADVICE #1
+            # under the new path) and must actually have happened
+            if a.determinism["vnode_deletions"]:
+                failures.append(
+                    f"{name}: {a.determinism['vnode_deletions']} VirtualNode "
+                    "deletions across a restart/failover (must be 0)"
+                )
+            if not a.determinism["restarts"]:
+                failures.append(f"{name}: bridge fault never restarted the stack")
+        if name == "crash_restart":
+            # lossless recovery: the crashed run must END byte-identical
+            # to the same scenario with the crash stripped
+            ff = run_scenario(
+                dataclasses.replace(a.scenario, faults=FaultPlan())
+            )
+            same = (
+                ff.determinism["final_state_digest"]
+                == a.determinism["final_state_digest"]
+            )
+            print(json.dumps({
+                "scenario": "crash_restart[fault-free twin]",
+                "final_state_identical": same,
+            }))
+            if not same:
+                failures.append(
+                    "crash_restart: post-recovery final state diverged "
+                    "from the fault-free run at the same seed"
+                )
     if failures:
         for f in failures:
             print(f"# sim-smoke FAIL: {f}", file=sys.stderr)

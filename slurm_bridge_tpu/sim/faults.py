@@ -46,6 +46,10 @@ class SimRpcError(grpc.RpcError):
 RPC_KINDS = ("rpc_error", "rpc_latency", "stale_snapshot", "lost_status")
 #: fault kinds applied by the harness at tick boundaries
 CLUSTER_KINDS = ("drain_nodes", "partition_vanish", "preemption_storm")
+#: fault kinds that kill/replace the bridge process itself (PR-7): the
+#: harness tears the control plane down at the start tick and recovery
+#: rides snapshot+WAL + level-triggered re-convergence
+BRIDGE_KINDS = ("crash_restart", "leader_failover")
 
 
 @dataclass(frozen=True)
@@ -62,6 +66,14 @@ class Fault:
     - ``partition_vanish``: ``partition`` hidden for the window
     - ``preemption_storm``: ``jobs`` arrivals at ``priority`` injected at
       ``start_tick`` (requires the scheduler's preemption mode to displace)
+    - ``crash_restart``: at ``start_tick`` the whole bridge stack (store,
+      operator, configurator, scheduler) dies WITHOUT a final flush and a
+      fresh stack reloads from snapshot+WAL; ``end_tick`` should be
+      ``start_tick + 1`` so ``recovery_ticks`` counts from the restart
+    - ``leader_failover``: the lease-holding bridge steps down
+      (``graceful=True``: flush + release; ``False``: silent crash, the
+      standby waits out lease expiry) and a standby elector takes over,
+      rebuilding the stack from snapshot+WAL with zero node flap
     """
 
     kind: str
@@ -76,6 +88,7 @@ class Fault:
     partition: str = ""
     jobs: int = 0
     priority: int = 1000
+    graceful: bool = True
 
     def active(self, tick: int) -> bool:
         return self.start_tick <= tick < self.end_tick
@@ -123,6 +136,8 @@ class FaultPlan:
                 d.update(partition=f.partition)
             elif f.kind == "preemption_storm":
                 d.update(jobs=f.jobs, priority=f.priority)
+            elif f.kind == "leader_failover":
+                d.update(graceful=f.graceful)
             out.append(d)
         return out
 

@@ -34,6 +34,9 @@ import gc
 import hashlib
 import json
 import logging
+import os
+import shutil
+import tempfile
 import time
 from dataclasses import dataclass, field
 
@@ -41,14 +44,17 @@ import grpc
 import numpy as np
 
 from slurm_bridge_tpu.bridge.configurator import Configurator
+from slurm_bridge_tpu.bridge.leader import LeaderElector
 from slurm_bridge_tpu.bridge.objects import (
     BridgeJob,
     Meta,
     Pod,
     PodPhase,
     PodRole,
+    VirtualNode,
 )
 from slurm_bridge_tpu.bridge.operator import BridgeOperator
+from slurm_bridge_tpu.bridge.persist import StorePersistence, load_into
 from slurm_bridge_tpu.bridge.scheduler import PlacementScheduler
 from slurm_bridge_tpu.bridge.store import AlreadyExists, ObjectStore
 from slurm_bridge_tpu.core.types import JobStatus
@@ -108,6 +114,15 @@ class Scenario:
     #: the tick flight recorder (span capture + attribution records);
     #: off is the control arm of the bench-smoke overhead gate
     tracing: bool = True
+    #: WAL-backed store persistence, flushed synchronously at every tick
+    #: boundary (and compacted periodically). Forced on when the fault
+    #: plan contains a bridge-level fault (crash_restart /
+    #: leader_failover) — recovery needs something to recover FROM; the
+    #: WAL-overhead bench gate flips it on a fault-free scenario
+    persistence: bool = False
+    #: sim-smoke gate: fault scenarios must report recovery_ticks ≤ this
+    #: (None = only the existing non-None check applies)
+    max_recovery_ticks: int | None = None
 
 
 @dataclass
@@ -158,6 +173,11 @@ def _quiet_event_logs() -> None:
 
 
 class SimHarness:
+    #: snapshot-compaction cadence (ticks): keeps both recovery inputs —
+    #: a recent snapshot AND a WAL tail since it — live in every
+    #: crash-window, so a mid-run reload exercises snapshot+replay
+    _COMPACT_EVERY = 4
+
     def __init__(self, scenario: Scenario):
         _quiet_event_logs()
         self.scenario = scenario
@@ -218,6 +238,85 @@ class SimHarness:
         self._event_counts: dict[str, int] = {}
         self._preempt_events = 0
         self.events.add_sink(self._count_event)
+        self._build_stack()
+        #: the tick flight recorder — always-on unless the scenario opts
+        #: out (the overhead gate's control arm); every run_tick is one
+        #: capture window rooted at a "sim.tick" span
+        self.flight = FlightRecorder(
+            tracer=TRACER, store=self.store, enabled=scenario.tracing
+        )
+        self.rpc_failures: dict[str, int] = {}
+        self.violations: list[Violation] = []
+        self._digest = hashlib.sha256()
+        self._bound_total = 0
+        self._preempted_total = 0
+        self._tick_phases: list[dict[str, float]] = []
+        self._arrive_ms: list[float] = []
+        self._pending_by_tick: list[int] = []
+        self._drained_at: int | None = None
+        self._recovered_at: int | None = None
+
+        # ---- durability + leadership (PR-7) ----
+        plan_kinds = {f.kind for f in scenario.faults.faults}
+        self._needs_persistence = scenario.persistence or bool(
+            plan_kinds & {"crash_restart", "leader_failover"}
+        )
+        self._state_dir: str | None = None
+        self.persistence: StorePersistence | None = None
+        #: whether the control plane is alive this tick (False only in
+        #: the leaderless window between a leader dying and the standby's
+        #: lease takeover)
+        self._stack_up = True
+        #: arrivals landing in a leaderless window queue here (the
+        #: client retrying against a dead control plane) and replay on
+        #: the first tick the standby is up
+        self._arrival_backlog: list = []
+        self._restarts = 0
+        self.vnode_deletions = 0
+        self._takeover_ticks: list[int] = []
+        self._wal_records_prior = 0
+        self._snapshots_prior = 0
+        self.elector: LeaderElector | None = None
+        self._standby: LeaderElector | None = None
+        self._active_elector: LeaderElector | None = None
+        self._dead_elector: LeaderElector | None = None
+        if self._needs_persistence:
+            self._state_dir = tempfile.mkdtemp(prefix="sbt-sim-state-")
+            self.state_file = os.path.join(self._state_dir, "bridge-state.json")
+            # manual flush (determinism: no pump thread, no timers) and
+            # no fsync — sim "durability" is within-process, and a real
+            # fsync per virtual tick would dominate the toy-scale
+            # overhead measurement the bench gate pairs against
+            self.persistence = StorePersistence(
+                self.store, self.state_file, auto_flush=False, fsync=False
+            )
+        if "leader_failover" in plan_kinds:
+            lease_path = os.path.join(self._state_dir, "leader.lease")
+            # 8 virtual seconds: outlives one 5 s tick gap, expires
+            # during the second — expiry takeover exercises a real
+            # leaderless window, graceful handover is immediate
+            self.elector = LeaderElector(
+                lease_path,
+                identity="bridge-0",
+                lease_duration=8.0,
+                clock=lambda: self.vt,
+            )
+            if not self.elector.try_acquire():  # pragma: no cover - fresh dir
+                raise RuntimeError("sim leader could not acquire a fresh lease")
+            self._standby = LeaderElector(
+                lease_path,
+                identity="bridge-1",
+                lease_duration=8.0,
+                clock=lambda: self.vt,
+            )
+            self._active_elector = self.elector
+
+    def _build_stack(self) -> None:
+        """(Re)build the real control plane over ``self.store`` — called
+        at init and again by the crash/failover reload. Watches are
+        re-established on the new store; its synthetic ADDED backlog is
+        exactly the level-triggered resync a restarted operator needs."""
+        scenario = self.scenario
         self.operator = BridgeOperator(
             self.store, agent_endpoint="sim://agent", events=self.events
         )
@@ -239,22 +338,87 @@ class SimHarness:
             inventory_ttl=0.0,  # virtual time: always take a fresh snapshot
         )
         self._pod_watch = self.store.watch((Pod.KIND,))
-        #: the tick flight recorder — always-on unless the scenario opts
-        #: out (the overhead gate's control arm); every run_tick is one
-        #: capture window rooted at a "sim.tick" span
-        self.flight = FlightRecorder(
-            tracer=TRACER, store=self.store, enabled=scenario.tracing
+        self._node_watch = self.store.watch((VirtualNode.KIND,))
+
+    # ---- crash / failover machinery ----
+
+    def _drain_node_watch(self) -> None:
+        """Count VirtualNode DELETED events — the node-flap detector the
+        failover scenarios gate to zero (synthetic ADDED events from a
+        fresh watch pass through uncounted)."""
+        while True:
+            try:
+                ev = self._node_watch.get_nowait()
+            except Exception:
+                break
+            if ev.type == "DELETED":
+                self.vnode_deletions += 1
+
+    def _teardown_stack(self, *, flush: bool) -> None:
+        """Kill the control plane. ``flush=True`` is the graceful path
+        (step-down: WAL flushed first); ``False`` is a crash — whatever
+        the last tick-boundary flush captured is all recovery gets."""
+        if flush and self.persistence is not None:
+            self.persistence.flush()
+        self._drain_node_watch()
+        # pool/ticker teardown only — Configurator.stop() must leave
+        # every VirtualNode in the store (the ADVICE #1 contract; the
+        # failover scenarios assert zero node deletions)
+        self.configurator.stop()
+        self.store.unwatch(self._pod_watch)
+        self.store.unwatch(self._node_watch)
+
+    def _reload_stack(self, tick: int) -> None:
+        """Bring up a fresh bridge over snapshot+WAL: new store, rebased
+        persistence incarnation, new operator/configurator/scheduler.
+        The sim agent (ground truth "Slurm") is untouched — partitions
+        and jobs outlive the controller, the JIRIAF operating model."""
+        self.store = ObjectStore()
+        restored = load_into(self.store, self.state_file)
+        if self.persistence is not None:
+            self._wal_records_prior += self.persistence.wal_records_total
+            self._snapshots_prior += self.persistence.snapshots_written
+        self.persistence = StorePersistence(
+            self.store, self.state_file, auto_flush=False, fsync=False
         )
-        self.rpc_failures: dict[str, int] = {}
-        self.violations: list[Violation] = []
-        self._digest = hashlib.sha256()
-        self._bound_total = 0
-        self._preempted_total = 0
-        self._tick_phases: list[dict[str, float]] = []
-        self._arrive_ms: list[float] = []
-        self._pending_by_tick: list[int] = []
-        self._drained_at: int | None = None
-        self._recovered_at: int | None = None
+        self.persistence.compact()
+        self._build_stack()
+        self.flight.store = self.store
+        self._restarts += 1
+        self._note(tick, "restart", restored)
+
+    def _bridge_faults(self, tick: int) -> None:
+        """Apply bridge-level faults at the tick boundary, then renew or
+        chase the lease."""
+        plan = self.scenario.faults
+        for _ in plan.starting("crash_restart", tick):
+            self._note(tick, "crash")
+            self._teardown_stack(flush=False)
+            self._reload_stack(tick)
+        for f in plan.starting("leader_failover", tick):
+            self._note(
+                tick, "leader-down", "graceful" if f.graceful else "expiry"
+            )
+            self._teardown_stack(flush=f.graceful)
+            dead = self._active_elector
+            if dead is not None and f.graceful:
+                dead.release()
+            # a supervisor restarts the dead process — it rejoins the
+            # election as the standby for any later failover window
+            self._dead_elector = dead
+            self._active_elector = None
+            self._stack_up = False
+        if not self._stack_up and self._standby is not None:
+            if self._standby.try_acquire():
+                self._note(tick, "leader-up", self._standby.identity)
+                self._reload_stack(tick)
+                self._active_elector = self._standby
+                self._standby = self._dead_elector
+                self._dead_elector = None
+                self._stack_up = True
+                self._takeover_ticks.append(tick)
+        elif self._active_elector is not None:
+            self._active_elector.try_acquire()  # periodic renewal
 
     # ---- bookkeeping ----
 
@@ -298,7 +462,15 @@ class SimHarness:
             self.cluster.show_partition(f.partition)
 
     def _arrive(self, tick: int) -> int:
-        arrivals = self.trace[tick] if tick < len(self.trace) else []
+        arrivals = self._arrival_backlog + (
+            self.trace[tick] if tick < len(self.trace) else []
+        )
+        self._arrival_backlog = []
+        if not self._stack_up:
+            # leaderless window: the control plane is down — the client
+            # queues its submissions and retries once a leader is back
+            self._arrival_backlog = arrivals
+            return 0
         for a in arrivals:
             job = BridgeJob(meta=Meta(name=a.name), spec=a.spec)
             # the trace's virtual duration rides the demand's time limit —
@@ -379,6 +551,7 @@ class SimHarness:
         cpu0 = time.process_time()
         if isinstance(self.client, FaultyClient):
             self.client.set_tick(tick)
+        self._bridge_faults(tick)
         self._apply_fault_boundaries(tick)
 
         t0 = time.perf_counter()
@@ -398,15 +571,17 @@ class SimHarness:
         pending_before = self._pending_names(pods_before)
 
         t1 = time.perf_counter()
-        try:
-            self.scheduler.tick()
-        except grpc.RpcError:
-            self._rpc_fail("scheduler.tick")
+        if self._stack_up:
+            try:
+                self.scheduler.tick()
+            except grpc.RpcError:
+                self._rpc_fail("scheduler.tick")
         sched_ms = (time.perf_counter() - t1) * 1e3
-        phases = dict(self.scheduler.last_phase_ms)
+        phases = dict(self.scheduler.last_phase_ms) if self._stack_up else {}
 
         t2 = time.perf_counter()
-        self._mirror()
+        if self._stack_up:
+            self._mirror()
         phases["mirror"] = (time.perf_counter() - t2) * 1e3
         # anything tick() spent outside its own phase decomposition
         # (RPC-fault aborts, remote skips, future costs) gets its own
@@ -477,6 +652,15 @@ class SimHarness:
         ):
             self._drained_at = tick
 
+        self._drain_node_watch()
+        if self.persistence is not None and self._stack_up:
+            # tick-boundary durability: everything the control loops
+            # committed this tick is WAL-appended before virtual time
+            # moves — the state a crash at the NEXT boundary recovers
+            self.persistence.flush()
+            if (tick + 1) % self._COMPACT_EVERY == 0:
+                self.persistence.compact()
+
         tick_ms = sum(phases.get(k, 0.0) for k in PHASES)
         phases["tick"] = tick_ms
         # CPU seconds actually burned this tick (whole run_tick, including
@@ -488,6 +672,53 @@ class SimHarness:
         self._tick_phases.append(phases)
         self.vt += self.scenario.tick_interval_s
         return phases
+
+    def _final_state_digest(self) -> str:
+        """SHA-256 over the run's FINAL logical state — bindings,
+        placements, lifecycle outcomes — on both sides of the wire
+        (bridge store AND sim ground truth). This is the recovery
+        acceptance digest: a crash-restart run must end byte-identical
+        to the fault-free run at the same seed. Volatile fields (rvs,
+        heartbeats, run_time ticks, free-text reasons) are excluded —
+        they carry process history, not cluster state."""
+        pods = [
+            (
+                p.name,
+                p.spec.node_name,
+                p.status.phase,
+                list(p.status.job_ids),
+                list(p.spec.placement_hint),
+                p.meta.owner,
+                bool(p.meta.deleted),
+            )
+            for p in self.store.list(Pod.KIND)
+        ]
+        jobs = [
+            (
+                j.name,
+                j.status.state,
+                [
+                    (k, int(s.state), s.exit_code)
+                    for k, s in sorted(j.status.subjobs.items())
+                ],
+            )
+            for j in self.store.list(BridgeJob.KIND)
+        ]
+        nodes = sorted(n.name for n in self.store.list(VirtualNode.KIND))
+        sim = sorted(
+            (int(jid), int(j.state), sorted(j.assigned))
+            for jid, j in self.cluster.jobs.items()
+        )
+        payload = json.dumps(
+            {"pods": pods, "jobs": jobs, "nodes": nodes, "sim": sim},
+            sort_keys=True,
+        )
+        return hashlib.sha256(payload.encode()).hexdigest()
+
+    def _cleanup(self) -> None:
+        if self._state_dir is not None:
+            shutil.rmtree(self._state_dir, ignore_errors=True)
+            self._state_dir = None
 
     # ---- the full run ----
 
@@ -511,6 +742,14 @@ class SimHarness:
         )
 
     def run(self) -> ScenarioResult:
+        # finally-guarded so a raising run (invariant failure, store
+        # conflict) still reclaims the snapshot+WAL state tempdir
+        try:
+            return self._run()
+        finally:
+            self._cleanup()
+
+    def _run(self) -> ScenarioResult:
         sc = self.scenario
         # GC policy (PR-4): a cold-start tick allocates ~100k long-lived
         # store objects while ~600k are already live, and CPython's
@@ -546,6 +785,7 @@ class SimHarness:
             if was_enabled:
                 gc.enable()
         total_ticks = tick + 1
+        self._drain_node_watch()
 
         if sc.expect_drain:
             self.violations.extend(
@@ -591,6 +831,18 @@ class SimHarness:
             ),
             "drained_at_tick": self._drained_at,
             "grace_ticks_used": grace_used,
+            # crash/failover robustness (PR-7): restart count, node-flap
+            # detector, lease history, and the final-state digest the
+            # crash scenario compares against its fault-free twin
+            "restarts": self._restarts,
+            "vnode_deletions": self.vnode_deletions,
+            "leader_takeover_ticks": list(self._takeover_ticks),
+            "leader_final": (
+                self._active_elector.identity
+                if self._active_elector is not None
+                else ""
+            ),
+            "final_state_digest": self._final_state_digest(),
             "digest": self._digest.hexdigest(),
         }
         phase_arr = {
@@ -620,6 +872,22 @@ class SimHarness:
             )
             if isinstance(self.client, FaultyClient)
             else 0.0,
+            # WAL pressure (timing, not determinism: a VirtualNode
+            # heartbeat rides wall time, so record counts can wiggle):
+            # records appended + snapshots compacted across the run,
+            # summed over every bridge incarnation
+            "wal_records_total": self._wal_records_prior
+            + (
+                self.persistence.wal_records_total
+                if self.persistence is not None
+                else 0
+            ),
+            "wal_snapshots_total": self._snapshots_prior
+            + (
+                self.persistence.snapshots_written
+                if self.persistence is not None
+                else 0
+            ),
         }
         shape = {
             "pods": sum(len(t) for t in self.trace),
@@ -627,7 +895,7 @@ class SimHarness:
             "partitions": sc.cluster.num_partitions,
             "ticks": total_ticks,
         }
-        return ScenarioResult(
+        result = ScenarioResult(
             scenario=sc,
             determinism=determinism,
             timing=timing,
@@ -635,6 +903,7 @@ class SimHarness:
             flight_record=self.flight.aggregate(),
             flight_ticks=list(self.flight.records),
         )
+        return result
 
 
 def run_scenario(scenario: Scenario) -> ScenarioResult:

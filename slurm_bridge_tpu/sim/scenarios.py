@@ -89,6 +89,7 @@ def agent_flaky_rpc(scale: float = 1.0, seed: int = 44) -> Scenario:
         ),
         ticks=18,
         seed=seed,
+        max_recovery_ticks=24,
     )
 
 
@@ -129,6 +130,7 @@ def preemption_storm(scale: float = 1.0, seed: int = 45) -> Scenario:
         drain_grace_ticks=100,
         preemption=True,
         seed=seed,
+        max_recovery_ticks=90,
     )
 
 
@@ -158,6 +160,7 @@ def node_churn(scale: float = 1.0, seed: int = 46) -> Scenario:
         ),
         ticks=18,
         seed=seed,
+        max_recovery_ticks=36,
     )
 
 
@@ -184,6 +187,68 @@ def partition_vanish(scale: float = 1.0, seed: int = 47) -> Scenario:
         ),
         ticks=16,
         seed=seed,
+        max_recovery_ticks=12,
+    )
+
+
+def crash_restart(scale: float = 1.0, seed: int = 48) -> Scenario:
+    """The bridge process dies mid-run — no graceful flush — and a fresh
+    stack reloads from snapshot+WAL, re-converging against the sim
+    agent's live ground truth. The smoke gate additionally proves the
+    final state digest byte-identical to this scenario with the crash
+    stripped (lossless recovery at the tick boundary)."""
+    return Scenario(
+        name="crash_restart",
+        description="bridge crashes at tick 6; reloads snapshot+WAL and "
+        "re-converges with zero node flap",
+        cluster=ClusterSpec(num_nodes=_n(300, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(900, scale, floor=20), arrival="poisson", spread_ticks=8
+        ),
+        faults=FaultPlan(
+            (Fault(kind="crash_restart", start_tick=6, end_tick=7),)
+        ),
+        ticks=16,
+        seed=seed,
+        persistence=True,
+        max_recovery_ticks=8,
+    )
+
+
+def leader_failover(scale: float = 1.0, seed: int = 49) -> Scenario:
+    """Two leadership handoffs over one run: a graceful step-down
+    (lease released, standby takes over the same tick) and a leader
+    crash (standby must wait out lease expiry — a real leaderless
+    window, arrivals queue and replay). Both takeovers rebuild the
+    stack from snapshot+WAL with ZERO VirtualNode deletions."""
+    return Scenario(
+        name="leader_failover",
+        description="graceful step-down at tick 4, crash + lease-expiry "
+        "takeover at tick 10; zero node flap across both",
+        cluster=ClusterSpec(num_nodes=_n(300, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(800, scale, floor=20), arrival="poisson", spread_ticks=8
+        ),
+        faults=FaultPlan(
+            (
+                Fault(
+                    kind="leader_failover",
+                    start_tick=4,
+                    end_tick=5,
+                    graceful=True,
+                ),
+                Fault(
+                    kind="leader_failover",
+                    start_tick=10,
+                    end_tick=11,
+                    graceful=False,
+                ),
+            )
+        ),
+        ticks=18,
+        seed=seed,
+        persistence=True,
+        max_recovery_ticks=28,
     )
 
 
@@ -220,6 +285,8 @@ SCENARIOS = {
         preemption_storm,
         node_churn,
         partition_vanish,
+        crash_restart,
+        leader_failover,
         full_50kx10k,
     )
 }

@@ -396,3 +396,49 @@ def test_rpc_cancel(agent_rpc):
             break
         time.sleep(0.05)
     assert st.status == pb.CANCELLED
+
+
+# ------------------------------------------------- submit-ledger durability
+
+
+def test_ledger_tolerates_corrupt_state_file(tmp_path, caplog):
+    """A truncated/corrupt/wrong-shape ledger file degrades to an empty
+    ledger with a warning — never a crash (PR-7 satellite)."""
+    import logging
+
+    from slurm_bridge_tpu.agent.server import SubmitLedger
+
+    for i, payload in enumerate(
+        ('{"pod-a": 1, "pod', '["not", "a", "map"]', '{"pod-a": "NaNaN"}', "")
+    ):
+        path = str(tmp_path / f"ledger-{i}.json")
+        with open(path, "w") as f:
+            f.write(payload)
+        with caplog.at_level(logging.WARNING, logger="sbt.agent"):
+            caplog.clear()
+            ledger = SubmitLedger(path)
+        assert ledger.get("pod-a") is None
+        assert any("could not load submit ledger" in r.message for r in caplog.records)
+        # and the broken file heals on the next put
+        ledger.put("pod-b", 42)
+        assert SubmitLedger(path).get("pod-b") == 42
+
+
+def test_ledger_writes_are_atomic(tmp_path):
+    """Persistence rides utils.files.atomic_write: after any number of
+    puts there is exactly the ledger file (no orphaned temp files) and it
+    always parses."""
+    import json as _json
+
+    from slurm_bridge_tpu.agent.server import SubmitLedger
+
+    path = str(tmp_path / "ledger.json")
+    ledger = SubmitLedger(path)
+    for i in range(25):
+        ledger.put(f"pod-{i}", 1000 + i)
+        with open(path) as f:
+            data = _json.load(f)  # never torn
+        assert data[f"pod-{i}"] == 1000 + i
+    leftovers = [p for p in os.listdir(tmp_path) if p != "ledger.json"]
+    assert leftovers == []
+    assert SubmitLedger(path).get("pod-24") == 1024

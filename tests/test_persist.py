@@ -131,6 +131,299 @@ def test_concurrent_flushes_serialized(tmp_path):
     assert len(data["objects"]) == 50
 
 
+# ----------------------------------------------------------------- WAL
+
+
+def _pod(name: str, owner: str = "", node: str = "") -> Pod:
+    from datetime import datetime
+
+    from slurm_bridge_tpu.bridge.objects import Meta, PodSpec, PodStatus
+    from slurm_bridge_tpu.core.types import JobDemand
+
+    return Pod(
+        meta=Meta(name=name, owner=owner, labels={"k": name}),
+        spec=PodSpec(
+            partition="debug",
+            demand=JobDemand(partition="debug", script="x", nodelist=("n1",)),
+            node_name=node,
+            placement_hint=("n1",) if node else (),
+        ),
+        status=PodStatus(
+            phase=PodPhase.RUNNING if node else PodPhase.PENDING,
+            job_ids=(7,) if node else (),
+            job_infos=[
+                JobInfo(id=7, state=JobStatus.RUNNING,
+                        start_time=datetime(2026, 8, 1, 9, 30, 0))
+            ]
+            if node
+            else [],
+        ),
+    )
+
+
+def _job(name: str) -> BridgeJob:
+    from slurm_bridge_tpu.bridge.objects import Meta
+
+    return BridgeJob(
+        meta=Meta(name=name),
+        spec=BridgeJobSpec(partition="debug", sbatch_script="#!/bin/sh\n"),
+    )
+
+
+def test_wal_flush_is_incremental_and_dirty_aware(tmp_path):
+    """A flush appends only what changed; a no-change flush writes
+    NOTHING (no file I/O, no frozen views) — the steady-state contract
+    bench-smoke gates."""
+    from slurm_bridge_tpu.bridge.persist import StorePersistence
+
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False)
+    store.create(_job("a"))
+    store.create(_pod("a-sizecar", owner="a"))
+    assert p.flush() == 2
+    views = store.view_builds_total()
+    size = os.path.getsize(p.wal_path)
+    # dirty-aware skip: nothing changed → zero records, untouched file,
+    # zero views materialized
+    assert p.flush() == 0
+    assert os.path.getsize(p.wal_path) == size
+    assert store.view_builds_total() == views
+    # one more change → exactly one record
+    store.mutate(Pod.KIND, "a-sizecar", lambda o: setattr(o.status, "reason", "r"))
+    assert p.flush() == 1
+    assert store.view_builds_total() == views
+
+
+def test_wal_row_docs_build_zero_views(tmp_path):
+    """Columnar kinds serialize straight from rows: a flush over dirty
+    Pods/BridgeJobs must not materialize a single frozen view."""
+    from slurm_bridge_tpu.bridge.persist import StorePersistence
+
+    store = ObjectStore()
+    for i in range(20):
+        store.create(_job(f"j{i}"))
+        store.create(_pod(f"j{i}-sizecar", owner=f"j{i}", node="vn-0"))
+    p = StorePersistence(store, str(tmp_path / "s.json"), auto_flush=False)
+    views = store.view_builds_total()
+    assert p.flush() == 40
+    p.compact()
+    assert store.view_builds_total() == views
+    # and the docs round-trip identically to the object-path decode
+    fresh = ObjectStore()
+    assert load_into(fresh, str(tmp_path / "s.json")) == 40
+    a = fresh.get(Pod.KIND, "j3-sizecar")
+    b = store.get(Pod.KIND, "j3-sizecar")
+    assert a.spec == b.spec
+    assert a.status.job_infos == b.status.job_infos
+    assert a.meta.labels == b.meta.labels
+
+
+def test_wal_replay_after_crash_without_close(tmp_path):
+    """The crash path: flushes but NO close/compact — recovery must see
+    snapshot (possibly absent) + WAL tail."""
+    from slurm_bridge_tpu.bridge.persist import StorePersistence
+
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False)
+    store.create(_job("a"))
+    p.flush()
+    store.create(_job("b"))
+    store.mutate(BridgeJob.KIND, "a", lambda j: setattr(j.status, "reason", "x"))
+    p.flush()
+    # crash: no close. Snapshot file never written; WAL has everything.
+    assert not os.path.exists(path)
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 2
+    assert fresh.get(BridgeJob.KIND, "a").status.reason == "x"
+
+
+def test_wal_torn_tail_keeps_prior_records(tmp_path):
+    from slurm_bridge_tpu.bridge.persist import StorePersistence, read_wal
+
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False)
+    store.create(_job("a"))
+    p.flush()
+    store.create(_job("b"))
+    p.flush()
+    wal = p.wal_path
+    data = open(wal, "rb").read()
+    open(wal, "wb").write(data[:-4])  # torn mid-record
+    records, _, defect = read_wal(wal)
+    assert defect == "torn" and len(records) == 1
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 1
+    assert fresh.try_get(BridgeJob.KIND, "a") is not None
+
+
+def test_wal_corrupt_record_keeps_prior_state(tmp_path):
+    """A checksum-corrupt record stops replay there — everything before
+    it survives, nothing after it is trusted."""
+    from slurm_bridge_tpu.bridge.persist import StorePersistence, read_wal
+
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False)
+    store.create(_job("a"))
+    p.flush()
+    first_len = os.path.getsize(p.wal_path)
+    store.create(_job("b"))
+    p.flush()
+    blob = bytearray(open(p.wal_path, "rb").read())
+    blob[first_len + 12] ^= 0xFF  # flip a byte inside record 2's payload
+    open(p.wal_path, "wb").write(bytes(blob))
+    records, _, defect = read_wal(p.wal_path)
+    assert defect == "corrupt" and len(records) == 1
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 1
+    assert fresh.try_get(BridgeJob.KIND, "a") is not None
+
+
+def test_wal_delete_replay_and_cascade(tmp_path):
+    from slurm_bridge_tpu.bridge.persist import StorePersistence
+
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False)
+    store.create(_job("a"))
+    store.create(_pod("a-sizecar", owner="a"))
+    store.create(_job("keep"))
+    p.flush()
+    store.delete(BridgeJob.KIND, "a")  # cascades the owned pod
+    p.flush()
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 1
+    assert fresh.try_get(BridgeJob.KIND, "a") is None
+    assert fresh.try_get(Pod.KIND, "a-sizecar") is None
+    assert fresh.try_get(BridgeJob.KIND, "keep") is not None
+
+
+def test_wal_compaction_truncates_and_rebases(tmp_path):
+    """Past the record budget a flush folds the WAL into the snapshot;
+    recovery sees snapshot+tail and the result is identical."""
+    from slurm_bridge_tpu.bridge.persist import StorePersistence
+
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(
+        store, path, auto_flush=False, compact_records=10
+    )
+    for i in range(8):
+        store.create(_job(f"j{i}"))
+    p.flush()
+    assert p.snapshots_written == 0
+    for i in range(8, 16):
+        store.create(_job(f"j{i}"))
+    p.flush()  # 16 records total > 10 → compaction fires
+    assert p.snapshots_written == 1
+    assert os.path.getsize(p.wal_path) == 0
+    store.create(_job("tail"))
+    p.flush()
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 17
+
+
+def test_wal_delete_burst_beyond_tombstone_limit(tmp_path, monkeypatch):
+    """Delete tracking rides watch events, not the store's bounded
+    tombstone map: a delete burst bigger than TOMBSTONE_LIMIT between
+    two flushes must not resurrect anything on replay."""
+    from slurm_bridge_tpu.bridge.persist import StorePersistence
+
+    monkeypatch.setattr(ObjectStore, "TOMBSTONE_LIMIT", 5)
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False)
+    names = [f"j{i:03d}" for i in range(30)]
+    for n in names:
+        store.create(_job(n))
+    store.create(_job("keeper"))
+    p.flush()
+    for n in names:  # 30 deletes >> the 5-tombstone budget
+        store.delete(BridgeJob.KIND, n)
+    assert p.flush() == 30  # every delete became a WAL record anyway
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 1
+    assert fresh.try_get(BridgeJob.KIND, "keeper") is not None
+    assert all(fresh.try_get(BridgeJob.KIND, n) is None for n in names)
+
+
+def test_wal_delete_then_recreate_within_one_flush(tmp_path):
+    """A name deleted and recreated between flushes must survive: the
+    stale delete event is superseded by the fresh put."""
+    from slurm_bridge_tpu.bridge.persist import StorePersistence
+
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False)
+    store.create(_job("phoenix"))
+    p.flush()
+    store.delete(BridgeJob.KIND, "phoenix")
+    store.create(_job("phoenix"))
+    p.flush()
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 1
+    assert fresh.try_get(BridgeJob.KIND, "phoenix") is not None
+
+
+def test_wal_stale_delete_skipped_after_snapshot_recreation(tmp_path):
+    """Crash between snapshot install and WAL truncate, same
+    incarnation: a leftover 'del' record must not replay over the
+    snapshot's later recreation of the same name (rv-stamped deletes)."""
+    from slurm_bridge_tpu.bridge.persist import StorePersistence
+
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False)
+    store.create(_job("a"))
+    store.create(_pod("a-sizecar", owner="a"))
+    p.flush()
+    store.delete(BridgeJob.KIND, "a")
+    p.flush()  # WAL now carries del(a) + del(a-sizecar)
+    stale_wal = open(p.wal_path, "rb").read()
+    store.create(_job("a"))  # recreated AFTER the delete
+    p.compact()  # snapshot contains the recreation; WAL truncated
+    # simulate the crash window: the pre-compaction tail reappears
+    with open(p.wal_path, "ab") as fh:
+        fh.write(stale_wal)
+    fresh = ObjectStore()
+    load_into(fresh, path)
+    assert fresh.try_get(BridgeJob.KIND, "a") is not None, (
+        "stale same-incarnation delete erased the snapshot's recreation"
+    )
+
+
+def test_wal_stale_tail_from_previous_incarnation_skipped(tmp_path):
+    """Crash between snapshot install and WAL truncate: the NEW
+    incarnation's snapshot must not be rewound by the OLD incarnation's
+    leftover WAL records."""
+    from slurm_bridge_tpu.bridge.persist import StorePersistence
+
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False)
+    store.create(_job("a"))
+    store.mutate(BridgeJob.KIND, "a", lambda j: setattr(j.status, "reason", "old"))
+    p.flush()
+    old_wal = open(p.wal_path, "rb").read()
+
+    # restart: recover, then the new incarnation compacts with NEWER state
+    store2 = ObjectStore()
+    load_into(store2, path)
+    p2 = StorePersistence(store2, path, auto_flush=False)
+    store2.mutate(BridgeJob.KIND, "a", lambda j: setattr(j.status, "reason", "new"))
+    p2.compact()
+    # simulate the crash window: the old incarnation's records reappear
+    # appended under the new snapshot
+    with open(p2.wal_path, "ab") as fh:
+        fh.write(old_wal)
+    fresh = ObjectStore()
+    load_into(fresh, path)
+    assert fresh.get(BridgeJob.KIND, "a").status.reason == "new"
+
+
 # ----------------------------------------------------------------- e2e
 
 

@@ -1,0 +1,181 @@
+"""Crash-restart + leader-failover robustness (PR-7).
+
+The heavyweight gates live in ``make sim-smoke`` (crash_restart /
+leader_failover scenarios, double-run + fault-free-twin digest); these
+tests pin the same contracts at toy shapes in the fast lane, plus the
+unit-level pieces: the LeaderElector's virtual clock, and the ADVICE #1
+step-down contract (Configurator.stop() never deletes VirtualNodes)
+across a full failover cycle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from slurm_bridge_tpu.bridge.configurator import Configurator
+from slurm_bridge_tpu.bridge.leader import LeaderElector
+from slurm_bridge_tpu.bridge.objects import VirtualNode
+from slurm_bridge_tpu.bridge.store import ObjectStore
+from slurm_bridge_tpu.sim.agent import SimCluster, SimWorkloadClient
+from slurm_bridge_tpu.sim.faults import Fault, FaultPlan
+from slurm_bridge_tpu.sim.harness import Scenario, run_scenario
+from slurm_bridge_tpu.sim.trace import ClusterSpec, WorkloadSpec, build_cluster
+
+
+def _tiny(name, *, faults, ticks=12, jobs=50, seed=11, **kw):
+    return Scenario(
+        name=name,
+        cluster=ClusterSpec(num_nodes=24),
+        workload=WorkloadSpec(
+            jobs=jobs, arrival="poisson", spread_ticks=4,
+            duration_range=(5.0, 20.0),
+        ),
+        faults=faults,
+        ticks=ticks,
+        seed=seed,
+        persistence=True,
+        drain_grace_ticks=40,
+        **kw,
+    )
+
+
+# ----------------------------------------------------------- crash_restart
+
+
+def test_crash_restart_recovers_to_fault_free_state():
+    """Mid-run crash + snapshot/WAL reload: zero invariant violations,
+    exactly one restart, zero node flap, and a final state byte-identical
+    to the run that never crashed."""
+    plan = FaultPlan((Fault(kind="crash_restart", start_tick=5, end_tick=6),))
+    crashed = run_scenario(_tiny("crash-tiny", faults=plan))
+    clean = run_scenario(
+        dataclasses.replace(_tiny("crash-tiny", faults=plan), faults=FaultPlan())
+    )
+    d = crashed.determinism
+    assert d["invariant_violations"] == []
+    assert d["restarts"] == 1
+    assert d["vnode_deletions"] == 0
+    assert d["recovery_ticks"] is not None
+    assert d["final_state_digest"] == clean.determinism["final_state_digest"]
+
+
+def test_crash_restart_is_deterministic():
+    plan = FaultPlan((Fault(kind="crash_restart", start_tick=4, end_tick=5),))
+    a = run_scenario(_tiny("crash-det", faults=plan))
+    b = run_scenario(_tiny("crash-det", faults=plan))
+    assert a.determinism_json() == b.determinism_json()
+
+
+# --------------------------------------------------------- leader_failover
+
+
+def test_leader_failover_graceful_and_expiry():
+    """Graceful step-down hands over the same tick; a crashed leader's
+    standby must wait out lease expiry (a real leaderless window).
+    Neither may delete a single VirtualNode or violate an invariant."""
+    plan = FaultPlan(
+        (
+            Fault(kind="leader_failover", start_tick=3, end_tick=4, graceful=True),
+            Fault(kind="leader_failover", start_tick=7, end_tick=8, graceful=False),
+        )
+    )
+    r = run_scenario(_tiny("failover-tiny", faults=plan, ticks=14))
+    d = r.determinism
+    assert d["invariant_violations"] == []
+    assert d["restarts"] == 2
+    assert d["vnode_deletions"] == 0
+    assert len(d["leader_takeover_ticks"]) == 2
+    graceful_at, expiry_at = d["leader_takeover_ticks"]
+    assert graceful_at == 3  # released lease: takeover the same tick
+    assert expiry_at > 7  # crashed lease: takeover only after expiry
+    assert d["pending_final"] == 0
+
+
+# ------------------------------------------------- LeaderElector vclock
+
+
+def test_leader_elector_virtual_clock_expiry(tmp_path):
+    lease = str(tmp_path / "leader.lease")
+    vt = [0.0]
+    a = LeaderElector(lease, identity="a", lease_duration=10.0, clock=lambda: vt[0])
+    b = LeaderElector(lease, identity="b", lease_duration=10.0, clock=lambda: vt[0])
+    assert a.try_acquire()
+    vt[0] = 5.0
+    assert not b.try_acquire()  # live lease elsewhere
+    vt[0] = 10.5
+    assert b.try_acquire()  # expired: takeover
+    # the deposed holder no longer renews silently
+    assert not a.try_acquire()
+
+
+def test_leader_elector_graceful_release_hands_over(tmp_path):
+    lease = str(tmp_path / "leader.lease")
+    vt = [0.0]
+    a = LeaderElector(lease, identity="a", lease_duration=100.0, clock=lambda: vt[0])
+    b = LeaderElector(lease, identity="b", lease_duration=100.0, clock=lambda: vt[0])
+    assert a.try_acquire()
+    assert not b.try_acquire()
+    a.release()
+    assert b.try_acquire()  # immediate, no expiry wait
+
+
+# ---------------------------------- step-down never deletes VirtualNodes
+
+
+def _mini_control_plane():
+    spec = ClusterSpec(num_nodes=8, num_partitions=2)
+    nodes, partitions = build_cluster(spec, np.random.default_rng(3))
+    cluster = SimCluster(nodes, partitions, clock=lambda: 0.0)
+    store = ObjectStore()
+    client = SimWorkloadClient(cluster)
+    return store, client
+
+
+def test_configurator_stop_keeps_nodes_across_failover_cycle():
+    """The ADVICE #1 contract under the new path: leader step-down
+    (Configurator.stop()) leaves every VirtualNode in the store, and a
+    standby's configurator ADOPTS them — zero DELETED events across the
+    whole cycle, same node objects (uid-stable, no flap)."""
+    store, client = _mini_control_plane()
+    watch = store.watch((VirtualNode.KIND,))
+    leader = Configurator(
+        store, client, node_sync_interval=0.0, pod_sync_workers=1
+    )
+    leader.reconcile()
+    nodes_before = {n.name: n.meta.uid for n in store.list(VirtualNode.KIND)}
+    assert len(nodes_before) == 2
+
+    leader.stop()  # graceful step-down
+    assert {n.name for n in store.list(VirtualNode.KIND)} == set(nodes_before)
+
+    standby = Configurator(
+        store, client, node_sync_interval=0.0, pod_sync_workers=1
+    )
+    standby.reconcile()
+    standby.sync_now()
+    after = {n.name: n.meta.uid for n in store.list(VirtualNode.KIND)}
+    assert after == nodes_before, "takeover recreated (flapped) nodes"
+
+    deletions = 0
+    while True:
+        try:
+            ev = watch.get_nowait()
+        except Exception:
+            break
+        if ev.type == "DELETED":
+            deletions += 1
+    assert deletions == 0
+    standby.stop()
+
+
+def test_wal_overhead_profile_digest_identical():
+    """The bench gate's WAL arm at a minimal shape: persistence on vs
+    off must not change a single digest byte (flushes only read)."""
+    base = _tiny("wal-arm", faults=FaultPlan(), ticks=6, jobs=20)
+    on = run_scenario(base)
+    off = run_scenario(dataclasses.replace(base, persistence=False))
+    assert on.determinism["digest"] == off.determinism["digest"]
+    assert on.timing["wal_records_total"] > 0
+    assert off.timing["wal_records_total"] == 0
