@@ -4,8 +4,8 @@
 # workflows can never drift.
 
 .PHONY: help test fast check generate apidoc hygiene bench bench-smoke \
-        sim-smoke sim sim-bench scenarios docker-build install uninstall \
-        deploy undeploy run demo
+        sim-smoke chaos-smoke sim sim-bench sim-bench-crash wal-fsync-bench \
+        scenarios docker-build install uninstall deploy undeploy run demo
 
 help: ## Display this help.
 	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ \
@@ -17,7 +17,7 @@ test: ## Full suite + graft compile contracts + hygiene (ref: make test).
 fast: ## ~2-min signal: everything not marked slow.
 	python -m pytest tests/ -q -m "not slow"
 
-check: test bench-smoke sim-smoke ## Alias the reference's CI verb (+ encode & sim gates).
+check: test bench-smoke sim-smoke chaos-smoke ## Alias the reference's CI verb (+ encode, sim & chaos gates).
 
 generate: ## Regenerate protobuf bindings + API docs (ref: make generate).
 	hack/regen-proto.sh
@@ -38,11 +38,20 @@ bench-smoke: ## 5k×1k end-to-end tick; fails on an encode regression.
 sim-smoke: ## Small-shape sim scenarios, double-run: determinism + invariants.
 	python -m slurm_bridge_tpu.sim --smoke
 
+chaos-smoke: ## Composed-fault scenarios only, double-run + crash-free twin digests.
+	python -m slurm_bridge_tpu.sim --chaos
+
 sim: ## Run every fast sim scenario full-size (see --list for names).
 	python -m slurm_bridge_tpu.sim --all
 
 sim-bench: ## The slow 50k×10k full-bridge tick headline (minutes).
 	python -m slurm_bridge_tpu.sim full_50kx10k
+
+sim-bench-crash: ## Crash recovery at the 50k×10k headline shape (minutes).
+	python -m slurm_bridge_tpu.sim full_50kx10k_crash
+
+wal-fsync-bench: ## WAL overhead at 0/1/5 ms simulated fsync latency (record, not gate).
+	python -m benchmarks.ticksmoke --wal-fsync
 
 scenarios: ## The five BASELINE scenarios.
 	python -m benchmarks.scenarios --json

@@ -1,0 +1,23 @@
+"""Sim scenario: the AGENT process dies mid-run and recovers from its
+job-state journal.
+
+At tick 5 the fake agent's process state — jobs, submit ledger, queue,
+per-node allocation — is dropped and rebuilt from journal replay
+(``agent/journal.py``); node hardware state and hidden partitions are
+cluster-side truth and survive. Lossless: final state byte-identical to
+the crash-free run (docs/persistence.md).
+
+    python -m benchmarks.scenarios.sim_agent_crash [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.agent_crash``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import agent_crash as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "agent_crash"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

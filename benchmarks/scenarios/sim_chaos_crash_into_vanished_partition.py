@@ -1,0 +1,27 @@
+"""Sim scenario: bridge crash recovering INTO a vanished partition.
+
+Partition part1 disappears at tick 5 and the bridge crashes the same
+tick. The reloaded configurator never knew the partition, so the
+restored VirtualNode stays in the store unmanaged — ZERO deletions, the
+gate — until part1 returns at tick 12 and the fresh provider adopts it
+uid-stably. Lifecycle outcomes end identical to the crash-free twin
+(which, observing the vanish live, deletes and re-creates the node —
+the crashed arm preserves MORE state; docs/persistence.md).
+
+    python -m benchmarks.scenarios.sim_chaos_crash_into_vanished_partition [--scale F] [--seed N]
+
+Canonical definition:
+``slurm_bridge_tpu.sim.scenarios.chaos_crash_into_vanished_partition``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import (  # noqa: F401
+    chaos_crash_into_vanished_partition as SCENARIO_FACTORY,
+)
+
+NAME = "chaos_crash_into_vanished_partition"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

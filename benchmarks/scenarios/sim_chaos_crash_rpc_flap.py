@@ -1,0 +1,24 @@
+"""Sim scenario: bridge crash DURING a degraded-RPC window.
+
+25% UNAVAILABLE plus injected latency on the batched submit/status and
+inventory RPCs for ticks 4-10; the bridge crashes at tick 6 and must
+re-converge THROUGH the still-flapping plane. Bounded retries
+(``rpc_retries=True``) absorb the transient errors, so no control-loop
+round fails outright; lifecycle outcomes end identical to the crash-free
+twin (docs/persistence.md, chaos-composition matrix).
+
+    python -m benchmarks.scenarios.sim_chaos_crash_rpc_flap [--scale F] [--seed N]
+
+Canonical definition:
+``slurm_bridge_tpu.sim.scenarios.chaos_crash_rpc_flap``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import chaos_crash_rpc_flap as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "chaos_crash_rpc_flap"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

@@ -1,0 +1,23 @@
+"""Sim scenario: simultaneous bridge+agent crash, both reload losslessly.
+
+At tick 6 the bridge stack dies (no flush) AND the agent's process state
+drops in the same tick boundary. The bridge reloads snapshot+WAL, the
+agent replays its job-state journal, and the reloaded bridge's resync
+dedupes every in-flight submission through the journaled ledger — zero
+double submits, zero node flap, final state byte-identical to the run
+where neither crashed (docs/persistence.md, chaos-composition matrix).
+
+    python -m benchmarks.scenarios.sim_chaos_dual_crash [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.chaos_dual_crash``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import chaos_dual_crash as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "chaos_dual_crash"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

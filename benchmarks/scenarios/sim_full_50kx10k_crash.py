@@ -1,0 +1,23 @@
+"""Sim scenario: crash recovery at the 50k×10k headline shape (slow).
+
+The front-loaded 50k-pod × 10k-node scenario with a bridge crash after
+the cold-start tick: snapshot+WAL reload plus level-triggered
+re-convergence, proven bounded at the product shape (``recovery_ms`` and
+``restored_objects`` in the output; ``crash_recovery_ms_50kx10k`` is the
+metric BASELINE.md records). Minutes of wall time — not part of smoke.
+
+    python -m benchmarks.scenarios.sim_full_50kx10k_crash [--scale F] [--seed N]
+
+Canonical definition:
+``slurm_bridge_tpu.sim.scenarios.full_50kx10k_crash``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import full_50kx10k_crash as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "full_50kx10k_crash"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

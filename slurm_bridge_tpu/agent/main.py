@@ -31,6 +31,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--socket", default="", help="unix socket path (optional)")
     parser.add_argument("--config", default="", help="partition overrides YAML")
     parser.add_argument("--ledger", default="", help="submit-dedupe state file")
+    parser.add_argument(
+        "--journal", default="",
+        help="agent job-state journal path (WAL-backed submit ledger + "
+        "in-flight job index; supersedes --ledger when set)",
+    )
     add_observability_flags(parser)
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
@@ -43,6 +48,7 @@ def main(argv: list[str] | None = None) -> int:
         SlurmClient(),
         partition_config=partition_config,
         ledger_file=args.ledger or None,
+        journal_file=args.journal or None,
     )
 
     interceptors = (tracing_interceptor(),)

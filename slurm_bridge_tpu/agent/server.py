@@ -93,50 +93,116 @@ class SubmitLedger:
     """Idempotency map submitter_id → job id, optionally persisted.
 
     The state file is the dedupe token that makes SubmitJob idempotent
-    across AGENT restarts, so its durability matters: writes go through
-    :func:`utils.files.atomic_write` (tempfile + fsync + rename — a
-    crash mid-write can never tear it), and a truncated/corrupt/
-    wrong-shape file on load degrades to an empty ledger with a warning
-    instead of killing the agent — losing dedupe history is recoverable
-    (the bridge's resume tokens still prevent resubmission storms),
-    a crash-looping agent is not.
+    across AGENT restarts, so its durability matters. Two backends:
+
+    - ``state_file`` (legacy, PR-7): the whole map rewritten through
+      :func:`utils.files.atomic_write` on every put (tempfile + fsync +
+      rename — a crash mid-write can never tear it).
+    - ``journal`` (PR-8, preferred): an :class:`agent.journal.AgentJournal`
+      — one CRC-framed WAL append per entry instead of an O(ledger)
+      rewrite, group-commit fsync across the batched submit's thread-pool
+      fan-out, snapshot compaction past the record budget. The journal
+      entry lands BEFORE the submit response leaves the process, so a
+      crash between Slurm accepting the job and the response reaching the
+      bridge still dedupes the bridge's retry.
+
+    Either way, a truncated/corrupt/wrong-shape file on load degrades to
+    an empty ledger with a warning instead of killing the agent — losing
+    dedupe history is recoverable (the bridge's resume tokens still
+    prevent resubmission storms), a crash-looping agent is not.
     """
 
-    def __init__(self, state_file: str | None = None):
+    #: journal-mode bound on the in-flight job index: the index exists
+    #: to warm a restarted daemon's view of recent submissions, so the
+    #: oldest entries age out by insertion order once past this
+    MAX_JOB_DOCS = 10_000
+
+    def __init__(self, state_file: str | None = None, journal=None):
         self._lock = threading.Lock()
         self._by_submitter: dict[str, int] = {}
         self._state_file = state_file
-        if state_file and os.path.exists(state_file):
-            try:
-                with open(state_file) as f:
-                    raw = json.load(f)
-                if not isinstance(raw, dict):
-                    raise ValueError(f"ledger is {type(raw).__name__}, not a map")
-                self._by_submitter = {
-                    str(k): int(v) for k, v in raw.items()
-                }
-            except (OSError, ValueError, TypeError, json.JSONDecodeError) as exc:
-                log.warning(
-                    "could not load submit ledger %s (%s); starting empty",
-                    state_file, exc,
+        self._journal = journal
+        self._jobs: dict[int, dict] = {}
+        legacy = self._load_legacy(state_file) if state_file else {}
+        if journal is not None:
+            state = journal.load()
+            # migration: an agent upgraded from --ledger to --journal
+            # folds the legacy dedupe history into the first checkpoint —
+            # journal entries win (they are newer); dropping the legacy
+            # map here would reopen the double-submit hole for every
+            # submission made before the upgrade
+            self._by_submitter = {**legacy, **state.ledger}
+            if legacy:
+                log.info(
+                    "folded %d legacy ledger entries from %s into the "
+                    "journal", len(legacy), state_file,
                 )
+            self._jobs = dict(state.jobs)
+            # rebase: fold the previous incarnation's snapshot+tail into
+            # a fresh snapshot under THIS incarnation before appending
+            journal.checkpoint(self._by_submitter, self._jobs)
+        else:
+            self._by_submitter = legacy
+
+    @staticmethod
+    def _load_legacy(state_file: str) -> dict[str, int]:
+        if not os.path.exists(state_file):
+            return {}
+        try:
+            with open(state_file) as f:
+                raw = json.load(f)
+            if not isinstance(raw, dict):
+                raise ValueError(f"ledger is {type(raw).__name__}, not a map")
+            return {str(k): int(v) for k, v in raw.items()}
+        except (OSError, ValueError, TypeError, json.JSONDecodeError) as exc:
+            log.warning(
+                "could not load submit ledger %s (%s); starting empty",
+                state_file, exc,
+            )
+            return {}
 
     def get(self, submitter_id: str) -> int | None:
         with self._lock:
             return self._by_submitter.get(submitter_id)
 
-    def put(self, submitter_id: str, job_id: int) -> None:
+    def put(self, submitter_id: str, job_id: int, job_doc: dict | None = None) -> None:
         from slurm_bridge_tpu.utils.files import atomic_write
 
         with self._lock:
             self._by_submitter[submitter_id] = job_id
-            if self._state_file:
+            if job_doc is not None and self._journal is not None:
+                # the job index only exists in journal mode (nothing
+                # reads it on the legacy path), bounded by insertion age
+                self._jobs[job_id] = job_doc
+                while len(self._jobs) > self.MAX_JOB_DOCS:
+                    self._jobs.pop(next(iter(self._jobs)))
+            if self._journal is None and self._state_file:
                 try:
                     atomic_write(
                         self._state_file, json.dumps(self._by_submitter)
                     )
                 except OSError:
                     log.warning("could not persist submit ledger")
+        if self._journal is not None:
+            # journal appends happen OUTSIDE the map lock: WalWriter
+            # orders them itself, and group commit lets the batched
+            # submit's pool threads share fsyncs instead of serializing
+            # full-map rewrites under one lock. Compaction captures the
+            # maps via checkpoint_with — under the journal's append
+            # barrier — so a concurrent put's record can never land
+            # between the capture and the WAL truncate (its map entry is
+            # written before its record, hence inside any capture that
+            # could truncate the record away).
+            try:
+                self._journal.record_submit(submitter_id, job_id, job_doc)
+                if self._journal.needs_compaction:
+                    self._journal.checkpoint_with(self._journal_state)
+            except OSError:
+                log.warning("could not journal submit ledger entry")
+
+    def _journal_state(self):
+        with self._lock:
+            return dict(self._by_submitter), dict(self._jobs)
 
 
 class WorkloadServicer:
@@ -150,13 +216,31 @@ class WorkloadServicer:
         *,
         partition_config: dict[str, PartitionResources] | None = None,
         ledger_file: str | None = None,
+        journal_file: str | None = None,
         tail_poll_interval: float = 0.1,
     ):
         self.driver = driver
         self.partition_config = partition_config or {}
-        self.ledger = SubmitLedger(ledger_file)
+        self.journal = None
+        if journal_file:
+            from slurm_bridge_tpu.agent.journal import AgentJournal
+
+            self.journal = AgentJournal(journal_file)
+        self.ledger = SubmitLedger(ledger_file, journal=self.journal)
         self.uid = str(uuid.uuid4())
         self.tail_poll_interval = tail_poll_interval
+
+    @staticmethod
+    def _job_doc(req: pb.SubmitJobRequest, job_id: int) -> dict:
+        """The journaled submit-time document: the reverse index that
+        hands a restarted agent its in-flight job set (Slurm remains the
+        job-state truth — this is identity, not status)."""
+        return {
+            "name": req.job_name,
+            "partition": req.partition,
+            "submitter": req.submitter_id,
+            "nodes": int(req.nodes),
+        }
 
     # ---- submission ----
 
@@ -171,7 +255,9 @@ class WorkloadServicer:
         except SlurmError as e:
             context.abort(grpc.StatusCode.INTERNAL, str(e))
         if request.submitter_id:
-            self.ledger.put(request.submitter_id, job_id)
+            self.ledger.put(
+                request.submitter_id, job_id, self._job_doc(request, job_id)
+            )
         log.info("submitted job %d (partition=%s)", job_id, request.partition)
         return pb.SubmitJobResponse(job_id=job_id)
 
@@ -210,7 +296,9 @@ class WorkloadServicer:
                     ok=False, error_code="INTERNAL", error=f"{type(e).__name__}: {e}"
                 )
             if req.submitter_id:
-                self.ledger.put(req.submitter_id, job_id)
+                self.ledger.put(
+                    req.submitter_id, job_id, self._job_doc(req, job_id)
+                )
             log.info("submitted job %d (partition=%s)", job_id, req.partition)
             return pb.SubmitJobsEntry(job_id=job_id, ok=True)
 

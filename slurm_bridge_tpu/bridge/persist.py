@@ -47,10 +47,8 @@ import enum
 import json
 import logging
 import os
-import struct
 import threading
 import uuid
-import zlib
 from datetime import datetime
 
 from slurm_bridge_tpu.bridge.store import (
@@ -60,12 +58,22 @@ from slurm_bridge_tpu.bridge.store import (
     ObjectStore,
 )
 
+# the CRC-framed record/replay machinery is shared with the agent's
+# job-state journal (PR-8): utils/wal.py owns framing, torn/corrupt
+# tolerant parsing, group-commit fsync and the disk-latency seam.
+# pack_record/read_wal stay importable from here (the public surface
+# tests and docs reference).
+from slurm_bridge_tpu.utils.wal import (  # noqa: F401 - re-exported
+    RECORD_HDR as _HDR,
+    WalWriter,
+    durable_fsync,
+    pack_record,
+    read_wal,
+)
+
 log = logging.getLogger("sbt.persist")
 
 _DT_KEY = "__dt__"
-
-#: WAL record framing: little-endian (payload_len, crc32(payload))
-_HDR = struct.Struct("<II")
 
 
 _KIND_REGISTRY: dict[str, type] | None = None
@@ -130,14 +138,119 @@ def _decode(value, ftype):
     return value
 
 
-def _decode_dataclass(raw: dict, cls):
+# -- compiled decoders -------------------------------------------------
+#
+# Recovery at the headline shape replays ~100k objects, each fanning out
+# into nested dataclasses/enums/unions. The generic ``_decode`` pays the
+# full type-dispatch cascade (get_origin/is_dataclass/issubclass) for
+# EVERY value, and ``typing.get_type_hints`` re-evaluated annotations per
+# object — together they dominated the whole snapshot reload. Type hints
+# are immutable per class, so each hint compiles ONCE into a closure;
+# ``_decode`` stays as the semantics-defining fallback (the closures must
+# decode exactly like it — the round-trip tests hold the two together).
+
+_DECODERS: dict[object, object] = {}
+
+
+def _decoder_for(ftype):
+    try:
+        cached = _DECODERS.get(ftype)
+    except TypeError:  # unhashable hint: fall back to the generic path
+        return lambda v, _t=ftype: _decode(v, _t)
+    if cached is None:
+        cached = _build_decoder(ftype)
+        _DECODERS[ftype] = cached
+    return cached
+
+
+def _build_decoder(ftype):
+    import types
     import typing
 
-    hints = typing.get_type_hints(cls)
+    origin = typing.get_origin(ftype)
+    if ftype is datetime:
+        def dec_dt(v):
+            if isinstance(v, dict) and _DT_KEY in v:
+                return datetime.fromisoformat(v[_DT_KEY])
+            return v
+
+        return dec_dt
+    if isinstance(ftype, type) and issubclass(ftype, enum.Enum):
+        return ftype
+    if dataclasses.is_dataclass(ftype):
+        return lambda v, _cls=ftype: _decode_dataclass(v, _cls)
+    if origin in (list, tuple):
+        args = typing.get_args(ftype)
+        inner = _decoder_for(args[0] if args else typing.Any)
+        as_tuple = origin is tuple
+
+        def dec_seq(v, _inner=inner, _tuple=as_tuple):
+            if not isinstance(v, list):
+                return v
+            seq = [_inner(x) for x in v]
+            return tuple(seq) if _tuple else seq
+
+        return dec_seq
+    if origin is dict:
+        args = typing.get_args(ftype)
+        vt = _decoder_for(args[1] if len(args) == 2 else typing.Any)
+
+        def dec_map(v, _vt=vt):
+            if not isinstance(v, dict):
+                return v
+            return {k: _vt(x) for k, x in v.items()}
+
+        return dec_map
+    if origin in (typing.Union, types.UnionType):
+        arms = [
+            (arg, _decoder_for(arg))
+            for arg in typing.get_args(ftype)
+            if arg is not type(None)
+        ]
+        nullable = type(None) in typing.get_args(ftype)
+
+        def dec_union(v, _arms=tuple(arms), _nullable=nullable):
+            if v is None and _nullable:
+                return None
+            for _, dec in _arms:
+                try:
+                    return dec(v)
+                except (TypeError, ValueError, KeyError):
+                    continue
+            return v
+
+        return dec_union
+
+    # plain/unknown type (str/int/float/Any/...): values pass through,
+    # except the tagged-datetime sentinel the generic path honors for any
+    # value shape
+    def dec_plain(v):
+        if isinstance(v, dict) and _DT_KEY in v:
+            return datetime.fromisoformat(v[_DT_KEY])
+        return v
+
+    return dec_plain
+
+
+#: per-class (field name, compiled decoder) pairs, built once
+_FIELD_DECODERS: dict[type, tuple[tuple[str, object], ...]] = {}
+
+
+def _decode_dataclass(raw: dict, cls):
+    plan = _FIELD_DECODERS.get(cls)
+    if plan is None:
+        import typing
+
+        hints = typing.get_type_hints(cls)
+        plan = tuple(
+            (f.name, _decoder_for(hints.get(f.name, typing.Any)))
+            for f in dataclasses.fields(cls)
+        )
+        _FIELD_DECODERS[cls] = plan
     kwargs = {}
-    for f in dataclasses.fields(cls):
-        if f.name in raw:
-            kwargs[f.name] = _decode(raw[f.name], hints.get(f.name, typing.Any))
+    for name, dec in plan:
+        if name in raw:
+            kwargs[name] = dec(raw[name])
     return cls(**kwargs)
 
 
@@ -263,46 +376,6 @@ def _row_doc_builder(kind: str):
     return {Pod.KIND: _pod_row_doc, BridgeJob.KIND: _job_row_doc}.get(kind)
 
 
-# ------------------------------------------------------------ WAL file
-
-def pack_record(payload: dict) -> bytes:
-    body = json.dumps(payload, separators=(",", ":")).encode()
-    return _HDR.pack(len(body), zlib.crc32(body)) + body
-
-
-def read_wal(path: str) -> tuple[list[dict], int, str | None]:
-    """Parse a WAL file: ``(records, clean_bytes, defect)``.
-
-    ``defect`` is None for a clean file, ``"torn"`` for a truncated last
-    record (crash mid-append — expected, not an error), ``"corrupt"``
-    for a checksum/JSON failure. Parsing stops at the first defect;
-    everything before it is returned — prior state is never lost.
-    """
-    try:
-        with open(path, "rb") as fh:
-            data = fh.read()
-    except FileNotFoundError:
-        return [], 0, None
-    records: list[dict] = []
-    off, n = 0, len(data)
-    while off < n:
-        if off + _HDR.size > n:
-            return records, off, "torn"
-        length, crc = _HDR.unpack_from(data, off)
-        end = off + _HDR.size + length
-        if end > n:
-            return records, off, "torn"
-        body = data[off + _HDR.size : end]
-        if zlib.crc32(body) != crc:
-            return records, off, "corrupt"
-        try:
-            records.append(json.loads(body))
-        except ValueError:
-            return records, off, "corrupt"
-        off = end
-    return records, off, None
-
-
 class StorePersistence:
     """WAL-backed write-behind durability for an ObjectStore.
 
@@ -328,6 +401,7 @@ class StorePersistence:
         compact_bytes: int = 4 << 20,
         compact_records: int = 50_000,
         fsync: bool = True,
+        fsync_delay_s: float | None = None,
     ):
         self.store = store
         self.path = path
@@ -336,6 +410,12 @@ class StorePersistence:
         self.compact_bytes = compact_bytes
         self.compact_records = compact_records
         self.fsync = fsync
+        #: simulated device latency per fsync (None = the process-wide
+        #: utils.wal seam) — the fsync-realism bench knob
+        self.fsync_delay_s = fsync_delay_s
+        self._wal = WalWriter(
+            self.wal_path, fsync=fsync, fsync_delay_s=fsync_delay_s
+        )
         #: stamped into every record + snapshot; replay refuses to apply
         #: another incarnation's WAL records over this one's snapshot
         self.incarnation = uuid.uuid4().hex
@@ -347,10 +427,7 @@ class StorePersistence:
         self.wal_records = 0
         self.wal_records_total = 0
         self.snapshots_written = 0
-        try:
-            self.wal_bytes = os.path.getsize(self.wal_path)
-        except OSError:
-            self.wal_bytes = 0
+        self.wal_bytes = self._wal.size
         self._lock = threading.Lock()
         # Serializes whole flush/compact cycles: a timer-fired flush can
         # race close()'s synchronous flush, and two writers interleaving
@@ -500,12 +577,10 @@ class StorePersistence:
             self._last_rv = max(self._last_rv, start_rv)
             return 0
         blob = b"".join(chunks)
-        os.makedirs(os.path.dirname(os.path.abspath(self.wal_path)), exist_ok=True)
-        with open(self.wal_path, "ab") as fh:
-            fh.write(blob)
-            fh.flush()
-            if self.fsync:
-                os.fsync(fh.fileno())
+        # one ordered append + one group-commit barrier for the whole
+        # flush — concurrent flushers (debounce timer vs close()) share
+        # a single device fsync through the WalWriter
+        self._wal.append_durable(blob)
         # only the captured deletes are retired — ones folded while we
         # wrote ride to the next flush (a failed write retires nothing)
         with self._lock:
@@ -564,18 +639,32 @@ class StorePersistence:
                 f,
             )
             f.flush()
-            os.fsync(f.fileno())
+            durable_fsync(f.fileno(), delay_s=self.fsync_delay_s)
         os.replace(tmp, self.path)
         # snapshot is durable; now the WAL prefix it folded in can go.
         # (A crash between the two replays an incarnation-matched WAL
         # whose rv ≤ snapshot rv records are skipped — no stale rewind.)
-        with open(self.wal_path, "wb"):
-            pass
+        self._wal.truncate()
         self._last_rv = max(self._last_rv, start_rv)
         self.wal_records = 0
         self.wal_bytes = 0
         self.snapshots_written += 1
         log.debug("compacted %d objects into %s", len(docs), self.path)
+
+    def abandon(self) -> None:
+        """Release resources WITHOUT flushing — the simulated-crash path
+        (the whole point is that nothing gets a last-gasp write). Closes
+        the WAL file handle and detaches the store watch; the instance
+        must not be used afterwards."""
+        if self._pump is not None:
+            self._stop.set()
+            self._pump.join(5.0)
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+        self._wal.close()
+        self.store.unwatch(self._del_watch)
 
     def close(self) -> None:
         if self._pump is not None:
@@ -588,6 +677,7 @@ class StorePersistence:
         with self._flush_lock:
             self._flush_locked()
             self._compact_locked()
+            self._wal.close()
         self.store.unwatch(self._del_watch)
 
 

@@ -35,6 +35,7 @@ from slurm_bridge_tpu.bridge.store import NotFound, ObjectStore
 from slurm_bridge_tpu.obs.events import EventRecorder
 from slurm_bridge_tpu.solver.auction import AuctionConfig
 from slurm_bridge_tpu.wire import ServiceClient, dial
+from slurm_bridge_tpu.wire.rpc import TRANSIENT_CODES, RetryPolicy
 
 log = logging.getLogger("sbt.bridge")
 
@@ -75,7 +76,15 @@ class Bridge:
                 log.info("restored %d objects from %s", restored, state_file)
         self.events = EventRecorder()
         self.channel = dial(agent_endpoint)
-        self.client = ServiceClient(self.channel, "WorkloadManager")
+        # DEADLINE_EXCEEDED joins the retryable set here because every
+        # bridge submit carries a submitter_id the agent's journal-backed
+        # ledger dedupes — a retry whose first attempt actually landed is
+        # a no-op, not a duplicate Slurm job
+        self.client = ServiceClient(
+            self.channel,
+            "WorkloadManager",
+            retry=RetryPolicy(codes=TRANSIENT_CODES),
+        )
         self.operator = BridgeOperator(
             self.store,
             agent_endpoint=agent_endpoint,

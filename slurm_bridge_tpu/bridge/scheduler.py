@@ -197,7 +197,13 @@ class PlacementScheduler:
         if solver_endpoint:
             from slurm_bridge_tpu.wire.rpc import dial
 
-            self._remote = ServiceClient(dial(solver_endpoint), "PlacementSolver")
+            # retry=None: the scheduler thread must never sleep in
+            # backoff — place_timeout bounds exactly ONE attempt and the
+            # tick-skip fallback owns failure handling; retries would
+            # stretch a down-sidecar tick by the whole backoff ladder
+            self._remote = ServiceClient(
+                dial(solver_endpoint), "PlacementSolver", retry=None
+            )
         # cancels whose pod vanished before the failure could be annotated;
         # retried alongside the annotated ones
         self._orphan_cancels: set[int] = set()

@@ -124,6 +124,10 @@ class SimJob:
     #: (entry, info_msg, signature) — the JobsInfo response cache; see
     #: SimAgent.JobsInfo. Excluded from comparison/repr: pure memo.
     pb_cache: tuple | None = field(default=None, repr=False, compare=False)
+    #: last journaled mutable-state signature — keeps journal records
+    #: proportional to actual transitions, not queue length (a failed
+    #: start re-checks every pending job every step). Pure memo.
+    journal_sig: tuple | None = field(default=None, repr=False, compare=False)
 
     def _run_time(self, now: float | None) -> int:
         # elapsed runtime like Slurm's RunTime: virtual now, capped at the
@@ -228,6 +232,134 @@ class SimCluster:
         self._ledger: dict[str, int] = {}
         self._next_id = 1000
         self._queue: list[int] = []  # PENDING job ids, submit order
+        #: the agent job-state journal (PR-8): when attached, every
+        #: ledger entry and job lifecycle transition is appended durably,
+        #: and :meth:`crash_reload` rebuilds the whole agent-process
+        #: state from replay — the ``agent_crash`` fault's recovery path
+        self.journal = None
+
+    # ---- agent job-state journal (PR-8) ----
+
+    def attach_journal(self, journal) -> None:
+        """Start journaling — and rebase the journal around the current
+        (usually empty) state so a previous incarnation's tail can never
+        mix with this process's records."""
+        self.journal = journal
+        ledger, jobs = self.journal_state()
+        journal.checkpoint(ledger, jobs)
+
+    @staticmethod
+    def _job_doc(job: SimJob) -> dict:
+        """Full journal document for one job — every field
+        :meth:`crash_reload` needs to reconstruct the ``SimJob`` exactly
+        (the sim journal carries complete state because ``SimCluster``
+        plays both the login-node daemon AND Slurm; the real agent's
+        journal carries identity only — Slurm holds its job state)."""
+        return {
+            "id": job.id,
+            "name": job.name,
+            "submitter_id": job.submitter_id,
+            "partition": job.partition,
+            "num_nodes": job.num_nodes,
+            "cpus_per_node": job.cpus_per_node,
+            "mem_per_node_mb": job.mem_per_node_mb,
+            "gpus_per_node": job.gpus_per_node,
+            "duration_s": job.duration_s,
+            "priority": job.priority,
+            "nodelist": list(job.nodelist),
+            "state": int(job.state),
+            "submit_vt": job.submit_vt,
+            "start_vt": job.start_vt,
+            "end_vt": job.end_vt,
+            "assigned": list(job.assigned),
+            "reason": job.reason,
+        }
+
+    @staticmethod
+    def _job_from_doc(doc: dict) -> SimJob:
+        return SimJob(
+            id=int(doc["id"]),
+            name=doc["name"],
+            submitter_id=doc["submitter_id"],
+            partition=doc["partition"],
+            num_nodes=int(doc["num_nodes"]),
+            cpus_per_node=int(doc["cpus_per_node"]),
+            mem_per_node_mb=int(doc["mem_per_node_mb"]),
+            gpus_per_node=int(doc["gpus_per_node"]),
+            duration_s=float(doc["duration_s"]),
+            priority=int(doc["priority"]),
+            nodelist=tuple(doc["nodelist"]),
+            state=JobStatus(int(doc["state"])),
+            submit_vt=float(doc["submit_vt"]),
+            start_vt=float(doc["start_vt"]),
+            end_vt=float(doc["end_vt"]),
+            assigned=tuple(doc["assigned"]),
+            reason=doc["reason"],
+        )
+
+    @staticmethod
+    def _mut_sig(job: SimJob) -> tuple:
+        """The mutable slice of a job the journal doc captures."""
+        return (
+            int(job.state), job.assigned, job.reason,
+            job.start_vt, job.end_vt,
+        )
+
+    def _journal_job(self, job: SimJob) -> None:
+        if self.journal is None:
+            return
+        sig = self._mut_sig(job)
+        if job.journal_sig == sig:
+            return  # nothing the doc captures has moved
+        job.journal_sig = sig
+        self.journal.record_job(job.id, self._job_doc(job))
+
+    def journal_state(self) -> tuple[dict[str, int], dict[int, dict]]:
+        """(ledger, job docs) for a journal checkpoint."""
+        return dict(self._ledger), {
+            jid: self._job_doc(j) for jid, j in sorted(self.jobs.items())
+        }
+
+    def crash_reload(self) -> int:
+        """The ``agent_crash`` fault: drop every piece of agent-process
+        state — jobs, ledger, queue, per-node allocations — and rebuild
+        it from journal replay, in place (the client wrapper keeps its
+        reference). Node hardware state (drained flags, base allocation)
+        and hidden partitions are cluster-side truth and survive; so does
+        :attr:`stats`, which is the simulator's measurement layer, not
+        agent state. Returns the number of jobs restored; a lossless
+        replay leaves the cluster byte-identical to the moment of the
+        crash — the ``final_state_digest`` twin gate proves exactly that.
+        """
+        if self.journal is None:
+            raise RuntimeError("agent_crash without an attached journal")
+        state = self.journal.load()
+        self.jobs.clear()
+        self._ledger = dict(state.ledger)
+        self._queue = []
+        for node in self.nodes.values():
+            node.job_cpus = 0
+            node.job_memory_mb = 0
+            node.job_gpus = 0
+        for jid in sorted(state.jobs):
+            job = self._job_from_doc(state.jobs[jid])
+            self.jobs[job.id] = job
+            if job.state == JobStatus.RUNNING:
+                for name in job.assigned:
+                    node = self.nodes.get(name)
+                    if node is None:
+                        continue
+                    node.job_cpus += job.cpus_per_node
+                    node.job_memory_mb += job.mem_per_node_mb
+                    node.job_gpus += job.gpus_per_node
+            elif job.state == JobStatus.PENDING:
+                self._queue.append(job.id)  # ids are submit-ordered
+        self._next_id = max(self.jobs, default=self._next_id - 1) + 1
+        # rebase: fold the replayed state into a fresh snapshot under the
+        # new incarnation (mirrors Bridge.start()'s compact-first)
+        ledger, jobs = self.journal_state()
+        self.journal.checkpoint(ledger, jobs)
+        return len(self.jobs)
 
     # ---- inventory ----
 
@@ -301,8 +433,15 @@ class SimCluster:
         if submitter:
             self._ledger[submitter] = job.id
         self.stats.submitted += 1
-        if not self._try_start(job):
+        started = self._try_start(job)
+        if not started:
             self._queue.append(job.id)
+        if self.journal is not None:
+            # ledger + post-placement job state behind ONE durability
+            # barrier (the dedupe token is what a crashed agent must
+            # never lose)
+            job.journal_sig = self._mut_sig(job)
+            self.journal.record_submit(submitter, job.id, self._job_doc(job))
         return job.id
 
     def cancel(self, job_id: int) -> None:
@@ -314,6 +453,7 @@ class SimCluster:
         job.state = JobStatus.CANCELLED
         job.end_vt = self.clock()
         self.stats.cancelled += 1
+        self._journal_job(job)
 
     def step(self) -> None:
         """Advance the cluster to the current virtual time: complete jobs
@@ -324,6 +464,7 @@ class SimCluster:
                 self._free(job)
                 job.state = JobStatus.COMPLETED
                 self.stats.completed += 1
+                self._journal_job(job)
         still: list[int] = []
         for jid in self._queue:
             job = self.jobs[jid]
@@ -331,6 +472,12 @@ class SimCluster:
                 continue  # cancelled while queued
             if not self._try_start(job):
                 still.append(jid)
+            # journal BOTH outcomes: a failed start still rewrites the
+            # job's ``reason`` (Resources / partition unavailable), and a
+            # crash replaying the stale reason would diverge from the
+            # crash-free twin when agent_crash composes with
+            # drain/vanish windows
+            self._journal_job(job)
         self._queue = still
 
     def _fits(self, node: SimNode, job: SimJob) -> bool:

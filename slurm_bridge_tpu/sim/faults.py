@@ -20,7 +20,8 @@ Windows are ``[start_tick, end_tick)``; cluster-level faults revert at
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+import logging
+from dataclasses import dataclass
 
 import grpc
 
@@ -50,6 +51,13 @@ CLUSTER_KINDS = ("drain_nodes", "partition_vanish", "preemption_storm")
 #: harness tears the control plane down at the start tick and recovery
 #: rides snapshot+WAL + level-triggered re-convergence
 BRIDGE_KINDS = ("crash_restart", "leader_failover")
+#: fault kinds that kill/replace the AGENT process (PR-8): the harness
+#: drops the fake agent's process state (jobs, ledger, queue, per-node
+#: allocation) and rebuilds it from the agent job-state journal
+AGENT_KINDS = ("agent_crash",)
+#: every kind any delivery mechanism understands — plan validation warns
+#: on anything else (a typo'd kind silently tests nothing)
+ALL_KINDS = RPC_KINDS + CLUSTER_KINDS + BRIDGE_KINDS + AGENT_KINDS
 
 
 @dataclass(frozen=True)
@@ -74,6 +82,19 @@ class Fault:
       (``graceful=True``: flush + release; ``False``: silent crash, the
       standby waits out lease expiry) and a standby elector takes over,
       rebuilding the stack from snapshot+WAL with zero node flap
+    - ``agent_crash``: at ``start_tick`` the fake agent's PROCESS state
+      (jobs, submit ledger, queue, per-node allocation) is dropped and
+      rebuilt from the agent job-state journal replay; node hardware
+      state and hidden partitions are cluster-side truth and survive.
+      Composes with ``crash_restart`` at the same tick for the
+      simultaneous bridge+agent crash.
+
+    Windows of different kinds may overlap freely (PR-8 composed chaos):
+    a ``crash_restart`` inside an ``rpc_error``/``rpc_latency`` window
+    recovers THROUGH the degraded RPC plane, and one inside a
+    ``partition_vanish`` window recovers INTO the shrunken inventory
+    (the restored VirtualNode of a vanished partition stays in the store,
+    unmanaged, until the partition returns and the provider adopts it).
     """
 
     kind: str
@@ -101,12 +122,73 @@ class Fault:
         return getattr(grpc.StatusCode, self.code)
 
 
+#: (context, name) pairs already warned about — plan validation is
+#: rate-limited to once per process per offending name, so a scenario
+#: constructed in a loop (the smoke gate's double-run) warns exactly once
+_VALIDATION_WARNED: set[tuple[str, str]] = set()
+
+
+def _known_rpc_methods() -> frozenset[str]:
+    """Every RPC method name the WorkloadManager service actually has —
+    derived from the proto descriptor, so the validation can never drift
+    from the wire surface."""
+    global _KNOWN_RPC_METHODS
+    if _KNOWN_RPC_METHODS is None:
+        from slurm_bridge_tpu.wire.rpc import service_methods
+
+        _, specs = service_methods("WorkloadManager")
+        _KNOWN_RPC_METHODS = frozenset(s.name for s in specs)
+    return _KNOWN_RPC_METHODS
+
+
+_KNOWN_RPC_METHODS: frozenset[str] | None = None
+
+log = logging.getLogger("sbt.sim.faults")
+
+
 @dataclass(frozen=True)
 class FaultPlan:
     faults: tuple[Fault, ...] = ()
 
+    def __post_init__(self) -> None:
+        """Validate the plan at construction: a typo'd RPC method in
+        ``methods`` (or an unknown ``kind``) silently no-ops — the
+        scenario then tests LESS than it claims. Warn once per process
+        per offending name (rate-limited: smoke gates construct each
+        scenario many times)."""
+        for f in self.faults:
+            if f.kind not in ALL_KINDS:
+                key = ("kind", f.kind)
+                if key not in _VALIDATION_WARNED:
+                    _VALIDATION_WARNED.add(key)
+                    log.warning(
+                        "FaultPlan: unknown fault kind %r — no delivery "
+                        "mechanism will apply it (known: %s)",
+                        f.kind, ", ".join(ALL_KINDS),
+                    )
+                continue
+            if f.kind not in ("rpc_error", "rpc_latency"):
+                continue
+            for m in f.methods:
+                if m in _known_rpc_methods():
+                    continue
+                key = ("method", m)
+                if key not in _VALIDATION_WARNED:
+                    _VALIDATION_WARNED.add(key)
+                    log.warning(
+                        "FaultPlan: %s fault names RPC method %r, which "
+                        "matches no WorkloadManager method — the window "
+                        "injects nothing for it", f.kind, m,
+                    )
+
     def __bool__(self) -> bool:
         return bool(self.faults)
+
+    def strip(self, kinds: tuple[str, ...]) -> "FaultPlan":
+        """The plan with every fault of the given kinds removed — how the
+        smoke gate builds a crash-free twin that keeps the REST of the
+        chaos (rpc flaps, vanished partitions) intact."""
+        return FaultPlan(tuple(f for f in self.faults if f.kind not in kinds))
 
     def active(self, kind: str, tick: int) -> list[Fault]:
         return [f for f in self.faults if f.kind == kind and f.active(tick)]
@@ -140,6 +222,16 @@ class FaultPlan:
                 d.update(graceful=f.graceful)
             out.append(d)
         return out
+
+    @property
+    def composed(self) -> bool:
+        """True when windows of different kinds overlap in time — the
+        PR-8 chaos-composition shape (crash during a degraded window)."""
+        for i, a in enumerate(self.faults):
+            for b in self.faults[i + 1 :]:
+                if a.kind != b.kind and a.start_tick < b.end_tick and b.start_tick < a.end_tick:
+                    return True
+        return False
 
 
 #: inventory RPCs a stale_snapshot window freezes

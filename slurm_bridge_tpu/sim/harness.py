@@ -62,8 +62,10 @@ from slurm_bridge_tpu.obs.events import EventRecorder
 from slurm_bridge_tpu.obs.flight import FlightRecorder
 from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.obs.tracing import TRACER
+from slurm_bridge_tpu.agent.journal import AgentJournal
 from slurm_bridge_tpu.sim.agent import SimCluster, SimWorkloadClient
-from slurm_bridge_tpu.sim.faults import FaultPlan, FaultyClient
+from slurm_bridge_tpu.sim.faults import AGENT_KINDS, FaultPlan, FaultyClient
+from slurm_bridge_tpu.wire.rpc import RetryingClient, RetryPolicy
 from slurm_bridge_tpu.sim.invariants import (
     Violation,
     check_drain,
@@ -123,6 +125,20 @@ class Scenario:
     #: sim-smoke gate: fault scenarios must report recovery_ticks ≤ this
     #: (None = only the existing non-None check applies)
     max_recovery_ticks: int | None = None
+    #: stack a bounded-retry wrapper (backoff+jitter, virtual sleeps)
+    #: over the client, so transient injected RPC errors heal inside the
+    #: tick instead of surfacing as failed control-loop rounds
+    rpc_retries: bool = False
+    #: simulated per-fsync device latency for the WAL (ms). 0 keeps the
+    #: sim's fsync-off mode; >0 turns real fsyncs ON with that much
+    #: injected latency — the fsync-realism bench arm
+    wal_fsync_ms: float = 0.0
+    #: smoke-gate twin comparison for crash scenarios: "" = none,
+    #: "state" = final_state_digest must be byte-identical to the twin
+    #: with bridge/agent crash faults stripped, "outcome" = the
+    #: id/placement-insensitive final_outcome_digest must be (used when
+    #: composed RPC faults legitimately reshuffle job ids/placements)
+    lossless_twin: str = ""
 
 
 @dataclass
@@ -214,11 +230,27 @@ class SimHarness:
                     )
                 )
         base_client = SimWorkloadClient(self.cluster)
-        self.client = (
+        #: the FaultyClient (tick advance + injection counters) — kept
+        #: separate from ``self.client`` because a retry wrapper may
+        #: stack on top of it
+        self.faulty: FaultyClient | None = (
             FaultyClient(base_client, scenario.faults, seed=scenario.seed + 1)
             if scenario.faults
-            else base_client
+            else None
         )
+        self.client = self.faulty if self.faulty is not None else base_client
+        self.retrier: RetryingClient | None = None
+        if scenario.rpc_retries:
+            # virtual time: retries cost no wall clock (sleep is a no-op)
+            # and draw jitter from a seeded RNG so injection sequences —
+            # and therefore whole runs — stay deterministic
+            self.retrier = RetryingClient(
+                self.client,
+                policy=RetryPolicy(max_attempts=8),
+                sleep=lambda s: None,
+                seed=scenario.seed + 2,
+            )
+            self.client = self.retrier
         # deterministic drain targets resolved up front (plan seed, not
         # call order): node_fraction picks evenly-spaced names
         self._drain_targets: dict[int, tuple[str, ...]] = {}
@@ -256,13 +288,15 @@ class SimHarness:
         self._drained_at: int | None = None
         self._recovered_at: int | None = None
 
-        # ---- durability + leadership (PR-7) ----
+        # ---- durability + leadership (PR-7/PR-8) ----
         plan_kinds = {f.kind for f in scenario.faults.faults}
         self._needs_persistence = scenario.persistence or bool(
             plan_kinds & {"crash_restart", "leader_failover"}
         )
+        self._needs_agent_journal = bool(plan_kinds & set(AGENT_KINDS))
         self._state_dir: str | None = None
         self.persistence: StorePersistence | None = None
+        self.agent_journal: AgentJournal | None = None
         #: whether the control plane is alive this tick (False only in
         #: the leaderless window between a leader dying and the standby's
         #: lease takeover)
@@ -272,24 +306,33 @@ class SimHarness:
         #: the first tick the standby is up
         self._arrival_backlog: list = []
         self._restarts = 0
+        self._agent_restarts = 0
         self.vnode_deletions = 0
         self._takeover_ticks: list[int] = []
         self._wal_records_prior = 0
         self._snapshots_prior = 0
+        self._recovery_ms: list[float] = []
+        self._wal_flush_ms: list[float] = []
+        self._restored_objects: list[int] = []
+        self._agent_restored_jobs: list[int] = []
         self.elector: LeaderElector | None = None
         self._standby: LeaderElector | None = None
         self._active_elector: LeaderElector | None = None
         self._dead_elector: LeaderElector | None = None
-        if self._needs_persistence:
+        if self._needs_persistence or self._needs_agent_journal:
             self._state_dir = tempfile.mkdtemp(prefix="sbt-sim-state-")
+        if self._needs_persistence:
             self.state_file = os.path.join(self._state_dir, "bridge-state.json")
-            # manual flush (determinism: no pump thread, no timers) and
-            # no fsync — sim "durability" is within-process, and a real
-            # fsync per virtual tick would dominate the toy-scale
-            # overhead measurement the bench gate pairs against
-            self.persistence = StorePersistence(
-                self.store, self.state_file, auto_flush=False, fsync=False
+            self.persistence = self._make_persistence()
+        if self._needs_agent_journal:
+            # fsync off, like the bridge WAL: sim durability is
+            # within-process; the journal's every-transition appends are
+            # driven purely by virtual events, so replay is deterministic
+            self.agent_journal = AgentJournal(
+                os.path.join(self._state_dir, "agent-journal.json"),
+                fsync=False,
             )
+            self.cluster.attach_journal(self.agent_journal)
         if "leader_failover" in plan_kinds:
             lease_path = os.path.join(self._state_dir, "leader.lease")
             # 8 virtual seconds: outlives one 5 s tick gap, expires
@@ -310,6 +353,23 @@ class SimHarness:
                 clock=lambda: self.vt,
             )
             self._active_elector = self.elector
+
+    def _make_persistence(self) -> StorePersistence:
+        """StorePersistence in the sim's deterministic posture: manual
+        flush (no pump thread, no timers). fsync stays OFF at 0 ms —
+        sim "durability" is within-process, and a real fsync per virtual
+        tick would dominate the toy-scale overhead measurement the bench
+        gate pairs against — and flips ON with injected device latency
+        when the scenario carries ``wal_fsync_ms`` (the fsync-realism
+        bench arm)."""
+        fsync_ms = self.scenario.wal_fsync_ms
+        return StorePersistence(
+            self.store,
+            self.state_file,
+            auto_flush=False,
+            fsync=fsync_ms > 0,
+            fsync_delay_s=(fsync_ms / 1e3) if fsync_ms > 0 else None,
+        )
 
     def _build_stack(self) -> None:
         """(Re)build the real control plane over ``self.store`` — called
@@ -373,19 +433,39 @@ class SimHarness:
         persistence incarnation, new operator/configurator/scheduler.
         The sim agent (ground truth "Slurm") is untouched — partitions
         and jobs outlive the controller, the JIRIAF operating model."""
+        t0 = time.perf_counter()
         self.store = ObjectStore()
         restored = load_into(self.store, self.state_file)
         if self.persistence is not None:
             self._wal_records_prior += self.persistence.wal_records_total
             self._snapshots_prior += self.persistence.snapshots_written
-        self.persistence = StorePersistence(
-            self.store, self.state_file, auto_flush=False, fsync=False
-        )
+            # crash semantics: no flush — but the dead incarnation's WAL
+            # file handle must not outlive it (one leaked fd per restart,
+            # and two live handles on one WAL invite interleaved writes)
+            self.persistence.abandon()
+        self.persistence = self._make_persistence()
         self.persistence.compact()
         self._build_stack()
+        self._recovery_ms.append((time.perf_counter() - t0) * 1e3)
+        self._restored_objects.append(restored)
         self.flight.store = self.store
         self._restarts += 1
         self._note(tick, "restart", restored)
+
+    def _agent_faults(self, tick: int) -> None:
+        """Apply agent-level faults at the tick boundary. ``agent_crash``
+        drops the fake agent's process state and rebuilds it from the
+        job-state journal — applied BEFORE the bridge faults so a
+        simultaneous bridge+agent crash has the reloaded bridge resync
+        against the reloaded agent (the composed-durability shape)."""
+        plan = self.scenario.faults
+        for _ in plan.starting("agent_crash", tick):
+            t0 = time.perf_counter()
+            restored = self.cluster.crash_reload()
+            self._recovery_ms.append((time.perf_counter() - t0) * 1e3)
+            self._agent_restored_jobs.append(restored)
+            self._agent_restarts += 1
+            self._note(tick, "agent-crash", restored)
 
     def _bridge_faults(self, tick: int) -> None:
         """Apply bridge-level faults at the tick boundary, then renew or
@@ -549,8 +629,9 @@ class SimHarness:
 
     def _run_tick(self, tick: int, *, arrivals: bool = True) -> dict[str, float]:
         cpu0 = time.process_time()
-        if isinstance(self.client, FaultyClient):
-            self.client.set_tick(tick)
+        if self.faulty is not None:
+            self.faulty.set_tick(tick)
+        self._agent_faults(tick)
         self._bridge_faults(tick)
         self._apply_fault_boundaries(tick)
 
@@ -656,10 +737,15 @@ class SimHarness:
         if self.persistence is not None and self._stack_up:
             # tick-boundary durability: everything the control loops
             # committed this tick is WAL-appended before virtual time
-            # moves — the state a crash at the NEXT boundary recovers
+            # moves — the state a crash at the NEXT boundary recovers.
+            # Timed separately from the phase clock (``wal_flush_ms``):
+            # this is where injected fsync latency lands, and folding it
+            # into a phase would break the flight-record reconciliation
+            t3 = time.perf_counter()
             self.persistence.flush()
             if (tick + 1) % self._COMPACT_EVERY == 0:
                 self.persistence.compact()
+            self._wal_flush_ms.append((time.perf_counter() - t3) * 1e3)
 
         tick_ms = sum(phases.get(k, 0.0) for k in PHASES)
         phases["tick"] = tick_ms
@@ -715,7 +801,51 @@ class SimHarness:
         )
         return hashlib.sha256(payload.encode()).hexdigest()
 
+    def _final_outcome_digest(self) -> str:
+        """SHA-256 over the run's final LIFECYCLE outcomes — the
+        id/placement-insensitive sibling of :meth:`_final_state_digest`.
+
+        Composed chaos (a crash inside an ``rpc_error`` window) can
+        legitimately delay a submission past the window: the job then
+        draws a later Slurm id and possibly different nodes than the
+        crash-free twin, so byte-identical *state* is unachievable even
+        though nothing was lost. What MUST still hold — and what this
+        digest captures — is that every pod reaches the same phase with
+        a job behind it, every CR ends in the same state with the same
+        subjob-state multiset, every sim-side job (by name) reaches the
+        same terminal state, and the node set matches. Numeric job ids,
+        node assignments and volatile fields are excluded by design."""
+        pods = sorted(
+            (
+                p.name,
+                p.status.phase,
+                p.meta.owner,
+                bool(p.meta.deleted),
+                bool(p.status.job_ids),
+            )
+            for p in self.store.list(Pod.KIND)
+        )
+        jobs = sorted(
+            (
+                j.name,
+                j.status.state,
+                sorted(int(s.state) for s in j.status.subjobs.values()),
+            )
+            for j in self.store.list(BridgeJob.KIND)
+        )
+        nodes = sorted(n.name for n in self.store.list(VirtualNode.KIND))
+        sim = sorted(
+            (j.name, int(j.state)) for j in self.cluster.jobs.values()
+        )
+        payload = json.dumps(
+            {"pods": pods, "jobs": jobs, "nodes": nodes, "sim": sim},
+            sort_keys=True,
+        )
+        return hashlib.sha256(payload.encode()).hexdigest()
+
     def _cleanup(self) -> None:
+        if self.agent_journal is not None:
+            self.agent_journal.close()
         if self._state_dir is not None:
             shutil.rmtree(self._state_dir, ignore_errors=True)
             self._state_dir = None
@@ -819,9 +949,15 @@ class SimHarness:
             ),
             "rpc_failures": dict(sorted(self.rpc_failures.items())),
             "injected_errors": dict(
-                sorted(self.client.injected_errors.items())
+                sorted(self.faulty.injected_errors.items())
             )
-            if isinstance(self.client, FaultyClient)
+            if self.faulty is not None
+            else {},
+            # bounded-retry healing (PR-8): attempts retried per method —
+            # the difference between injected_errors and rpc_failures is
+            # exactly what the retry layer absorbed
+            "rpc_retries": dict(sorted(self.retrier.retries.items()))
+            if self.retrier is not None
             else {},
             "invariant_violations": [v.as_dict() for v in self.violations],
             "recovery_ticks": (
@@ -842,7 +978,16 @@ class SimHarness:
                 if self._active_elector is not None
                 else ""
             ),
+            # composed chaos (PR-8): agent-side crash/reload count, the
+            # object/job counts each recovery restored (deterministic —
+            # tick-boundary state is), and the outcome digest the
+            # composed-fault twin gate compares when id/placement
+            # reshuffles make byte-identical state unachievable
+            "agent_restarts": self._agent_restarts,
+            "restored_objects": list(self._restored_objects),
+            "agent_restored_jobs": list(self._agent_restored_jobs),
             "final_state_digest": self._final_state_digest(),
+            "final_outcome_digest": self._final_outcome_digest(),
             "digest": self._digest.hexdigest(),
         }
         phase_arr = {
@@ -868,9 +1013,25 @@ class SimHarness:
             "decoded_views_total": self.store.view_builds_total(),
             "rows_written_total": self.store.rows_written_total(),
             "injected_latency_ms": round(
-                self.client.injected_latency_ms, 3
+                self.faulty.injected_latency_ms, 3
             )
-            if isinstance(self.client, FaultyClient)
+            if self.faulty is not None
+            else 0.0,
+            # recovery cost (PR-8): wall ms per stack/agent reload —
+            # what the slow full_50kx10k_crash scenario proves bounded
+            # at the headline shape
+            "recovery_ms": [round(v, 3) for v in self._recovery_ms],
+            # the tick-boundary WAL flush+compact cost, where injected
+            # fsync latency lands (outside the phase clock by design)
+            "wal_flush_p50_ms": round(
+                float(np.median(self._wal_flush_ms)), 3
+            )
+            if self._wal_flush_ms
+            else 0.0,
+            "wal_flush_p95_ms": round(
+                float(np.percentile(self._wal_flush_ms, 95)), 3
+            )
+            if self._wal_flush_ms
             else 0.0,
             # WAL pressure (timing, not determinism: a VirtualNode
             # heartbeat rides wall time, so record counts can wiggle):
@@ -886,6 +1047,19 @@ class SimHarness:
             + (
                 self.persistence.snapshots_written
                 if self.persistence is not None
+                else 0
+            ),
+            # agent journal pressure (PR-8): records appended + fsyncs
+            # issued (the group-commit ratio shows up here under the
+            # real agent; the sim journal runs fsync-off)
+            "agent_journal_records_total": (
+                self.agent_journal.records_total
+                if self.agent_journal is not None
+                else 0
+            ),
+            "agent_journal_snapshots_total": (
+                self.agent_journal.snapshots_written
+                if self.agent_journal is not None
                 else 0
             ),
         }

@@ -212,6 +212,7 @@ def crash_restart(scale: float = 1.0, seed: int = 48) -> Scenario:
         seed=seed,
         persistence=True,
         max_recovery_ticks=8,
+        lossless_twin="state",
     )
 
 
@@ -252,6 +253,155 @@ def leader_failover(scale: float = 1.0, seed: int = 49) -> Scenario:
     )
 
 
+def agent_crash(scale: float = 1.0, seed: int = 50) -> Scenario:
+    """The AGENT process dies mid-run: jobs, submit ledger, queue and
+    per-node allocation all drop and rebuild from the job-state journal
+    (``agent/journal.py``). The smoke gate proves the reload lossless —
+    final state byte-identical to the crash-free run — which is exactly
+    the dedupe + in-flight-state durability a real login-node daemon
+    restart needs (JIRIAF's operating model)."""
+    return Scenario(
+        name="agent_crash",
+        description="agent process state dies at tick 5; journal replay "
+        "rebuilds ledger + in-flight jobs losslessly",
+        cluster=ClusterSpec(num_nodes=_n(300, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(800, scale, floor=20), arrival="poisson", spread_ticks=8
+        ),
+        faults=FaultPlan(
+            (Fault(kind="agent_crash", start_tick=5, end_tick=6),)
+        ),
+        ticks=16,
+        seed=seed,
+        # the window closes at tick 6 but arrivals keep coming to tick 8
+        # and jobs run tens of virtual seconds — the bound covers natural
+        # workload drain, not journal replay (which is same-tick)
+        max_recovery_ticks=24,
+        lossless_twin="state",
+    )
+
+
+def chaos_dual_crash(scale: float = 1.0, seed: int = 51) -> Scenario:
+    """The composed-durability headline: bridge AND agent crash at the
+    SAME tick. The bridge reloads snapshot+WAL, the agent reloads its
+    journal, and the reloaded bridge's resync runs against the reloaded
+    agent — in-flight submits dedupe through the journaled ledger, so
+    nothing double-submits and nothing is lost. Gated byte-identical to
+    the crash-free twin."""
+    return Scenario(
+        name="chaos_dual_crash",
+        description="simultaneous bridge+agent crash at tick 6; both "
+        "reload (snapshot+WAL / journal) losslessly",
+        cluster=ClusterSpec(num_nodes=_n(300, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(900, scale, floor=20), arrival="poisson", spread_ticks=8
+        ),
+        faults=FaultPlan(
+            (
+                Fault(kind="crash_restart", start_tick=6, end_tick=7),
+                Fault(kind="agent_crash", start_tick=6, end_tick=7),
+            )
+        ),
+        ticks=16,
+        seed=seed,
+        persistence=True,
+        max_recovery_ticks=8,
+        lossless_twin="state",
+    )
+
+
+def chaos_crash_rpc_flap(scale: float = 1.0, seed: int = 52) -> Scenario:
+    """Crash DURING a degraded-RPC window: 25% UNAVAILABLE on the
+    mirror/submit/inventory RPCs for ticks 4-10 with injected latency,
+    and the bridge crashes at tick 6 — recovery has to re-converge
+    THROUGH the still-flapping RPC plane. Bounded retries
+    (``rpc_retries``) absorb the transient errors, so no control-loop
+    round fails outright; the crash-free twin (same flap, no crash) must
+    end with identical lifecycle outcomes."""
+    return Scenario(
+        name="chaos_crash_rpc_flap",
+        description="25% UNAVAILABLE + latency on ticks 4-10; bridge "
+        "crashes at tick 6 and recovers through the flap (retries on)",
+        cluster=ClusterSpec(num_nodes=_n(300, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(900, scale, floor=20), arrival="poisson", spread_ticks=8
+        ),
+        faults=FaultPlan(
+            (
+                Fault(
+                    kind="rpc_error",
+                    start_tick=4,
+                    end_tick=10,
+                    # whole-RPC faults on the batched forms + inventory:
+                    # every one is retry-healable (per-item "SubmitJob"
+                    # faults would surface as ok=false entries instead,
+                    # which retries cannot and should not mask)
+                    methods=("SubmitJobs", "JobsInfo", "Partitions", "Nodes"),
+                    rate=0.25,
+                ),
+                Fault(
+                    kind="rpc_latency",
+                    start_tick=4,
+                    end_tick=10,
+                    methods=("SubmitJobs", "JobsInfo"),
+                    latency_ms=25.0,
+                ),
+                Fault(kind="crash_restart", start_tick=6, end_tick=7),
+            )
+        ),
+        ticks=18,
+        seed=seed,
+        persistence=True,
+        rpc_retries=True,
+        max_recovery_ticks=10,
+        lossless_twin="outcome",
+    )
+
+
+def chaos_crash_into_vanished_partition(
+    scale: float = 1.0, seed: int = 53
+) -> Scenario:
+    """Crash recovering INTO a shrunken inventory: partition part1
+    vanishes at tick 5 and the bridge crashes the same tick. The
+    reloaded configurator never knew the partition, so the restored
+    VirtualNode stays in the store unmanaged (ZERO deletions — the gate)
+    until part1 returns at tick 12 and the fresh provider adopts it
+    uid-stably. Everything converges after the window; final state
+    byte-identical to the crash-free twin."""
+    return Scenario(
+        name="chaos_crash_into_vanished_partition",
+        description="partition part1 vanishes ticks 5-12 and the bridge "
+        "crashes at tick 5: recovery into the vanished partition, zero "
+        "node flap, adoption on return",
+        cluster=ClusterSpec(num_nodes=_n(300, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(800, scale, floor=20), arrival="poisson", spread_ticks=6
+        ),
+        faults=FaultPlan(
+            (
+                Fault(
+                    kind="partition_vanish",
+                    start_tick=5,
+                    end_tick=12,
+                    partition="part1",
+                ),
+                Fault(kind="crash_restart", start_tick=5, end_tick=6),
+            )
+        ),
+        ticks=18,
+        seed=seed,
+        persistence=True,
+        max_recovery_ticks=14,
+        # "outcome", not "state": the CRASH-FREE twin observes the vanish
+        # live, deletes the partition's VirtualNode and re-binds its
+        # not-yet-submitted pods on return — the crashed arm preserves
+        # the original bindings (strictly less churn), so placements
+        # legitimately permute among equivalent nodes while every
+        # lifecycle outcome must still match byte-for-byte
+        lossless_twin="outcome",
+    )
+
+
 def full_50kx10k(scale: float = 1.0, seed: int = 42) -> Scenario:
     """The headline: 50k pods × 10k nodes through the FULL bridge
     pipeline. Slow (minutes); records ``full_tick_p50_ms_50kx10k`` with
@@ -276,6 +426,37 @@ def full_50kx10k(scale: float = 1.0, seed: int = 42) -> Scenario:
     )
 
 
+def full_50kx10k_crash(scale: float = 1.0, seed: int = 42) -> Scenario:
+    """Recovery at the HEADLINE shape (slow, minutes): the 50k×10k
+    front-loaded scenario with a bridge crash after the cold-start tick.
+    Until PR-8 every crash scenario ran at smoke scale only — this one
+    proves snapshot+WAL reload and level-triggered re-convergence stay
+    bounded when the snapshot carries ~60k objects (``recovery_ms`` in
+    the timing section is the number BASELINE.md records)."""
+    return Scenario(
+        name="full_50kx10k_crash",
+        description="bridge crash + snapshot/WAL reload at the 50k x 10k "
+        "product shape (slow)",
+        cluster=ClusterSpec(num_nodes=_n(10_000, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(50_000, scale, floor=100),
+            arrival="front",
+            gang_fraction=0.05,
+            gpu_fraction=0.15,
+            duration_range=(30.0, 120.0),
+        ),
+        faults=FaultPlan(
+            (Fault(kind="crash_restart", start_tick=2, end_tick=3),)
+        ),
+        ticks=4,
+        expect_drain=False,
+        drain_grace_ticks=0,
+        seed=seed,
+        persistence=True,
+        slow=True,
+    )
+
+
 SCENARIOS = {
     f.__name__: f
     for f in (
@@ -287,9 +468,30 @@ SCENARIOS = {
         partition_vanish,
         crash_restart,
         leader_failover,
+        agent_crash,
+        chaos_dual_crash,
+        chaos_crash_rpc_flap,
+        chaos_crash_into_vanished_partition,
         full_50kx10k,
+        full_50kx10k_crash,
     )
 }
 
-#: the fast set `make sim-smoke` double-runs (everything but the slow one)
-SMOKE_SCENARIOS = tuple(n for n, f in SCENARIOS.items() if n != "full_50kx10k")
+#: the composed-fault subset `make chaos-smoke` double-runs: crash
+#: windows overlapping degraded-RPC/vanished-partition windows, agent
+#: crashes, and the simultaneous bridge+agent crash — all twin-gated
+CHAOS_SCENARIOS = (
+    "agent_crash",
+    "chaos_dual_crash",
+    "chaos_crash_rpc_flap",
+    "chaos_crash_into_vanished_partition",
+)
+
+#: the fast set `make sim-smoke` double-runs: everything not slow-marked,
+#: MINUS the chaos subset — `make check` and CI run sim-smoke and
+#: chaos-smoke side by side, so overlap would execute each chaos
+#: scenario (and its crash-free twin) twice for zero added coverage
+SMOKE_SCENARIOS = tuple(
+    n for n, f in SCENARIOS.items()
+    if not f().slow and n not in CHAOS_SCENARIOS
+)

@@ -16,6 +16,8 @@ cmd/slurm-agent/slurm-agent.go:33-47).
 from __future__ import annotations
 
 import inspect
+import random
+import time
 from dataclasses import dataclass
 
 import grpc
@@ -73,6 +75,174 @@ def dial(endpoint: str) -> grpc.Channel:
     return grpc.insecure_channel(normalize_endpoint(endpoint))
 
 
+# --------------------------------------------------------------- retries
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry for transient unary-call failures (PR-8).
+
+    Exponential backoff with equal jitter, capped per-attempt delay, an
+    overall deadline, and a closed set of retryable codes. The DEFAULT
+    retries only UNAVAILABLE — the transport-flap shape (agent restart,
+    wire blip) where the request almost certainly never reached the
+    server. DEADLINE_EXCEEDED is transient too but NOT default-safe: the
+    deadline can expire AFTER the server processed the call, so retrying
+    a ledger-less SubmitJob would duplicate the Slurm job. Callers whose
+    writes are idempotent — the bridge, whose every submit carries a
+    ``submitter_id`` the agent's journal-backed ledger dedupes
+    (``agent/server.py`` + ``agent/journal.py``) — opt in via
+    ``RetryPolicy(codes=TRANSIENT_CODES)``. Everything else (NOT_FOUND,
+    INVALID_ARGUMENT, INTERNAL…) is the server answering and surfaces
+    immediately.
+    """
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.05
+    max_delay_s: float = 1.0
+    multiplier: float = 2.0
+    #: give up once the NEXT sleep would cross this much elapsed time
+    deadline_s: float = 8.0
+    codes: tuple[str, ...] = ("UNAVAILABLE",)
+
+    def backoff_s(self, attempt: int, rng) -> float:
+        """Delay before retry ``attempt`` (1-based): exponential, capped,
+        equal-jitter (half fixed + half uniform — never collapses to 0,
+        never synchronizes a thundering herd)."""
+        raw = min(
+            self.max_delay_s, self.base_delay_s * self.multiplier ** (attempt - 1)
+        )
+        return raw / 2.0 + rng.random() * raw / 2.0
+
+
+#: both transient shapes — for callers whose writes are ledger-deduped
+TRANSIENT_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED")
+
+#: the default policy ServiceClient applies to every unary RPC
+DEFAULT_RETRY = RetryPolicy()
+
+
+def _retries_counter():
+    # lazy: wire must stay importable without dragging obs in at module
+    # import (same posture as the tracing import in _traced_call)
+    global _RETRIES_TOTAL
+    if _RETRIES_TOTAL is None:
+        from slurm_bridge_tpu.obs.metrics import REGISTRY
+
+        _RETRIES_TOTAL = REGISTRY.counter(
+            "sbt_rpc_retries_total",
+            "unary RPC attempts retried after a transient status code",
+        )
+    return _RETRIES_TOTAL
+
+
+_RETRIES_TOTAL = None
+
+
+def _code_name(err: grpc.RpcError) -> str:
+    code = getattr(err, "code", None)
+    if not callable(code):
+        return ""
+    try:
+        c = code()
+    except Exception:
+        return ""
+    return getattr(c, "name", "")
+
+
+def call_with_retries(
+    fn,
+    request,
+    *,
+    method: str,
+    policy: RetryPolicy,
+    timeout=None,
+    sleep=time.sleep,
+    clock=time.monotonic,
+    rng=None,
+    on_retry=None,
+):
+    """Run one unary call under the retry policy.
+
+    ``sleep``/``clock``/``rng`` are injectable so the simulator retries
+    on virtual time (no wall-clock sleeps) and tests are deterministic.
+    ``on_retry(method, attempt, code)`` fires before each retry (the
+    metric hook; RetryingClient also counts through it).
+    """
+    rng = rng if rng is not None else random
+    start = clock()
+    attempt = 1
+    while True:
+        try:
+            return fn(request, timeout=timeout)
+        except grpc.RpcError as err:
+            code = _code_name(err)
+            if code not in policy.codes or attempt >= policy.max_attempts:
+                raise
+            delay = policy.backoff_s(attempt, rng)
+            if clock() - start + delay > policy.deadline_s:
+                raise
+            _retries_counter().inc(method=method)
+            if on_retry is not None:
+                on_retry(method, attempt, code)
+            sleep(delay)
+            attempt += 1
+
+
+class RetryingClient:
+    """Bounded-retry wrapper over any WorkloadManager-shaped client —
+    the duck-typed form the simulator stacks over its :class:`FaultyClient`
+    (``ServiceClient`` applies the same policy natively to real channels).
+    Only callable attributes are wrapped; ``close()`` passes through.
+    """
+
+    def __init__(
+        self,
+        inner,
+        *,
+        policy: RetryPolicy = DEFAULT_RETRY,
+        sleep=time.sleep,
+        clock=time.monotonic,
+        seed: int | None = None,
+    ):
+        self._inner = inner
+        self._policy = policy
+        self._sleep = sleep
+        self._clock = clock
+        self._rng = random.Random(seed) if seed is not None else random
+        #: retries performed, by method — the sim's determinism section
+        #: reads this (the metric is process-global, runs would bleed)
+        self.retries: dict[str, int] = {}
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def _count(self, method: str, attempt: int, code: str) -> None:
+        self.retries[method] = self.retries.get(method, 0) + 1
+
+    def __getattr__(self, name: str):
+        inner_fn = getattr(self._inner, name)
+        if not callable(inner_fn) or name.startswith("_"):
+            return inner_fn
+
+        def call(request, timeout=None):
+            return call_with_retries(
+                inner_fn,
+                request,
+                method=name,
+                policy=self._policy,
+                timeout=timeout,
+                sleep=self._sleep,
+                clock=self._clock,
+                rng=self._rng,
+                on_retry=self._count,
+            )
+
+        # memoize: __getattr__ only fires on cache misses afterwards —
+        # the sim routes tens of thousands of calls per run through here
+        setattr(self, name, call)
+        return call
+
+
 def _traced_call(method_name: str, multicallable, unary: bool):
     """Wrap a multicallable with trace propagation: when the caller is
     inside an active span, a ``traceparent`` metadata entry rides the RPC
@@ -105,14 +275,41 @@ def _traced_call(method_name: str, multicallable, unary: bool):
     return call
 
 
+def _retrying_call(method_name: str, traced, policy: RetryPolicy):
+    """Retry wrapper OUTSIDE the traced call, so every attempt gets its
+    own ``rpc.client.<Method>`` span inside an active trace."""
+
+    def call(request, timeout=None, metadata=None):
+        return call_with_retries(
+            lambda req, timeout=None: traced(req, timeout=timeout, metadata=metadata),
+            request,
+            method=method_name,
+            policy=policy,
+            timeout=timeout,
+        )
+
+    return call
+
+
 class ServiceClient:
     """Dynamic client stub: one callable attribute per RPC.
+
+    Unary calls carry bounded retries for transient codes
+    (UNAVAILABLE/DEADLINE_EXCEEDED — see :class:`RetryPolicy`); pass
+    ``retry=None`` to fail fast instead. Streams are never retried (they
+    outlive the call frame; the caller owns resumption).
 
     >>> client = ServiceClient(dial("localhost:9999"), "WorkloadManager")
     >>> client.SubmitJob(pb.SubmitJobRequest(script="...", partition="debug"))
     """
 
-    def __init__(self, channel: grpc.Channel, service_name: str):
+    def __init__(
+        self,
+        channel: grpc.Channel,
+        service_name: str,
+        *,
+        retry: RetryPolicy | None = DEFAULT_RETRY,
+    ):
         self._channel = channel
         full_name, specs = service_methods(service_name)
         for spec in specs:
@@ -122,13 +319,11 @@ class ServiceClient:
                 request_serializer=spec.req_cls.SerializeToString,
                 response_deserializer=spec.resp_cls.FromString,
             )
-            setattr(
-                self,
-                spec.name,
-                _traced_call(
-                    spec.name, multicallable, unary=spec.kind == "unary_unary"
-                ),
-            )
+            unary = spec.kind == "unary_unary"
+            call = _traced_call(spec.name, multicallable, unary=unary)
+            if unary and retry is not None:
+                call = _retrying_call(spec.name, call, retry)
+            setattr(self, spec.name, call)
 
     def close(self) -> None:
         self._channel.close()
