@@ -4,8 +4,9 @@
 # workflows can never drift.
 
 .PHONY: help test fast check generate apidoc hygiene bench bench-smoke \
-        sim-smoke chaos-smoke sim sim-bench sim-bench-crash wal-fsync-bench \
-        scenarios docker-build install uninstall deploy undeploy run demo
+        sim-smoke chaos-smoke quality-smoke sim sim-bench sim-bench-crash \
+        wal-fsync-bench scenarios docker-build install uninstall deploy \
+        undeploy run demo
 
 help: ## Display this help.
 	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ \
@@ -17,7 +18,7 @@ test: ## Full suite + graft compile contracts + hygiene (ref: make test).
 fast: ## ~2-min signal: everything not marked slow.
 	python -m pytest tests/ -q -m "not slow"
 
-check: test bench-smoke sim-smoke chaos-smoke ## Alias the reference's CI verb (+ encode, sim & chaos gates).
+check: test bench-smoke sim-smoke chaos-smoke quality-smoke ## Alias the reference's CI verb (+ encode, sim, chaos & quality gates).
 
 generate: ## Regenerate protobuf bindings + API docs (ref: make generate).
 	hack/regen-proto.sh
@@ -40,6 +41,9 @@ sim-smoke: ## Small-shape sim scenarios, double-run: determinism + invariants.
 
 chaos-smoke: ## Composed-fault scenarios only, double-run + crash-free twin digests.
 	python -m slurm_bridge_tpu.sim --chaos
+
+quality-smoke: ## Placement-quality scenarios: policy-on/off arms + scorecard floors.
+	python -m slurm_bridge_tpu.sim --quality
 
 sim: ## Run every fast sim scenario full-size (see --list for names).
 	python -m slurm_bridge_tpu.sim --all

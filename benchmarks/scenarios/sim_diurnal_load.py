@@ -1,0 +1,21 @@
+"""Sim scenario: sinusoidal day/night load on an approximate auction.
+
+Gang-heavy diurnal arrivals against an auction deliberately configured
+without its in-engine repair — the policy backfill pass fills the
+admission holes; `make quality-smoke` gates utilization + gang wait
+against the policy-off twin.
+
+    python -m benchmarks.scenarios.sim_diurnal_load [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.diurnal_load``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import diurnal_load as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "diurnal_load"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

@@ -1,0 +1,21 @@
+"""Sim scenario: mid-flight shard-count changes (VirtualFlow).
+
+Two resize windows cancel running jobs, rewrite their demand's node
+count under a fresh submit generation, and the scheduler re-places
+them at the new shape — gang atomicity, capacity and eventual drain
+all hold (gated in `make quality-smoke`).
+
+    python -m benchmarks.scenarios.sim_elastic_resize [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.elastic_resize``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import elastic_resize as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "elastic_resize"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

@@ -1,0 +1,21 @@
+"""Sim scenario: four skewed tenants slam an oversubscribed cluster.
+
+Front-loaded arrivals with per-tenant priority skew; jobs outlive the
+window, so admission order IS the service split. `make quality-smoke`
+gates the Jain fairness index: ≥0.9 with weighted fair share on, <0.7
+under the policy-off priority-FIFO baseline.
+
+    python -m benchmarks.scenarios.sim_multi_tenant_storm [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.multi_tenant_storm``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import multi_tenant_storm as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "multi_tenant_storm"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

@@ -1,0 +1,22 @@
+"""Sim scenario: priority inversion — class trumps numeric priority.
+
+Batch incumbents with HIGH numeric priorities fill the cluster; a
+production gang with numeric priority 10 arrives mid-run. Policy-off
+never preempts (the inversion); with the class table on, the gang
+displaces preemptible batch work and binds within its wait bound
+(gated in `make quality-smoke`).
+
+    python -m benchmarks.scenarios.sim_priority_inversion [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.priority_inversion``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import priority_inversion as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "priority_inversion"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

@@ -40,6 +40,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--preemption", action="store_true",
                         help="let higher-priority pending jobs displace "
                              "lower-priority submitted ones (auction only)")
+    parser.add_argument("--policy", action="store_true",
+                        help="enable the placement-policy engine: priority "
+                             "classes, per-tenant fair share, bounded "
+                             "preemption pool, backfill "
+                             "(docs/scheduling-policy.md)")
+    parser.add_argument("--policy-max-preemptions", type=int, default=64,
+                        help="churn bound: incumbents displaceable per "
+                             "scheduler tick (with --policy)")
     parser.add_argument("--threads", type=int, default=2,
                         help="operator reconciler workers (--slurm-bridge-operator-threads)")
     parser.add_argument("--configurator-interval", type=float, default=30.0)
@@ -92,11 +100,26 @@ def main(argv: list[str] | None = None) -> int:
         kubelet_port = args.kubelet_port
     else:
         kubelet_port = vncfg.port if vncfg.port > 0 else -1
+    policy = None
+    if args.policy:
+        from slurm_bridge_tpu.policy import PlacementPolicy, PolicyConfig
+
+        if not args.preemption:
+            log.warning(
+                "--policy without --preemption: classes, fair share and "
+                "backfill apply, but the preemption pool is inactive — "
+                "a higher class cannot displace running work (pass "
+                "--preemption to enable it)"
+            )
+        policy = PlacementPolicy(
+            PolicyConfig(max_preemptions_per_tick=args.policy_max_preemptions)
+        )
     bridge = Bridge(
         args.endpoint,
         scheduler_backend=args.scheduler,
         solver_endpoint=args.scheduler_endpoint,
         preemption=args.preemption,
+        policy=policy,
         state_file=args.state_file,
         configurator_interval=args.configurator_interval,
         operator_workers=args.threads,

@@ -67,6 +67,10 @@ from slurm_bridge_tpu.core.types import JobDemand
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
 from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.obs.tracing import TRACER, current_span
+from slurm_bridge_tpu.policy.classes import (
+    CLASS_LABEL as _CLASS_LABEL,
+    TENANT_LABEL as _TENANT_LABEL,
+)
 
 log = logging.getLogger("sbt.operator")
 
@@ -827,6 +831,22 @@ class BridgeOperator:
     def _build_sizecar(self, job: BridgeJob) -> Pod:
         demand = demand_for_job(job)
         arr = array_len(demand.array)
+        labels = {
+            "role": PodRole.SIZECAR,
+            "partition": demand.partition,
+            # resource-request labels (pod.go:164-187)
+            "request-cpu": str(demand.total_cpus(arr)),
+            "request-memory-mb": str(demand.total_mem_mb(arr)),
+        }
+        job_labels = job.meta.labels
+        if job_labels:
+            # policy-bearing labels ride from the CR onto the sizecar —
+            # the scheduler's class/tenant resolution reads the POD
+            # (policy/classes.py); jobs without them pay nothing
+            for key in (_TENANT_LABEL, _CLASS_LABEL):
+                val = job_labels.get(key)
+                if val:
+                    labels[key] = val
         # fast_new (every field explicit): one sizecar per arrival, 50k
         # deep on a cold-start tick, against freeze-guarded classes
         return fast_new(
@@ -835,13 +855,7 @@ class BridgeOperator:
                 Meta,
                 name=sizecar_name(job.meta.name),
                 uid=new_uid(),
-                labels={
-                    "role": PodRole.SIZECAR,
-                    "partition": demand.partition,
-                    # resource-request labels (pod.go:164-187)
-                    "request-cpu": str(demand.total_cpus(arr)),
-                    "request-memory-mb": str(demand.total_mem_mb(arr)),
-                },
+                labels=labels,
                 annotations={},
                 owner=job.meta.name,
                 resource_version=0,

@@ -35,7 +35,11 @@ from slurm_bridge_tpu.bridge.store import NotFound, ObjectStore
 from slurm_bridge_tpu.obs.events import EventRecorder
 from slurm_bridge_tpu.solver.auction import AuctionConfig
 from slurm_bridge_tpu.wire import ServiceClient, dial
-from slurm_bridge_tpu.wire.rpc import TRANSIENT_CODES, RetryPolicy
+from slurm_bridge_tpu.wire.rpc import (
+    DEFAULT_METHOD_BUDGETS,
+    TRANSIENT_CODES,
+    RetryPolicy,
+)
 
 log = logging.getLogger("sbt.bridge")
 
@@ -60,6 +64,7 @@ class Bridge:
         kubelet_tls_cert: str = "",
         kubelet_tls_key: str = "",
         state_file: str = "",
+        policy=None,
     ):
         self.agent_endpoint = agent_endpoint
         self.store = ObjectStore()
@@ -79,11 +84,16 @@ class Bridge:
         # DEADLINE_EXCEEDED joins the retryable set here because every
         # bridge submit carries a submitter_id the agent's journal-backed
         # ledger dedupes — a retry whose first attempt actually landed is
-        # a no-op, not a duplicate Slurm job
+        # a no-op, not a duplicate Slurm job. Per-RPC budgets size the
+        # retry deadline to each method's real cost and bound every
+        # attempt, so one hung call can't eat the whole budget.
         self.client = ServiceClient(
             self.channel,
             "WorkloadManager",
-            retry=RetryPolicy(codes=TRANSIENT_CODES),
+            retry=RetryPolicy(
+                codes=TRANSIENT_CODES,
+                method_budgets=DEFAULT_METHOD_BUDGETS,
+            ),
         )
         self.operator = BridgeOperator(
             self.store,
@@ -109,6 +119,7 @@ class Bridge:
             preemption=preemption,
             solver_endpoint=solver_endpoint,
             sharded=sharded,
+            policy=policy,
         )
         self._sched_ticker = Ticker(
             scheduler_interval, self.scheduler.tick, name="scheduler"
@@ -170,8 +181,19 @@ class Bridge:
 
     # ---- user surface (the kubectl shape) ----
 
-    def submit(self, name: str, spec: BridgeJobSpec) -> BridgeJob:
-        job = BridgeJob(meta=Meta(name=name), spec=spec)
+    def submit(
+        self,
+        name: str,
+        spec: BridgeJobSpec,
+        *,
+        labels: dict[str, str] | None = None,
+    ) -> BridgeJob:
+        """Create the CR. ``labels`` carry CR metadata — notably the
+        policy's priority-class/tenant labels (docs/scheduling-policy.md),
+        which the operator mirrors onto the sizecar pod."""
+        job = BridgeJob(
+            meta=Meta(name=name, labels=dict(labels or {})), spec=spec
+        )
         validate_bridge_job(job)
         created = self.store.create(job)
         self.operator.enqueue(name)

@@ -81,6 +81,9 @@ class _RowPod(NamedTuple):
     reason: str
     hint: tuple = ()
     obj: object = None
+    #: pod labels (policy-bearing ticks only — tenant/class resolution);
+    #: None on the policy-off hot path so the 50k cold scan pays nothing
+    labels: object = None
 
 _tick_seconds = REGISTRY.histogram(
     "sbt_scheduler_tick_seconds", "placement solve wall time per tick"
@@ -138,6 +141,7 @@ class PlacementScheduler:
         retry_cancel_timeout: float = 2.0,
         place_timeout: float = 120.0,
         inventory_ttl: float = 1.0,
+        policy=None,
     ):
         if backend not in ("auto", "auction", "greedy"):
             raise ValueError(f"unknown scheduler backend {backend!r}")
@@ -157,6 +161,17 @@ class PlacementScheduler:
         self.auction_config = auction_config or AuctionConfig()
         self.events = events or EventRecorder()
         self.preemption = preemption
+        #: placement policy engine (slurm_bridge_tpu.policy) — priority
+        #: classes, fair-share admission order, bounded preemption pool,
+        #: backfill. None (the default) is the PR-8 tick byte-for-byte.
+        self.policy = policy
+        if policy is not None and solver_endpoint:
+            log.warning(
+                "placement policy attached with a remote solver sidecar: "
+                "admission order and the preemption pool apply, but "
+                "effective priorities cannot ride the Place RPC — class "
+                "dominance inside the remote solve is not enforced"
+            )
         self.bucket = bucket
         #: sharded auto-select (VERDICT r2 #4): with ``sharded=None`` the
         #: multi-device shard_map sweep engages when a mesh exists AND the
@@ -275,12 +290,14 @@ class PlacementScheduler:
         feed it straight from the "" node-index bucket's columns (no
         frozen views); object stores wrap :meth:`pending_pods`."""
         table = self.store.table(Pod.KIND)
+        want_labels = self.policy is not None
         if table is None:
             return [
                 _RowPod(
                     p.name, p.meta.uid, p.meta.resource_version,
                     p.spec.demand, p.spec.partition, p.status.reason,
                     p.spec.placement_hint, p,
+                    p.meta.labels if want_labels else None,
                 )
                 for p in self.pending_pods()
             ]
@@ -302,9 +319,16 @@ class PlacementScheduler:
             )
             sel = np.nonzero(keep)[0]
             rws = rows[sel]
+            # labels only on policy-bearing ticks: the column gather is
+            # cheap but pure waste on the 50k policy-off cold scan
+            lab = (
+                c.labels[rws].tolist()
+                if want_labels
+                else (None,) * int(sel.size)
+            )
             return [
-                _RowPod(names[i], u, rv, d, p, r, hh)
-                for i, u, rv, d, p, r, hh in zip(
+                _RowPod(names[i], u, rv, d, p, r, hh, None, ll)
+                for i, u, rv, d, p, r, hh, ll in zip(
                     sel.tolist(),
                     c.uid[rws].tolist(),
                     c.rv[rws].tolist(),
@@ -312,6 +336,7 @@ class PlacementScheduler:
                     c.partition[rws].tolist(),
                     c.reason[rws].tolist(),
                     c.hint[rws].tolist(),
+                    lab,
                 )
             ]
 
@@ -385,6 +410,7 @@ class PlacementScheduler:
                         p.name, p.meta.uid, p.meta.resource_version,
                         p.spec.demand, p.spec.partition, p.status.reason,
                         p.spec.placement_hint, p,
+                        p.meta.labels if self.policy is not None else None,
                     )
                     for p in (self.incumbent_pods() if self.preemption else [])
                 ]
@@ -400,6 +426,15 @@ class PlacementScheduler:
             _pods_unplaced.set(0)
             return 0
         _store_seconds.observe(store_s)
+        priorities = None
+        if self.policy is not None:
+            # the policy pass: class/tenant resolution, fair-share
+            # admission order, bounded preemption pool, per-job effective
+            # priorities the solver admits by (see policy/engine.py)
+            self.policy.begin_tick(nodes)
+            pods, incumbents, priorities = self.policy.prepare(
+                pods, incumbents
+            )
         all_pods = pods + incumbents
         demands: list[JobDemand] = []
         for pod in all_pods:
@@ -424,9 +459,9 @@ class PlacementScheduler:
             by_job_names, lost_jobs = solved
         else:
             by_job_names, lost_jobs = self._solve_local(
-                partitions, nodes, demands, all_pods, n_pending
+                partitions, nodes, demands, all_pods, n_pending,
+                priorities=priorities,
             )
-
         with TRACER.span("scheduler.bind") as bind_span:
             ready_nodes = {
                 vn.partition
@@ -435,12 +470,14 @@ class PlacementScheduler:
             }
             binds: list[tuple[Pod, str, tuple[str, ...]]] = []
             unschedulable: list[tuple[Pod, str]] = []
+            admitted_idx: list[int] = []
             no_vnode_reason: dict[str, str] = {}  # interned per partition
             for j, pod in enumerate(pods):
                 names = by_job_names.get(j)
                 partition = demands[j].partition
                 if names and partition in ready_nodes:
                     binds.append((pod, partition_node_name(partition), tuple(names)))
+                    admitted_idx.append(j)
                 elif partition in ready_nodes:
                     unschedulable.append(
                         (pod, "Unschedulable: insufficient capacity")
@@ -453,6 +490,12 @@ class PlacementScheduler:
                             f"partition {partition!r}"
                         )
                     unschedulable.append((pod, reason))
+            if self.policy is not None:
+                # fair-share charge for what actually reached the bind
+                # list — a solver assignment whose partition has no
+                # ready virtual node grants no service, and charging it
+                # would starve that tenant once the node comes up
+                self.policy.note_admitted(admitted_idx)
             self._mark_unschedulable_batch(unschedulable)
             placed = self._bind_batch(binds)
             preempted = 0
@@ -478,9 +521,15 @@ class PlacementScheduler:
         return placed
 
     def _solve_local(
-        self, partitions, nodes, demands, all_pods, n_pending
+        self, partitions, nodes, demands, all_pods, n_pending,
+        priorities=None,
     ) -> tuple[dict[int, list[str]], list[int]]:
         """In-process solve: encode, pin incumbents, run the kernel.
+
+        ``priorities`` (policy ticks) overrides the per-job admission
+        priorities without touching the cached encode rows — the row
+        cache stays keyed on demand identity, the override is applied at
+        batch assembly (solver/encoder.py).
 
         Returns (job index → assigned node names, incumbent job indices
         that lost their nodes and must be preempted).
@@ -493,6 +542,7 @@ class PlacementScheduler:
                 demands,
                 snapshot,
                 codes_token=self._encoded.codes_token(),
+                priorities=priorities,
             )
             enc_span.count("rows", int(batch.num_shards))
             enc_span.count("jobs", len(all_pods))
@@ -549,6 +599,15 @@ class PlacementScheduler:
         self.last_phase_ms["solve"] = solve_s * 1e3
         _solve_seconds.observe(solve_s)
         by_job = placement.by_job(batch)
+        if self.policy is not None and self.policy.config.backfill:
+            # cheap second pass: whatever the solve left unplaced —
+            # singles and whole gangs, all-or-nothing — into its
+            # leftover holes, guarded against delaying any other
+            # unplaced equal-or-higher-class gang (policy/engine.py)
+            for row, node in self.policy.backfill(
+                snapshot, batch, placement, n_pending
+            ):
+                by_job.setdefault(int(batch.job_of[row]), []).append(node)
         by_job_names = {
             j: [snapshot.node_names[i] for i in idxs] for j, idxs in by_job.items()
         }

@@ -27,11 +27,22 @@ import sys
 from slurm_bridge_tpu.sim.harness import run_scenario
 from slurm_bridge_tpu.sim.scenarios import (
     CHAOS_SCENARIOS,
+    QUALITY_SCENARIOS,
     SCENARIOS,
     SMOKE_SCENARIOS,
 )
 
 SMOKE_SCALE = 0.12
+
+#: quality-smoke floors (ISSUE 9 acceptance): the fairness split the
+#: multi-tenant storm must show, the utilization margin backfill must
+#: buy on diurnal load, and the wait bound the production gang must make
+QUALITY_GATES = {
+    "jain_on_floor": 0.9,
+    "jain_off_ceiling": 0.7,
+    "util_margin": 0.02,
+    "max_wait_ticks": 3.0,
+}
 
 
 def _build(name: str, *, seed: int | None, scale: float, ticks: int | None):
@@ -195,6 +206,151 @@ def _smoke(names: tuple[str, ...] = SMOKE_SCENARIOS, label: str = "sim-smoke") -
     return 0
 
 
+def _quality(label: str = "quality-smoke") -> int:
+    """The placement-quality gate (ISSUE 9): each quality scenario runs
+    TWICE (determinism over the scorecard too), then its policy-off —
+    and, for diurnal, backfill-off — twin arms run at the same seed and
+    the scorecard floors are enforced:
+
+    - ``multi_tenant_storm``: Jain ≥ 0.9 with fair share on, < 0.7
+      under the priority-FIFO baseline;
+    - ``priority_inversion``: the production gang binds within
+      ``max_wait_ticks`` via ≥1 preemption; the policy-off arm starves
+      it (recorded);
+    - ``diurnal_load``: utilization beats policy-off by the margin,
+      backfill actually fired, and gang waits beat the backfill-off arm;
+    - ``elastic_resize``: every resized job re-places, the scenario
+      drains, zero invariant violations.
+    """
+    import dataclasses
+
+    from slurm_bridge_tpu.policy.engine import PolicyConfig
+
+    g = QUALITY_GATES
+    failures: list[str] = []
+
+    def run(name: str, **replace):
+        sc = SCENARIOS[name](scale=SMOKE_SCALE)
+        if replace:
+            sc = dataclasses.replace(sc, **replace)
+        return run_scenario(sc)
+
+    for name in QUALITY_SCENARIOS:
+        a = run(name)
+        b = run(name)
+        det = (
+            a.determinism_json() == b.determinism_json()
+            and a.quality == b.quality
+        )
+        if not det:
+            failures.append(f"{name}: determinism broke (same seed, "
+                            "different run — scorecard or digest)")
+        if a.determinism["invariant_violations"]:
+            first = a.determinism["invariant_violations"][0]
+            failures.append(f"{name}: invariant violated: {first}")
+        q = a.quality
+        line = {
+            "scenario": name,
+            "deterministic": det,
+            "violations": len(a.determinism["invariant_violations"]),
+            "bound_total": a.determinism["bound_total"],
+            "utilization_mean": q["utilization_mean"],
+            "jain_fairness": q["jain_fairness"],
+            "gang_wait_p95_ticks": q["gang_wait_p95_ticks"],
+            "preempted_total": q["preempted_total"],
+            "backfill_binds": q.get("backfill_binds"),
+            "resizes": q["resizes"],
+        }
+
+        if name == "multi_tenant_storm":
+            off = run(name, policy=None)
+            line["jain_policy_off"] = off.quality["jain_fairness"]
+            if q["jain_fairness"] < g["jain_on_floor"]:
+                failures.append(
+                    f"{name}: Jain {q['jain_fairness']} under the "
+                    f"{g['jain_on_floor']} fair-share floor"
+                )
+            if off.quality["jain_fairness"] >= g["jain_off_ceiling"]:
+                failures.append(
+                    f"{name}: policy-off Jain {off.quality['jain_fairness']} "
+                    f"not under {g['jain_off_ceiling']} — the baseline "
+                    "stopped being unfair, the comparison is vacuous"
+                )
+        elif name == "priority_inversion":
+            off = run(name, policy=None)
+            on_wait = q["class_wait_p95_ticks"].get("production")
+            off_wait = off.quality["class_wait_p95_ticks"].get("production")
+            line["production_wait_p95"] = on_wait
+            line["production_wait_p95_policy_off"] = off_wait
+            if on_wait is None or on_wait > g["max_wait_ticks"]:
+                failures.append(
+                    f"{name}: production gang wait p95 {on_wait} over the "
+                    f"{g['max_wait_ticks']}-tick bound"
+                )
+            if q["preempted_total"] < 1:
+                failures.append(
+                    f"{name}: gang bound without preempting anyone — the "
+                    "scenario no longer exercises class preemption"
+                )
+            if off_wait is not None and on_wait is not None \
+                    and off_wait <= on_wait:
+                failures.append(
+                    f"{name}: policy-off wait {off_wait} not worse than "
+                    f"policy-on {on_wait} — no inversion to fix"
+                )
+        elif name == "diurnal_load":
+            off = run(name, policy=None)
+            nobf = run(name, policy=PolicyConfig(backfill=False))
+            line["utilization_policy_off"] = off.quality["utilization_mean"]
+            line["utilization_backfill_off"] = nobf.quality["utilization_mean"]
+            line["gang_wait_p95_backfill_off"] = nobf.quality[
+                "gang_wait_p95_ticks"
+            ]
+            if q["utilization_mean"] < (
+                off.quality["utilization_mean"] + g["util_margin"]
+            ):
+                failures.append(
+                    f"{name}: utilization {q['utilization_mean']} not "
+                    f"{g['util_margin']} over policy-off "
+                    f"{off.quality['utilization_mean']}"
+                )
+            if not q.get("backfill_binds"):
+                failures.append(f"{name}: backfill never placed anything")
+            if q["gang_wait_p95_ticks"] >= nobf.quality["gang_wait_p95_ticks"]:
+                failures.append(
+                    f"{name}: gang wait p95 {q['gang_wait_p95_ticks']} not "
+                    "under the backfill-off arm "
+                    f"{nobf.quality['gang_wait_p95_ticks']} — backfill "
+                    "isn't what starts the gangs"
+                )
+        elif name == "elastic_resize":
+            if not q["resizes"]:
+                failures.append(f"{name}: no resizes applied")
+            if a.determinism["drained_at_tick"] is None:
+                failures.append(f"{name}: resized workload never drained")
+            if q["unbound_final"]:
+                failures.append(
+                    f"{name}: {q['unbound_final']} jobs never re-placed "
+                    "after resize"
+                )
+            rec = a.determinism["recovery_ticks"]
+            bound = a.scenario.max_recovery_ticks
+            if rec is None or (bound is not None and rec > bound):
+                failures.append(
+                    f"{name}: recovery_ticks {rec} over bound {bound}"
+                )
+        print(json.dumps(line))
+    if failures:
+        for f in failures:
+            print(f"# {label} FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"# {label} OK: {len(QUALITY_SCENARIOS)} scenarios, deterministic, "
+        "scorecard floors held", file=sys.stderr,
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m slurm_bridge_tpu.sim",
@@ -209,6 +365,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--chaos", action="store_true",
                         help="CI gate: only the composed-fault chaos "
                         "scenarios (double-run + crash-free twin digests)")
+    parser.add_argument("--quality", action="store_true",
+                        help="CI gate: the placement-quality scenarios "
+                        "(double-run + policy-on/off arms + scorecard "
+                        "floors — fairness, wait bounds, backfill)")
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--scale", type=float, default=1.0,
                         help="multiply pod/node counts (default 1.0)")
@@ -225,14 +385,18 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if args.chaos:
         return _smoke(CHAOS_SCENARIOS, label="chaos-smoke")
+    if args.quality:
+        return _quality()
     if args.smoke:
         return _smoke()
 
     names = args.scenarios or (
-        # --all = every fast scenario, chaos subset included (the smoke
-        # GATES keep the two sets disjoint; a human asking for "all"
-        # wants all)
-        [*SMOKE_SCENARIOS, *CHAOS_SCENARIOS] if args.all else []
+        # --all = every fast scenario, chaos + quality subsets included
+        # (the smoke GATES keep the sets disjoint; a human asking for
+        # "all" wants all)
+        [*SMOKE_SCENARIOS, *CHAOS_SCENARIOS, *QUALITY_SCENARIOS]
+        if args.all
+        else []
     )
     if not names:
         parser.error("name at least one scenario, or use --all / --smoke / --list")

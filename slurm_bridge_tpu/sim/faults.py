@@ -46,7 +46,12 @@ class SimRpcError(grpc.RpcError):
 #: fault kinds delivered via the client wrapper
 RPC_KINDS = ("rpc_error", "rpc_latency", "stale_snapshot", "lost_status")
 #: fault kinds applied by the harness at tick boundaries
-CLUSTER_KINDS = ("drain_nodes", "partition_vanish", "preemption_storm")
+CLUSTER_KINDS = (
+    "drain_nodes",
+    "partition_vanish",
+    "preemption_storm",
+    "elastic_resize",
+)
 #: fault kinds that kill/replace the bridge process itself (PR-7): the
 #: harness tears the control plane down at the start tick and recovery
 #: rides snapshot+WAL + level-triggered re-convergence
@@ -73,7 +78,15 @@ class Fault:
       drawn deterministically from the plan seed; resumed at ``end_tick``
     - ``partition_vanish``: ``partition`` hidden for the window
     - ``preemption_storm``: ``jobs`` arrivals at ``priority`` injected at
-      ``start_tick`` (requires the scheduler's preemption mode to displace)
+      ``start_tick`` (requires the scheduler's preemption mode to
+      displace); ``gang_size`` > 1 makes each storm job a gang and
+      ``storm_class`` stamps a priority-class label (the
+      ``priority_inversion`` shape)
+    - ``elastic_resize``: at ``start_tick``, ``jobs`` currently-bound
+      sim jobs change shard count mid-flight (VirtualFlow semantics,
+      arxiv 2009.09523): singles grow to 2 nodes, gangs halve; the job
+      is cancelled, its demand rewritten, and it re-places at the new
+      shape under a fresh submit generation
     - ``crash_restart``: at ``start_tick`` the whole bridge stack (store,
       operator, configurator, scheduler) dies WITHOUT a final flush and a
       fresh stack reloads from snapshot+WAL; ``end_tick`` should be
@@ -110,6 +123,13 @@ class Fault:
     jobs: int = 0
     priority: int = 1000
     graceful: bool = True
+    #: preemption_storm: shard count per storm job (1 = singles)
+    gang_size: int = 1
+    #: preemption_storm: priority-class label stamped on storm jobs
+    storm_class: str = ""
+    #: preemption_storm: cpus_per_task draw for storm jobs (() = the
+    #: PR-2 default (4, 8, 16)); node-sized asks force real preemption
+    storm_cpus: tuple[int, ...] = ()
 
     def active(self, tick: int) -> bool:
         return self.start_tick <= tick < self.end_tick
@@ -218,6 +238,14 @@ class FaultPlan:
                 d.update(partition=f.partition)
             elif f.kind == "preemption_storm":
                 d.update(jobs=f.jobs, priority=f.priority)
+                if f.gang_size > 1:
+                    d.update(gang_size=f.gang_size)
+                if f.storm_class:
+                    d.update(storm_class=f.storm_class)
+                if f.storm_cpus:
+                    d.update(storm_cpus=list(f.storm_cpus))
+            elif f.kind == "elastic_resize":
+                d.update(jobs=f.jobs)
             elif f.kind == "leader_failover":
                 d.update(graceful=f.graceful)
             out.append(d)

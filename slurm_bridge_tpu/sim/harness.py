@@ -63,6 +63,9 @@ from slurm_bridge_tpu.obs.flight import FlightRecorder
 from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.obs.tracing import TRACER
 from slurm_bridge_tpu.agent.journal import AgentJournal
+from slurm_bridge_tpu.policy.classes import CLASS_LABEL, TENANT_LABEL
+from slurm_bridge_tpu.policy.engine import PlacementPolicy
+from slurm_bridge_tpu.policy.score import QualityTracker
 from slurm_bridge_tpu.sim.agent import SimCluster, SimWorkloadClient
 from slurm_bridge_tpu.sim.faults import AGENT_KINDS, FaultPlan, FaultyClient
 from slurm_bridge_tpu.wire.rpc import RetryingClient, RetryPolicy
@@ -139,6 +142,15 @@ class Scenario:
     #: id/placement-insensitive final_outcome_digest must be (used when
     #: composed RPC faults legitimately reshuffle job ids/placements)
     lossless_twin: str = ""
+    #: placement-policy config (policy.PolicyConfig) — priority classes,
+    #: fair share, bounded preemption, backfill. None = policy OFF, the
+    #: PR-8 tick byte-for-byte (the quality-smoke gate proves it)
+    policy: object | None = None
+    #: explicit AuctionConfig for backend="auction" scenarios (None =
+    #: scheduler defaults). diurnal_load pins an APPROXIMATE config
+    #: (repair off, few rounds) so the backfill pass has real
+    #: fragmentation holes to fill — the shape the quality gate measures
+    auction_config: object | None = None
 
 
 @dataclass
@@ -147,6 +159,11 @@ class ScenarioResult:
     determinism: dict
     timing: dict
     shape: dict
+    #: placement-quality scorecard (policy/score.py) — utilization,
+    #: fragmentation, wait percentiles, preemption churn, fairness;
+    #: computed for EVERY scenario (virtual-time deterministic) and
+    #: gated for the quality subset in `make quality-smoke`
+    quality: dict = field(default_factory=dict)
     #: run-level flight record (span tree p50s, top self-time, commit
     #: breakdown); {} when the scenario ran with tracing off
     flight_record: dict = field(default_factory=dict)
@@ -162,6 +179,7 @@ class ScenarioResult:
             "faults": self.scenario.faults.describe(),
             "determinism": self.determinism,
             "timing": self.timing,
+            "quality": self.quality,
             "flight_record": self.flight_record,
         }
 
@@ -223,12 +241,65 @@ class SimHarness:
         )
         for f in scenario.faults.faults:
             if f.kind == "preemption_storm" and f.start_tick < scenario.ticks:
+                # extra kwargs only on the NEW gang/class shape, so plain
+                # storms replay the PR-2 byte stream exactly (the
+                # defaults are draw-identical either way)
+                kw: dict = {}
+                if f.gang_size > 1 or f.storm_class or f.storm_cpus:
+                    eligible = [
+                        k
+                        for k, size in enumerate(sizes)
+                        if size >= max(1, f.gang_size)
+                    ]
+                    if f.gang_size > 1 and not eligible:
+                        # a storm gang no partition can host would pend
+                        # forever and surface as a misleading wait-bound
+                        # failure — refuse the config loudly instead
+                        raise ValueError(
+                            f"preemption_storm gang_size={f.gang_size} "
+                            "fits no partition at this scale "
+                            f"(sizes={sizes})"
+                        )
+                    kw = dict(
+                        gang_size=f.gang_size,
+                        storm_class=f.storm_class,
+                        eligible_parts=eligible,
+                    )
+                    if f.storm_cpus:
+                        kw["cpus"] = f.storm_cpus
                 self.trace[f.start_tick].extend(
                     storm_arrivals(
                         f.start_tick, f.jobs, scenario.cluster, rng,
-                        priority=f.priority,
+                        priority=f.priority, **kw,
                     )
                 )
+        # ---- placement-quality accounting (ISSUE 9) ----
+        tenant_of: dict[str, str] = {}
+        is_gang: dict[str, bool] = {}
+        class_of: dict[str, str] = {}
+        shard_cpus: list[float] = []
+        for arrivals in self.trace:
+            for a in arrivals:
+                tenant_of[a.name] = a.labels.get(TENANT_LABEL, "")
+                is_gang[a.name] = (a.spec.nodes or 1) > 1
+                class_of[a.name] = a.labels.get(CLASS_LABEL, "")
+                shard_cpus.append(
+                    max(1, a.spec.cpus_per_task)
+                    * max(1, a.spec.ntasks)
+                    / max(1, a.spec.nodes or 1)
+                )
+        self.quality = QualityTracker(
+            tenant_of=tenant_of,
+            is_gang=is_gang,
+            class_of=class_of,
+            tenant_weights=(
+                dict(scenario.policy.tenant_weights)
+                if scenario.policy is not None
+                else {}
+            ),
+            ref_cpu=float(np.median(shard_cpus)) if shard_cpus else 1.0,
+            tick_interval_s=scenario.tick_interval_s,
+        )
         base_client = SimWorkloadClient(self.cluster)
         #: the FaultyClient (tick advance + injection counters) — kept
         #: separate from ``self.client`` because a retry wrapper may
@@ -389,13 +460,22 @@ class SimHarness:
             pod_sync_workers=1,  # serial converge: deterministic order
             provider_inventory_ttl=0.0,  # no wall-clock cache window
         )
+        # fresh policy engine per stack incarnation: a crash loses the
+        # in-memory fair-share accumulator exactly as production would
+        self.policy_engine = (
+            PlacementPolicy(scenario.policy)
+            if scenario.policy is not None
+            else None
+        )
         self.scheduler = PlacementScheduler(
             self.store,
             self.client,
             backend=scenario.backend,
+            auction_config=scenario.auction_config,
             events=self.events,
             preemption=scenario.preemption,
             inventory_ttl=0.0,  # virtual time: always take a fresh snapshot
+            policy=self.policy_engine,
         )
         self._pod_watch = self.store.watch((Pod.KIND,))
         self._node_watch = self.store.watch((VirtualNode.KIND,))
@@ -540,6 +620,94 @@ class SimHarness:
             self.cluster.hide_partition(f.partition)
         for f in plan.ending("partition_vanish", tick):
             self.cluster.show_partition(f.partition)
+        for f in plan.starting("elastic_resize", tick):
+            self._apply_resizes(tick, f)
+
+    # ---- elastic resize (VirtualFlow, arxiv 2009.09523) ----
+
+    def _apply_resizes(self, tick: int, fault) -> None:
+        """Change ``fault.jobs`` bound jobs' shard counts mid-flight:
+        singles grow to 2 nodes, gangs halve (total demand is spread
+        across shards, so growing always stays feasible). Targets are
+        the first eligible pods in name order — deterministic."""
+        part_size = {
+            name: len(members)
+            for name, members in self.cluster.partitions.items()
+        }
+        pods = sorted(
+            (
+                p
+                for p in self.store.list(Pod.KIND)
+                if p.spec.role == PodRole.SIZECAR
+                and p.spec.node_name
+                and p.spec.demand is not None
+                and not p.meta.deleted
+                and p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+            ),
+            key=lambda p: p.name,
+        )
+        done = 0
+        for pod in pods:
+            if done >= fault.jobs:
+                break
+            nodes = max(1, pod.spec.demand.nodes)
+            new_nodes = nodes // 2 if nodes > 1 else 2
+            if new_nodes > part_size.get(pod.spec.partition, 0):
+                continue
+            if self._resize_pod(pod.name, new_nodes, tick):
+                done += 1
+
+    def _resize_pod(self, name: str, new_nodes: int, tick: int) -> bool:
+        """One mid-flight resize: cancel the running Slurm jobs, rewrite
+        the demand's shard count under a fresh submit generation, and
+        requeue — the scheduler re-places it at the new shape next tick.
+        Mirrors the scheduler's ``_preempt`` reset-before-cancel order so
+        the terminal CANCELLED state can never race the requeue."""
+        from slurm_bridge_tpu.bridge.store import NotFound
+        from slurm_bridge_tpu.wire import pb
+
+        job_ids: list[int] = []
+
+        def record(p):
+            job_ids.clear()
+            if not p.spec.node_name or p.meta.deleted:
+                return False
+            job_ids.extend(p.status.job_ids)
+            gen = int(p.meta.annotations.get("submit-generation", "0")) + 1
+            p.meta.annotations["submit-generation"] = str(gen)
+            p.spec.node_name = ""
+            p.spec.placement_hint = ()
+            p.spec.demand.nodes = new_nodes  # mutate() hands a thawed copy
+            p.status.job_ids = ()
+            p.status.job_infos = []
+            p.status.phase = PodPhase.PENDING
+            p.status.reason = "Resizing: shard count changed"
+
+        try:
+            self.store.mutate(Pod.KIND, name, record, site="sim.resize")
+        except NotFound:
+            return False
+        pod = self.store.try_get(Pod.KIND, name)
+        if pod is None or pod.spec.node_name:
+            return False
+        for jid in job_ids:
+            try:
+                self.client.CancelJob(pb.CancelJobRequest(job_id=jid))
+            except grpc.RpcError:
+                self._rpc_fail("sim.resize")
+        owner = pod.meta.owner or name
+
+        def stamp_job(j):
+            j.spec.nodes = new_nodes
+
+        try:
+            self.store.mutate(BridgeJob.KIND, owner, stamp_job, site="sim.resize")
+        except NotFound:
+            pass
+        self._note(tick, "resize", name, new_nodes)
+        self.quality.note_rearrival(owner, tick)
+        self.quality.note_resize()
+        return True
 
     def _arrive(self, tick: int) -> int:
         arrivals = self._arrival_backlog + (
@@ -552,13 +720,20 @@ class SimHarness:
             self._arrival_backlog = arrivals
             return 0
         for a in arrivals:
-            job = BridgeJob(meta=Meta(name=a.name), spec=a.spec)
+            job = BridgeJob(
+                meta=Meta(
+                    name=a.name,
+                    labels=dict(a.labels) if a.labels else {},
+                ),
+                spec=a.spec,
+            )
             # the trace's virtual duration rides the demand's time limit —
             # the sim agent runs each job for exactly that long
             try:
                 self.store.create(job, site="sim.arrive")
             except AlreadyExists:
                 continue
+            self.quality.note_arrival(a.name, tick)
             self.operator.reconcile(a.name)
             pod = self.store.try_get(Pod.KIND, f"{a.name}-sizecar")
             if pod is not None and pod.spec.demand is not None:
@@ -671,6 +846,7 @@ class SimHarness:
         phases["other"] = max(0.0, sched_ms - accounted)
 
         self.cluster.step()
+        self.quality.sample(self.cluster)
 
         pods = self.store.list(Pod.KIND)
         by_name = {p.name: p for p in pods}
@@ -697,6 +873,9 @@ class SimHarness:
                 u[2] += gpu
         self._bound_total += len(newly_bound)
         self._preempted_total += len(preempted)
+        for p in newly_bound:
+            self.quality.note_bound(p.meta.owner or p.name, tick)
+        self.quality.note_preempts(len(preempted))
         for p in sorted(newly_bound, key=lambda p: p.name):
             self._note(tick, "bind", p.name, p.spec.node_name,
                        ",".join(p.spec.placement_hint))
@@ -1069,11 +1248,22 @@ class SimHarness:
             "partitions": sc.cluster.num_partitions,
             "ticks": total_ticks,
         }
+        policy_extra = {"policy": "off"}
+        if self.policy_engine is not None:
+            policy_extra = {
+                "policy": "on",
+                "backfill_binds": self.policy_engine.backfill_binds_total,
+                "preempt_pool_last": self.policy_engine.pool_size_last,
+                "preempt_pool_excluded_last": (
+                    self.policy_engine.pool_excluded_last
+                ),
+            }
         result = ScenarioResult(
             scenario=sc,
             determinism=determinism,
             timing=timing,
             shape=shape,
+            quality=self.quality.scorecard(total_ticks, extra=policy_extra),
             flight_record=self.flight.aggregate(),
             flight_ticks=list(self.flight.records),
         )

@@ -13,9 +13,11 @@ pods × 10k nodes, reported as ``full_tick_p50_ms_50kx10k``.
 
 from __future__ import annotations
 
+from slurm_bridge_tpu.policy.engine import PolicyConfig
 from slurm_bridge_tpu.sim.faults import Fault, FaultPlan
 from slurm_bridge_tpu.sim.harness import Scenario
 from slurm_bridge_tpu.sim.trace import ClusterSpec, WorkloadSpec
+from slurm_bridge_tpu.solver.auction import AuctionConfig
 
 
 def _n(base: int, scale: float, floor: int = 8) -> int:
@@ -402,6 +404,158 @@ def chaos_crash_into_vanished_partition(
     )
 
 
+def diurnal_load(scale: float = 1.0, seed: int = 54) -> Scenario:
+    """Day/night sinusoidal arrivals, gang-heavy, on a deliberately
+    APPROXIMATE auction (2 rounds, in-engine repair off): the main
+    solve leaves genuine fragmentation holes and stranded gangs at
+    every peak, and the policy's backfill pass fills them — the
+    quality gate compares utilization + gang wait against this exact
+    scenario with policy off (and with backfill alone off, isolating
+    the backfill contribution)."""
+    return Scenario(
+        name="diurnal_load",
+        description="sinusoidal load on an approximate auction; backfill "
+        "fills the admission holes, gated vs policy-off",
+        cluster=ClusterSpec(num_nodes=_n(120, scale), gpu_fraction=0.1),
+        workload=WorkloadSpec(
+            jobs=_n(1500, scale, floor=80),
+            arrival="diurnal",
+            spread_ticks=16,
+            diurnal_cycles=2,
+            gang_fraction=0.45,
+            duration_range=(40.0, 80.0),
+        ),
+        ticks=24,
+        expect_drain=False,
+        drain_grace_ticks=0,
+        backend="auction",
+        auction_config=AuctionConfig(
+            rounds=2, repair=False, gang_salvage_rounds=1
+        ),
+        policy=PolicyConfig(),
+        seed=seed,
+    )
+
+
+def multi_tenant_storm(scale: float = 1.0, seed: int = 55) -> Scenario:
+    """Four tenants with skewed priority ranges slam an oversubscribed
+    cluster at tick 0; jobs outlive the window, so whoever admits first
+    keeps the capacity. Policy-off priority-FIFO hands everything to
+    the loud tenants (Jain ≈ 0.5); weighted dominant-resource fair
+    share interleaves them (Jain ≥ 0.9) — the quality-smoke gate."""
+    return Scenario(
+        name="multi_tenant_storm",
+        description="4 skewed tenants, front-loaded oversubscription; "
+        "fair-share Jain gated vs priority-FIFO",
+        cluster=ClusterSpec(
+            num_nodes=_n(120, scale), gpu_fraction=0.0, base_load=0.0
+        ),
+        workload=WorkloadSpec(
+            jobs=_n(1200, scale, floor=80),
+            arrival="front",
+            gpu_fraction=0.0,
+            gang_fraction=0.0,
+            cpu_choices=(16, 32, 64),
+            duration_range=(500.0, 800.0),
+            tenants=4,
+            tenant_priorities=((80, 100), (55, 75), (30, 50), (0, 20)),
+        ),
+        ticks=10,
+        expect_drain=False,
+        drain_grace_ticks=0,
+        policy=PolicyConfig(),
+        seed=seed,
+    )
+
+
+def priority_inversion(scale: float = 1.0, seed: int = 56) -> Scenario:
+    """The inversion shape: batch incumbents carrying HIGH numeric
+    priorities fill the cluster, then node-sized production gangs with
+    a LOW numeric priority arrive. Numeric-priority preemption (policy
+    off) never displaces anyone — the gang starves behind lower-class
+    work. With the class table on, class trumps numeric priority: the
+    gang preempts preemptible batch incumbents and binds within its
+    wait bound (gated in quality-smoke)."""
+    return Scenario(
+        name="priority_inversion",
+        description="production gang at numeric priority 10 vs batch "
+        "incumbents at 60-100; class preemption bounds its wait",
+        cluster=ClusterSpec(
+            num_nodes=_n(120, scale), gpu_fraction=0.0, base_load=0.0
+        ),
+        workload=WorkloadSpec(
+            jobs=_n(500, scale, floor=40),
+            arrival="front",
+            gpu_fraction=0.0,
+            gang_fraction=0.0,
+            cpu_choices=(8, 16, 32),
+            duration_range=(500.0, 800.0),
+            priority_range=(60, 100),
+        ),
+        faults=FaultPlan(
+            (
+                Fault(
+                    kind="preemption_storm",
+                    start_tick=5,
+                    end_tick=6,
+                    jobs=_n(16, scale, floor=2),
+                    priority=10,
+                    gang_size=4,
+                    storm_class="production",
+                    storm_cpus=(96, 128),
+                ),
+            )
+        ),
+        ticks=14,
+        expect_drain=False,
+        drain_grace_ticks=0,
+        preemption=True,
+        policy=PolicyConfig(),
+        seed=seed,
+    )
+
+
+def elastic_resize(scale: float = 1.0, seed: int = 57) -> Scenario:
+    """Jobs change shard count mid-flight (VirtualFlow, arxiv
+    2009.09523): two resize windows cancel running work, rewrite the
+    demand's node count under a fresh submit generation, and the
+    scheduler re-places every resized job at its new shape — gang
+    atomicity, capacity, and eventual drain all still hold."""
+    return Scenario(
+        name="elastic_resize",
+        description="mid-flight shard-count changes at ticks 6 and 10; "
+        "everything re-places and drains",
+        cluster=ClusterSpec(num_nodes=_n(300, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(800, scale, floor=40),
+            arrival="poisson",
+            spread_ticks=8,
+            gang_fraction=0.15,
+            duration_range=(30.0, 80.0),
+        ),
+        faults=FaultPlan(
+            (
+                Fault(
+                    kind="elastic_resize",
+                    start_tick=6,
+                    end_tick=7,
+                    jobs=_n(60, scale, floor=8),
+                ),
+                Fault(
+                    kind="elastic_resize",
+                    start_tick=10,
+                    end_tick=11,
+                    jobs=_n(40, scale, floor=5),
+                ),
+            )
+        ),
+        ticks=18,
+        policy=PolicyConfig(),
+        seed=seed,
+        max_recovery_ticks=30,
+    )
+
+
 def full_50kx10k(scale: float = 1.0, seed: int = 42) -> Scenario:
     """The headline: 50k pods × 10k nodes through the FULL bridge
     pipeline. Slow (minutes); records ``full_tick_p50_ms_50kx10k`` with
@@ -472,6 +626,10 @@ SCENARIOS = {
         chaos_dual_crash,
         chaos_crash_rpc_flap,
         chaos_crash_into_vanished_partition,
+        diurnal_load,
+        multi_tenant_storm,
+        priority_inversion,
+        elastic_resize,
         full_50kx10k,
         full_50kx10k_crash,
     )
@@ -487,11 +645,24 @@ CHAOS_SCENARIOS = (
     "chaos_crash_into_vanished_partition",
 )
 
+#: the placement-quality subset `make quality-smoke` runs (ISSUE 9):
+#: double-run determinism PLUS policy-on/off arm comparisons gated on
+#: the scorecard (fairness, wait bounds, backfill utilization)
+QUALITY_SCENARIOS = (
+    "diurnal_load",
+    "multi_tenant_storm",
+    "priority_inversion",
+    "elastic_resize",
+)
+
 #: the fast set `make sim-smoke` double-runs: everything not slow-marked,
-#: MINUS the chaos subset — `make check` and CI run sim-smoke and
-#: chaos-smoke side by side, so overlap would execute each chaos
-#: scenario (and its crash-free twin) twice for zero added coverage
+#: MINUS the chaos and quality subsets — `make check` and CI run
+#: sim-smoke, chaos-smoke and quality-smoke side by side, so overlap
+#: would execute each scenario (and its twin arms) twice for zero added
+#: coverage
 SMOKE_SCENARIOS = tuple(
     n for n, f in SCENARIOS.items()
-    if not f().slow and n not in CHAOS_SCENARIOS
+    if not f().slow
+    and n not in CHAOS_SCENARIOS
+    and n not in QUALITY_SCENARIOS
 )

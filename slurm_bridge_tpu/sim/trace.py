@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from slurm_bridge_tpu.bridge.objects import BridgeJobSpec
+from slurm_bridge_tpu.policy.classes import CLASS_LABEL, TENANT_LABEL
 from slurm_bridge_tpu.sim.agent import SimNode
 
 GPU_FEATURE = "gpu_type0"
@@ -50,7 +51,20 @@ class WorkloadSpec:
     - ``"front"``  — every job arrives at tick 0 (cold-start backlog);
     - ``"poisson"``— Poisson(jobs/spread_ticks) arrivals per tick over the
       first ``spread_ticks`` ticks;
-    - ``"burst"``  — jobs split evenly across ``burst_ticks``.
+    - ``"burst"``  — jobs split evenly across ``burst_ticks``;
+    - ``"diurnal"``— Poisson with a sinusoidal day/night rate over the
+      first ``spread_ticks`` ticks (``diurnal_cycles`` peaks).
+
+    Tenancy/class fields are OFF by default and — deliberately — draw
+    NOTHING from the RNG when off, so every pre-existing scenario's
+    random stream (and therefore its determinism digest) is untouched:
+
+    - ``tenants`` > 0 labels each job ``tenant-<k>`` (uniform draw);
+    - ``tenant_priorities`` (len == tenants) maps each tenant's jobs
+      into its own priority range via a deterministic transform of the
+      already-drawn priority (no extra draws) — the skew the
+      multi-tenant fairness scenario runs on;
+    - ``priority_classes`` assigns a class label by weighted draw.
     """
 
     jobs: int
@@ -65,6 +79,14 @@ class WorkloadSpec:
     #: virtual-seconds runtime, uniform over [lo, hi)
     duration_range: tuple[float, float] = (5.0, 60.0)
     priority_range: tuple[int, int] = (0, 100)
+    #: sinusoid peaks across the spread window (arrival="diurnal")
+    diurnal_cycles: int = 2
+    #: tenants for fair-share scenarios; 0 = unlabeled (no RNG drawn)
+    tenants: int = 0
+    #: per-tenant (lo, hi) priority ranges (len == tenants)
+    tenant_priorities: tuple[tuple[int, int], ...] = ()
+    #: (class name, weight) distribution for priority-class labels
+    priority_classes: tuple[tuple[str, float], ...] = ()
 
 
 @dataclass
@@ -75,6 +97,8 @@ class JobArrival:
     name: str
     spec: BridgeJobSpec
     duration_s: float
+    #: CR metadata labels (tenant / priority-class); empty = unlabeled
+    labels: dict = field(default_factory=dict)
 
 
 def build_cluster(
@@ -131,6 +155,16 @@ def _arrival_ticks(
         counts = rng.poisson(rate, size=window)
         out = np.repeat(np.arange(window, dtype=np.int64), counts)
         return out[: spec.jobs]  # cap at the nominal total
+    if spec.arrival == "diurnal":
+        # sinusoidal day/night load: per-tick Poisson rate ∝ 1 + sin,
+        # normalized so the window's expected total is ``jobs``
+        window = max(1, min(spec.spread_ticks, ticks))
+        t = np.arange(window, dtype=np.float64)
+        wave = 1.0 + np.sin(2.0 * np.pi * spec.diurnal_cycles * t / window)
+        rates = spec.jobs * wave / max(wave.sum(), 1e-9)
+        counts = rng.poisson(rates)
+        out = np.repeat(np.arange(window, dtype=np.int64), counts)
+        return out[: spec.jobs]
     raise ValueError(f"unknown arrival process {spec.arrival!r}")
 
 
@@ -164,6 +198,17 @@ def generate_trace(
     part = rng.integers(0, cluster.num_partitions, size=n)
     prio = rng.integers(spec.priority_range[0], spec.priority_range[1] + 1, size=n)
     dur = rng.uniform(*spec.duration_range, size=n)
+    # tenancy/class draws happen ONLY when enabled — and strictly after
+    # every pre-existing draw — so scenarios without them replay the
+    # exact PR-8 random stream (digest byte-compat is gated on this)
+    tenant_idx = (
+        rng.integers(0, spec.tenants, size=n) if spec.tenants > 0 else None
+    )
+    cls_pick = rng.random(n) if spec.priority_classes else None
+    if spec.priority_classes:
+        cls_names = [c for c, _w in spec.priority_classes]
+        w = np.asarray([w for _c, w in spec.priority_classes], np.float64)
+        cls_cum = np.cumsum(w / max(w.sum(), 1e-9))
     # feasible target sets (see docstring): populated partitions for any
     # job — random node assignment can leave a partition EMPTY at small
     # node counts, and a job aimed there could never place — GPU-bearing
@@ -219,6 +264,22 @@ def generate_trace(
         count = int(ngpu[j])
         if gpu_j and partition_gpu_caps is not None:
             count = min(count, partition_gpu_caps[k])
+        prio_j = int(prio[j])
+        labels: dict[str, str] = {}
+        if tenant_idx is not None:
+            t = int(tenant_idx[j])
+            labels[TENANT_LABEL] = f"tenant-{t}"
+            if spec.tenant_priorities:
+                # per-tenant priority skew as a deterministic transform
+                # of the already-drawn priority (no extra RNG)
+                lo, hi = spec.tenant_priorities[t % len(spec.tenant_priorities)]
+                prio_j = int(lo) + prio_j % (int(hi) - int(lo) + 1)
+        if cls_pick is not None:
+            labels[CLASS_LABEL] = cls_names[
+                int(np.searchsorted(cls_cum, cls_pick[j], side="right").clip(
+                    0, len(cls_names) - 1
+                ))
+            ]
         spec_j = BridgeJobSpec(
             partition=f"part{k}",
             sbatch_script="#!/bin/sh\n: sim workload\n",
@@ -227,7 +288,7 @@ def generate_trace(
             nodes=spec.gang_size if gang_j else 1,
             mem_per_cpu_mb=int(mem[j]),
             gres=f"gpu:{GPU_FEATURE}:{count}" if gpu_j else "",
-            priority=int(prio[j]),
+            priority=prio_j,
         )
         out[tick].append(
             JobArrival(
@@ -235,6 +296,7 @@ def generate_trace(
                 name=f"{name_prefix}-{j:06d}",
                 spec=spec_j,
                 duration_s=float(np.round(dur[j], 3)),
+                labels=labels,
             )
         )
     return out
@@ -248,24 +310,43 @@ def storm_arrivals(
     *,
     priority: int = 1000,
     name_prefix: str = "storm",
+    gang_size: int = 1,
+    storm_class: str = "",
+    eligible_parts: list[int] | None = None,
+    cpus: tuple[int, ...] = (4, 8, 16),
 ) -> list[JobArrival]:
-    """High-priority burst for a ``preemption_storm`` fault window."""
-    cpu = rng.choice((4, 8, 16), size=count)
+    """High-priority burst for a ``preemption_storm`` fault window.
+
+    ``gang_size`` > 1 makes each storm job an all-or-nothing gang (the
+    ``priority_inversion`` scenario's production gang), restricted to
+    ``eligible_parts`` (partitions big enough to host it — the harness
+    computes these from the BUILT cluster); ``storm_class`` stamps a
+    priority-class label. Defaults reproduce the PR-2 storm exactly —
+    same draws, same specs."""
+    cpu = rng.choice(cpus, size=count)
     part = rng.integers(0, cluster.num_partitions, size=count)
     dur = rng.uniform(10.0, 30.0, size=count)
+    labels = {CLASS_LABEL: storm_class} if storm_class else {}
+    parts_of = list(eligible_parts) if eligible_parts else None
     return [
         JobArrival(
             tick=tick,
             name=f"{name_prefix}-{tick}-{j:05d}",
             spec=BridgeJobSpec(
-                partition=f"part{int(part[j])}",
+                partition=(
+                    f"part{parts_of[int(part[j]) % len(parts_of)]}"
+                    if parts_of
+                    else f"part{int(part[j])}"
+                ),
                 sbatch_script="#!/bin/sh\n: storm\n",
                 cpus_per_task=int(cpu[j]),
                 ntasks=1,
+                nodes=gang_size if gang_size > 1 else 0,
                 mem_per_cpu_mb=1024,
                 priority=priority,
             ),
             duration_s=float(np.round(dur[j], 3)),
+            labels=dict(labels),
         )
         for j in range(count)
     ]

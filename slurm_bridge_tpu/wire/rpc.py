@@ -100,9 +100,19 @@ class RetryPolicy:
     base_delay_s: float = 0.05
     max_delay_s: float = 1.0
     multiplier: float = 2.0
-    #: give up once the NEXT sleep would cross this much elapsed time
+    #: give up once the NEXT sleep would cross this much elapsed time —
+    #: the FALLBACK for methods without a ``method_budgets`` entry
     deadline_s: float = 8.0
     codes: tuple[str, ...] = ("UNAVAILABLE",)
+    #: per-RPC budgets: method → (retry deadline s, per-attempt timeout
+    #: s). One global deadline treats a 2 k-request SubmitJobs batch and
+    #: a Partitions ping identically — and worse, an attempt with no RPC
+    #: timeout can hang until the transport gives up, eating the WHOLE
+    #: retry budget in one try (the ROADMAP durability leftover). The
+    #: table sizes the deadline to the method's real cost and bounds
+    #: each attempt so a slow attempt leaves room to retry; a caller's
+    #: explicit ``timeout=`` always wins over the table's.
+    method_budgets: tuple[tuple[str, float, float], ...] = ()
 
     def backoff_s(self, attempt: int, rng) -> float:
         """Delay before retry ``attempt`` (1-based): exponential, capped,
@@ -113,12 +123,66 @@ class RetryPolicy:
         )
         return raw / 2.0 + rng.random() * raw / 2.0
 
+    def _budget(self, method: str) -> tuple[float, float] | None:
+        # memoized dict over the tuple: call_with_retries consults the
+        # budget on EVERY unary RPC (tens of thousands per sim run) —
+        # lazily built because the dataclass is frozen
+        table = self.__dict__.get("_budget_map")
+        if table is None:
+            table = {name: (d, t) for name, d, t in self.method_budgets}
+            object.__setattr__(self, "_budget_map", table)
+        return table.get(method)
+
+    def deadline_for(self, method: str) -> float:
+        """The retry deadline this method's budget allows."""
+        b = self._budget(method)
+        return b[0] if b is not None else self.deadline_s
+
+    def attempt_timeout_for(self, method: str, timeout):
+        """The per-attempt RPC timeout: the caller's explicit value
+        wins; otherwise the method's budgeted attempt timeout — but
+        ONLY when this policy retries DEADLINE_EXCEEDED. Injecting a
+        timeout under a policy that treats the resulting
+        DEADLINE_EXCEEDED as fatal would convert a slow-but-healthy
+        call that used to succeed into a zero-retry failure; callers on
+        the default UNAVAILABLE-only policy keep unbounded attempts
+        (None when the table has no entry either)."""
+        if timeout is not None:
+            return timeout
+        if "DEADLINE_EXCEEDED" not in self.codes:
+            return None
+        b = self._budget(method)
+        return b[1] if b is not None else None
+
 
 #: both transient shapes — for callers whose writes are ledger-deduped
 TRANSIENT_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED")
 
+#: the WorkloadManager surface's default budgets: heavyweight batched
+#: RPCs (a 512-chunk SubmitJobs fans out across the agent's submit pool;
+#: JobsInfo answers 45 k rows at the headline shape) get deadlines sized
+#: to their cost, cheap inventory/control pings get tight ones — and
+#: every entry bounds the per-attempt RPC so one hung call cannot eat
+#: the whole retry budget (the attempt bound engages only for callers
+#: that retry DEADLINE_EXCEEDED, i.e. ledger-deduped writers like the
+#: bridge — see ``attempt_timeout_for``). Values are deliberately
+#: generous (≥10× the measured sim-shape costs); the point is
+#: proportionality, not tuning.
+DEFAULT_METHOD_BUDGETS: tuple[tuple[str, float, float], ...] = (
+    # (method, retry deadline s, per-attempt timeout s)
+    ("SubmitJobs", 60.0, 30.0),
+    ("JobsInfo", 45.0, 20.0),
+    ("SubmitJob", 20.0, 10.0),
+    ("Nodes", 20.0, 10.0),
+    ("Partitions", 8.0, 5.0),
+    ("Partition", 8.0, 5.0),
+    ("JobInfo", 8.0, 5.0),
+    ("JobState", 8.0, 5.0),
+    ("CancelJob", 8.0, 5.0),
+)
+
 #: the default policy ServiceClient applies to every unary RPC
-DEFAULT_RETRY = RetryPolicy()
+DEFAULT_RETRY = RetryPolicy(method_budgets=DEFAULT_METHOD_BUDGETS)
 
 
 def _retries_counter():
@@ -170,6 +234,8 @@ def call_with_retries(
     """
     rng = rng if rng is not None else random
     start = clock()
+    deadline_s = policy.deadline_for(method)
+    timeout = policy.attempt_timeout_for(method, timeout)
     attempt = 1
     while True:
         try:
@@ -179,7 +245,7 @@ def call_with_retries(
             if code not in policy.codes or attempt >= policy.max_attempts:
                 raise
             delay = policy.backoff_s(attempt, rng)
-            if clock() - start + delay > policy.deadline_s:
+            if clock() - start + delay > deadline_s:
                 raise
             _retries_counter().inc(method=method)
             if on_retry is not None:

@@ -300,6 +300,131 @@ def test_retrying_client_wraps_and_counts():
     assert inner.closed
 
 
+# ------------------------------------- per-RPC timeout budgets (ISSUE 9)
+
+
+def test_method_budget_deadline_overrides_global():
+    """A method's own retry deadline binds instead of the global one:
+    with 1 s of clock burned per attempt, the 2 s budget stops a cheap
+    ping after 2 attempts while an unbudgeted method under the same
+    policy keeps retrying inside the 8 s fallback."""
+    policy = RetryPolicy(
+        max_attempts=10, base_delay_s=1.0, max_delay_s=1.0,
+        method_budgets=(("Ping", 2.0, 1.0),),
+    )
+
+    def run(method):
+        import random as _random
+
+        fn, calls = _flaky(100)
+        now = [0.0]
+
+        def sleep(s):
+            now[0] += s
+
+        with pytest.raises(grpc.RpcError):
+            call_with_retries(
+                fn, None, method=method, policy=policy,
+                sleep=sleep, clock=lambda: now[0],
+                # seeded jitter: the attempt count under a tight budget
+                # depends on the drawn backoffs — unseeded, this test
+                # would flake on lucky short draws
+                rng=_random.Random(0),
+            )
+        return calls["n"]
+
+    ping = run("Ping")
+    assert ping <= 4, "the 2 s budget admitted a near-unbounded retry run"
+    assert run("Unbudgeted") > ping
+
+
+def test_method_budget_bounds_each_attempt():
+    """The ROADMAP leftover: with no per-attempt timeout, one hung call
+    eats the whole retry budget. A budgeted method's attempts carry the
+    table's RPC timeout when the caller passed none — but ONLY for
+    policies that retry the resulting DEADLINE_EXCEEDED (injecting a
+    fatal timeout would turn a slow success into a zero-retry failure).
+    The caller's explicit timeout always wins."""
+    from slurm_bridge_tpu.wire.rpc import TRANSIENT_CODES
+
+    budgets = (("SubmitJobs", 60.0, 30.0),)
+    transient = RetryPolicy(codes=TRANSIENT_CODES, method_budgets=budgets)
+    plain = RetryPolicy(method_budgets=budgets)  # UNAVAILABLE-only
+    seen: list = []
+
+    def fn(request, timeout=None):
+        seen.append(timeout)
+        return "ok"
+
+    call_with_retries(fn, None, method="SubmitJobs", policy=transient,
+                      sleep=lambda s: None)
+    call_with_retries(fn, None, method="SubmitJobs", policy=transient,
+                      timeout=1.5, sleep=lambda s: None)
+    call_with_retries(fn, None, method="NoBudget", policy=transient,
+                      sleep=lambda s: None)
+    call_with_retries(fn, None, method="SubmitJobs", policy=plain,
+                      sleep=lambda s: None)
+    assert seen == [30.0, 1.5, None, None]
+
+
+def test_slow_method_does_not_eat_the_budget():
+    """Regression: each attempt of a slow-but-flaky budgeted method is
+    RPC-bounded, so the retry deadline still buys retries — the first
+    attempt cannot consume the whole budget the way an unbounded hang
+    did. Every attempt must observe the budgeted per-attempt timeout,
+    and the call must still succeed within its own deadline."""
+    from slurm_bridge_tpu.wire.rpc import TRANSIENT_CODES
+
+    policy = RetryPolicy(
+        max_attempts=4, base_delay_s=0.1, max_delay_s=0.1,
+        codes=TRANSIENT_CODES,
+        method_budgets=(("JobsInfo", 45.0, 20.0),),
+    )
+    now = [0.0]
+    timeouts: list = []
+    calls = {"n": 0}
+
+    def fn(request, timeout=None):
+        timeouts.append(timeout)
+        calls["n"] += 1
+        now[0] += 20.0  # the attempt burns its full RPC timeout
+        if calls["n"] <= 1:
+            raise SimRpcError(grpc.StatusCode.UNAVAILABLE, "slow flap")
+        return "ok"
+
+    out = call_with_retries(
+        fn, None, method="JobsInfo", policy=policy,
+        sleep=lambda s: now.__setitem__(0, now[0] + s),
+        clock=lambda: now[0],
+    )
+    assert out == "ok"
+    assert calls["n"] == 2, "the 20 s first attempt ate the 45 s budget"
+    assert timeouts == [20.0, 20.0]
+
+
+def test_default_retry_carries_the_method_table():
+    from slurm_bridge_tpu.wire.rpc import DEFAULT_METHOD_BUDGETS, DEFAULT_RETRY
+
+    from slurm_bridge_tpu.wire.rpc import TRANSIENT_CODES
+
+    assert DEFAULT_RETRY.method_budgets == DEFAULT_METHOD_BUDGETS
+    # proportionality: the batched heavyweights get more room than pings
+    assert DEFAULT_RETRY.deadline_for("SubmitJobs") > \
+        DEFAULT_RETRY.deadline_for("Partitions")
+    # the DEFAULT policy does not retry DEADLINE_EXCEEDED, so it must
+    # not inject attempt timeouts either (a slow success would become a
+    # zero-retry failure); ledger-deduped callers opt in via codes
+    assert DEFAULT_RETRY.attempt_timeout_for("JobsInfo", None) is None
+    bridge_policy = RetryPolicy(
+        codes=TRANSIENT_CODES, method_budgets=DEFAULT_METHOD_BUDGETS
+    )
+    assert bridge_policy.attempt_timeout_for("JobsInfo", None) == 20.0
+    # unknown methods keep the legacy fallback exactly
+    assert DEFAULT_RETRY.deadline_for("NotAMethod") == \
+        DEFAULT_RETRY.deadline_s
+    assert bridge_policy.attempt_timeout_for("NotAMethod", None) is None
+
+
 # -------------------------------------------------- FaultPlan validation
 
 

@@ -174,6 +174,113 @@ def test_failed_preempt_cancel_is_retried():
     assert PENDING_CANCEL_ANNOTATION not in pod.meta.annotations
 
 
+def test_preempt_cancel_retry_survives_agent_crash(tmp_path):
+    """The ISSUE 9 durability satellite: preempt-cancels that failed
+    while the agent was down must survive an AGENT CRASH in between —
+    after the journal replay rebuilds the agent, the pending-cancel set
+    drains, every Slurm job is cancelled exactly once (no double-cancel
+    on later ticks), and the annotation clears."""
+    import grpc
+
+    from slurm_bridge_tpu.agent.journal import AgentJournal
+    from slurm_bridge_tpu.bridge.objects import Meta, PodSpec, PodStatus
+    from slurm_bridge_tpu.bridge.scheduler import (
+        PENDING_CANCEL_ANNOTATION,
+        PlacementScheduler,
+    )
+    from slurm_bridge_tpu.bridge.store import ObjectStore
+    from slurm_bridge_tpu.core.types import JobStatus
+    from slurm_bridge_tpu.sim.agent import SimCluster, SimNode, SimWorkloadClient
+    from slurm_bridge_tpu.sim.faults import SimRpcError
+    from slurm_bridge_tpu.wire import pb
+
+    cluster = SimCluster(
+        [SimNode(name="n0", cpus=64, memory_mb=64_000)],
+        {"tiny": ("n0",)},
+        clock=lambda: 0.0,
+    )
+    cluster.attach_journal(
+        AgentJournal(str(tmp_path / "agent-journal.json"), fsync=False)
+    )
+
+    class FlakyCancel:
+        """CancelJob raises UNAVAILABLE while down; counts the calls
+        that actually LANDED per job id."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.down = True
+            self.landed: dict[int, int] = {}
+
+        def __getattr__(self, name):
+            fn = getattr(self.inner, name)
+            if name != "CancelJob":
+                return fn
+
+            def cancel(req, timeout=None):
+                if self.down:
+                    raise SimRpcError(
+                        grpc.StatusCode.UNAVAILABLE, "agent down"
+                    )
+                self.landed[req.job_id] = self.landed.get(req.job_id, 0) + 1
+                return fn(req, timeout=timeout)
+
+            return cancel
+
+    client = FlakyCancel(SimWorkloadClient(cluster))
+    ids = [
+        cluster.submit(
+            pb.SubmitJobRequest(
+                partition="tiny", job_name=f"v{i}", cpus_per_task=4,
+                ntasks=1, mem_per_cpu_mb=64, submitter_id=f"v{i}",
+            )
+        )
+        for i in range(2)
+    ]
+    store = ObjectStore()
+    sched = PlacementScheduler(store, client, backend="greedy")
+    store.create(
+        Pod(
+            meta=Meta(name="victim"),
+            spec=PodSpec(
+                partition="tiny",
+                node_name="slurm-partition-tiny",
+                placement_hint=("n0",),
+            ),
+            status=PodStatus(phase=PodPhase.RUNNING, job_ids=tuple(ids)),
+        )
+    )
+
+    assert sched._preempt(store.get(Pod.KIND, "victim"))
+    pod = store.get(Pod.KIND, "victim")
+    assert pod.meta.annotations[PENDING_CANCEL_ANNOTATION] == ",".join(
+        str(i) for i in sorted(ids)
+    )
+
+    # the agent process dies and rebuilds from its journal mid-backlog;
+    # the jobs survive the crash (still cancellable afterwards)
+    restored = cluster.crash_reload()
+    assert restored == len(ids)
+    assert all(not cluster.jobs[i].state.is_terminal for i in ids)
+
+    sched._retry_pending_cancels()  # still down: backlog intact
+    assert store.get(Pod.KIND, "victim").meta.annotations[
+        PENDING_CANCEL_ANNOTATION
+    ]
+
+    client.down = False
+    sched._retry_pending_cancels()  # recovered: backlog drains
+    pod = store.get(Pod.KIND, "victim")
+    assert PENDING_CANCEL_ANNOTATION not in pod.meta.annotations
+    assert all(cluster.jobs[i].state == JobStatus.CANCELLED for i in ids)
+    assert client.landed == {ids[0]: 1, ids[1]: 1}
+
+    # later ticks must NOT re-cancel (drained set, no double-cancel)
+    sched._retry_pending_cancels()
+    sched._retry_pending_cancels()
+    assert client.landed == {ids[0]: 1, ids[1]: 1}
+
+
 def test_no_preemption_among_equal_priority(bridge):
     bridge.submit(
         "first",
