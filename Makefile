@@ -4,9 +4,9 @@
 # workflows can never drift.
 
 .PHONY: help test fast check generate apidoc hygiene bench bench-smoke \
-        sim-smoke chaos-smoke quality-smoke sim sim-bench sim-bench-crash \
-        wal-fsync-bench scenarios docker-build install uninstall deploy \
-        undeploy run demo
+        sim-smoke chaos-smoke quality-smoke shard-smoke sim sim-bench \
+        sim-bench-crash sim-bench-500k wal-fsync-bench scenarios \
+        docker-build install uninstall deploy undeploy run demo
 
 help: ## Display this help.
 	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ \
@@ -18,7 +18,7 @@ test: ## Full suite + graft compile contracts + hygiene (ref: make test).
 fast: ## ~2-min signal: everything not marked slow.
 	python -m pytest tests/ -q -m "not slow"
 
-check: test bench-smoke sim-smoke chaos-smoke quality-smoke ## Alias the reference's CI verb (+ encode, sim, chaos & quality gates).
+check: test bench-smoke sim-smoke chaos-smoke quality-smoke shard-smoke ## Alias the reference's CI verb (+ encode, sim, chaos, quality & shard gates).
 
 generate: ## Regenerate protobuf bindings + API docs (ref: make generate).
 	hack/regen-proto.sh
@@ -45,6 +45,9 @@ chaos-smoke: ## Composed-fault scenarios only, double-run + crash-free twin dige
 quality-smoke: ## Placement-quality scenarios: policy-on/off arms + scorecard floors.
 	python -m slurm_bridge_tpu.sim --quality
 
+shard-smoke: ## Sharded-placement scenarios: double-run determinism + reconcile gates.
+	python -m slurm_bridge_tpu.sim --shard
+
 sim: ## Run every fast sim scenario full-size (see --list for names).
 	python -m slurm_bridge_tpu.sim --all
 
@@ -53,6 +56,9 @@ sim-bench: ## The slow 50k×10k full-bridge tick headline (minutes).
 
 sim-bench-crash: ## Crash recovery at the 50k×10k headline shape (minutes).
 	python -m slurm_bridge_tpu.sim full_50kx10k_crash
+
+sim-bench-500k: ## The 10×-scale sharded headline: 500k×100k (slow, ~10 min).
+	python -m slurm_bridge_tpu.sim full_500kx100k
 
 wal-fsync-bench: ## WAL overhead at 0/1/5 ms simulated fsync latency (record, not gate).
 	python -m benchmarks.ticksmoke --wal-fsync

@@ -1,0 +1,21 @@
+"""Sim scenario: the 10×-scale sharded headline (slow — tens of minutes).
+
+500k pods × 100k nodes through the FULL bridge pipeline with the
+partition/island shard fan-out on; records
+``full_tick_p50_ms_500kx100k`` with the phase breakdown and enforces
+the scenario's p50 gate.
+
+    python -m benchmarks.scenarios.sim_full_500kx100k [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.full_500kx100k``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import full_500kx100k as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "full_500kx100k"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

@@ -1,0 +1,22 @@
+"""Sim scenario: cross-shard gang reconciliation (ISSUE 10).
+
+Gangs of 8 on partitions deliberately split into shards too small to
+host them: every gang fails its home shard and places only through the
+merged-residual reconcile pass, all-or-nothing (`make shard-smoke`
+gates ``reconcile_placed ≥ 1``).
+
+    python -m benchmarks.scenarios.sim_sharded_gang_split [--scale F] [--seed N]
+
+Canonical definition:
+``slurm_bridge_tpu.sim.scenarios.sharded_gang_split``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import sharded_gang_split as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "sharded_gang_split"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

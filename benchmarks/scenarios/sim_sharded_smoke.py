@@ -1,0 +1,21 @@
+"""Sim scenario: the fast sharded-tick gate (ISSUE 10).
+
+Gang-heavy mixed workload on 3 partitions, each split across several
+shards; per-shard encode+solve fan-out with id-keyed merge. Double-run
+deterministic with zero invariant violations (gated in
+`make shard-smoke` and `make sim-smoke`).
+
+    python -m benchmarks.scenarios.sim_sharded_smoke [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.sharded_smoke``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import sharded_smoke as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "sharded_smoke"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

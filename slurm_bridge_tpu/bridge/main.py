@@ -45,6 +45,16 @@ def main(argv: list[str] | None = None) -> int:
                              "classes, per-tenant fair share, bounded "
                              "preemption pool, backfill "
                              "(docs/scheduling-policy.md)")
+    parser.add_argument("--shard", action="store_true",
+                        help="enable sharded placement: partition/island "
+                             "fan-out with per-shard encode+solve and "
+                             "cross-shard gang reconciliation "
+                             "(docs/sharding.md)")
+    parser.add_argument("--shard-max-nodes", type=int, default=4096,
+                        help="split partitions bigger than this across "
+                             "shards (with --shard)")
+    parser.add_argument("--shard-workers", type=int, default=2,
+                        help="per-shard solve fan-out width (with --shard)")
     parser.add_argument("--policy-max-preemptions", type=int, default=64,
                         help="churn bound: incumbents displaceable per "
                              "scheduler tick (with --policy)")
@@ -114,12 +124,21 @@ def main(argv: list[str] | None = None) -> int:
         policy = PlacementPolicy(
             PolicyConfig(max_preemptions_per_tick=args.policy_max_preemptions)
         )
+    shard = None
+    if args.shard:
+        from slurm_bridge_tpu.shard import ShardConfig
+
+        shard = ShardConfig(
+            max_nodes_per_shard=args.shard_max_nodes,
+            workers=args.shard_workers,
+        )
     bridge = Bridge(
         args.endpoint,
         scheduler_backend=args.scheduler,
         solver_endpoint=args.scheduler_endpoint,
         preemption=args.preemption,
         policy=policy,
+        shard=shard,
         state_file=args.state_file,
         configurator_interval=args.configurator_interval,
         operator_workers=args.threads,

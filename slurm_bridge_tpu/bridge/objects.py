@@ -336,3 +336,36 @@ class FetchJob:
     @property
     def name(self) -> str:
         return self.meta.name
+
+
+# -------------------------------------------------------------- PolicyState
+
+
+@dataclass
+class PolicyState:
+    """Durable scheduler-policy state — the fair-share service ledger.
+
+    The policy engine's per-tenant accumulated dominant-share usage is
+    the only scheduler state that is neither derivable from the cluster
+    nor carried by a pod: losing it on restart resets every tenant's
+    service to zero, so whichever tenant floods the queue first after a
+    crash monopolizes the cluster until balance re-accumulates. One
+    singleton object (``FAIRSHARE_NAME``) holds the ledger in the store,
+    where the ordinary WAL persistence picks it up like any other kind
+    (ROADMAP policy follow-up; regression: the crash_restart twin keeps
+    Jain within tolerance — tests/test_policy.py).
+    """
+
+    meta: Meta
+    #: tenant → accumulated dominant-share service (policy/fairshare.py)
+    usage: dict[str, float] = field(default_factory=dict)
+    #: bumped on every save — observability, not concurrency (the store
+    #: rv is the concurrency token)
+    generation: int = 0
+
+    KIND = "PolicyState"
+    FAIRSHARE_NAME = "fair-share"
+
+    @property
+    def name(self) -> str:
+        return self.meta.name

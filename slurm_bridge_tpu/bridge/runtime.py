@@ -65,6 +65,7 @@ class Bridge:
         kubelet_tls_key: str = "",
         state_file: str = "",
         policy=None,
+        shard=None,
     ):
         self.agent_endpoint = agent_endpoint
         self.store = ObjectStore()
@@ -120,6 +121,7 @@ class Bridge:
             solver_endpoint=solver_endpoint,
             sharded=sharded,
             policy=policy,
+            shard=shard,
         )
         self._sched_ticker = Ticker(
             scheduler_interval, self.scheduler.tick, name="scheduler"
@@ -164,6 +166,8 @@ class Bridge:
         if self.kubelet_server is not None:
             self.kubelet_server.stop()
         self._sched_ticker.stop()
+        if self.scheduler.shard is not None:
+            self.scheduler.shard.close()  # shard solve pool teardown
         self.configurator.stop()
         self.operator.stop()
         self.fetch_worker.stop()

@@ -142,6 +142,7 @@ class PlacementScheduler:
         place_timeout: float = 120.0,
         inventory_ttl: float = 1.0,
         policy=None,
+        shard=None,
     ):
         if backend not in ("auto", "auction", "greedy"):
             raise ValueError(f"unknown scheduler backend {backend!r}")
@@ -166,13 +167,46 @@ class PlacementScheduler:
         #: backfill. None (the default) is the PR-8 tick byte-for-byte.
         self.policy = policy
         if policy is not None and solver_endpoint:
-            log.warning(
+            # effective priorities ride the Place RPC since PR-10
+            # (PlaceJob.priority_override), so admission order, the
+            # preemption pool AND class dominance all survive the hop;
+            # only the backfill second pass stays in-process-only
+            log.info(
                 "placement policy attached with a remote solver sidecar: "
-                "admission order and the preemption pool apply, but "
-                "effective priorities cannot ride the Place RPC — class "
-                "dominance inside the remote solve is not enforced"
+                "effective priorities ride the Place RPC; the backfill "
+                "pass does not apply on remote solves"
             )
+        if policy is not None:
+            # durable fair share (PR-10): a store restored from
+            # snapshot+WAL carries the PolicyState singleton — hydrate
+            # the ledger so accumulated service survives the restart
+            policy.load_from_store(store)
         self.bucket = bucket
+        #: the sharded-placement layer (slurm_bridge_tpu.shard): plan the
+        #: tick into partition/island shards, encode+solve each against
+        #: per-shard caches, reconcile cross-shard gangs. None (the
+        #: default) is the monolithic tick byte-for-byte — fixture-pinned
+        #: like ``policy=None``.
+        self.shard = None
+        if shard is not None:
+            from slurm_bridge_tpu.shard import ShardExecutor
+
+            self.shard = (
+                shard
+                if isinstance(shard, ShardExecutor)
+                else ShardExecutor(
+                    shard,
+                    backend=backend,
+                    auction_config=auction_config,
+                    bucket=bucket,
+                )
+            )
+            if solver_endpoint:
+                log.warning(
+                    "sharded placement attached with a remote solver "
+                    "sidecar: the sidecar owns encode+solve, so the "
+                    "in-process shard fan-out is IGNORED on solver ticks"
+                )
         #: sharded auto-select (VERDICT r2 #4): with ``sharded=None`` the
         #: multi-device shard_map sweep engages when a mesh exists AND the
         #: solve is big enough to amortize the collectives — tiny solves
@@ -445,7 +479,8 @@ class PlacementScheduler:
             # the sidecar owns encode+solve; report the RPC as the solve
             with TRACER.span("scheduler.solve", engine="remote") as solve_span:
                 solved = self._solve_remote(
-                    partitions, nodes, demands, all_pods, n_pending
+                    partitions, nodes, demands, all_pods, n_pending,
+                    priorities=priorities,
                 )
             remote_solve_s = solve_span.duration
             self.last_phase_ms["solve"] = remote_solve_s * 1e3
@@ -457,6 +492,11 @@ class PlacementScheduler:
                 # diagnosis; the level-triggered loop retries next tick
                 return 0
             by_job_names, lost_jobs = solved
+        elif self.shard is not None:
+            by_job_names, lost_jobs = self._solve_sharded(
+                partitions, nodes, demands, all_pods, n_pending,
+                priorities=priorities,
+            )
         else:
             by_job_names, lost_jobs = self._solve_local(
                 partitions, nodes, demands, all_pods, n_pending,
@@ -496,6 +536,9 @@ class PlacementScheduler:
                 # ready virtual node grants no service, and charging it
                 # would starve that tenant once the node comes up
                 self.policy.note_admitted(admitted_idx)
+                # ...and the ledger rides the WAL (PR-10): a no-charge
+                # tick writes nothing
+                self.policy.save_to_store(self.store)
             self._mark_unschedulable_batch(unschedulable)
             placed = self._bind_batch(binds)
             preempted = 0
@@ -621,8 +664,48 @@ class PlacementScheduler:
         ]
         return by_job_names, lost_jobs
 
+    def _solve_sharded(
+        self, partitions, nodes, demands, all_pods, n_pending,
+        priorities=None,
+    ) -> tuple[dict[int, list[str]], list[int]]:
+        """The sharded tick: plan → route → per-shard encode+solve →
+        merge → cross-shard gang reconciliation (slurm_bridge_tpu.shard).
+
+        Per-shard encode runs inside the executor (per-shard
+        ``EncodedInventory``/``JobRowCache``), so the phase clock books
+        the executor's measured encode slice under ``encode`` and the
+        remainder — solves, merge, reconcile — under ``solve``; the
+        per-shard spans carry the fine breakdown for the flight record.
+        Policy effective priorities were computed GLOBALLY by
+        ``policy.prepare`` before this call and are applied per shard by
+        index slice — class dominance and the fair order survive the
+        fan-out unchanged.
+        """
+        self._prune_demand_keys(all_pods)
+        with TRACER.span("scheduler.solve", engine="sharded") as solve_span:
+            by_job_names, lost_jobs = self.shard.solve(
+                partitions, nodes, demands, all_pods, n_pending,
+                priorities=priorities,
+                demand_key=self._demand_key,
+                policy=self.policy,
+            )
+            solve_span.count("shards_used", self.shard.last_shards_used)
+            solve_span.count(
+                "reconciled", self.shard.last_reconcile_placed
+            )
+        solve_s = solve_span.duration
+        enc_ms = self.shard.last_encode_ms
+        self.last_phase_ms["encode"] = enc_ms
+        self.last_phase_ms["solve"] = max(0.0, solve_s * 1e3 - enc_ms)
+        _encode_seconds.observe(enc_ms / 1e3)
+        _solve_seconds.observe(max(0.0, solve_s - enc_ms / 1e3))
+        self.last_route = "sharded"
+        _route_total.inc(engine="sharded")
+        return by_job_names, lost_jobs
+
     def _solve_remote(
-        self, partitions, nodes, demands, all_pods, n_pending
+        self, partitions, nodes, demands, all_pods, n_pending,
+        priorities=None,
     ) -> tuple[dict[int, list[str]], list[int]] | None:
         """Out-of-process solve via the PlacementSolver sidecar.
 
@@ -632,6 +715,12 @@ class PlacementScheduler:
         all-or-nothing, so a preempted incumbent simply has no node_names in
         the response — unless every hinted node vanished from the inventory,
         which the local path treats as "drop the shards, keep the pod".
+
+        ``priorities`` (policy ticks) ride each PlaceJob as
+        ``priority_override`` (PR-10): the sidecar admits by the
+        bridge's globally-computed effective priorities, so class
+        dominance and the fair-share order are enforced inside the
+        remote solve exactly like the in-process one.
         """
         from slurm_bridge_tpu.wire.convert import (
             auction_config_to_proto,
@@ -645,6 +734,9 @@ class PlacementScheduler:
             job = demand_to_place(d, job_id=str(j))
             if j >= n_pending:
                 job.incumbent_node_names.extend(all_pods[j].hint)
+            if priorities is not None:
+                job.priority_override = float(priorities[j])
+                job.has_priority_override = True
             jobs.append(job)
         try:
             resp = self._remote.Place(

@@ -119,6 +119,9 @@ class PlacementPolicy:
         self.pool_excluded_last = 0
         self.backfill_candidates_last = 0
         self.backfill_binds_last = 0
+        #: fair-share usage changed since the last store save (PR-10:
+        #: the ledger rides the WAL through a PolicyState singleton)
+        self._usage_dirty = False
 
     # ---- tick lifecycle ----
 
@@ -239,6 +242,55 @@ class PlacementPolicy:
             if 0 <= j < len(self._tick_jobs):
                 tenant, share, _rank = self._tick_jobs[j]
                 self.fair.charge(tenant, share)
+                self._usage_dirty = True
+
+    # ---- durable fair share (PR-10, ROADMAP policy follow-up) ----
+
+    def load_from_store(self, store) -> None:
+        """Hydrate the fair-share ledger from the PolicyState singleton
+        (restored by WAL replay on a restarted bridge). Missing object =
+        fresh start — exactly the pre-PR-10 behavior."""
+        from slurm_bridge_tpu.bridge.objects import PolicyState
+
+        obj = store.try_get(PolicyState.KIND, PolicyState.FAIRSHARE_NAME)
+        if obj is not None:
+            self.fair.usage = {k: float(v) for k, v in obj.usage.items()}
+
+    def save_to_store(self, store) -> None:
+        """Persist the ledger when (and only when) an admission charged
+        it this tick — a no-admission tick writes NOTHING, keeping the
+        steady-state zero-writes discipline intact. The write is an
+        ordinary store commit, so WAL persistence picks it up through
+        the same ``changes_since`` path as every other kind."""
+        if not self._usage_dirty:
+            return
+        from slurm_bridge_tpu.bridge.objects import Meta, PolicyState
+        from slurm_bridge_tpu.bridge.store import AlreadyExists, NotFound
+
+        usage = dict(self.fair.usage)
+
+        def record(obj):
+            obj.usage = dict(usage)
+            obj.generation += 1
+
+        try:
+            store.mutate(
+                PolicyState.KIND, PolicyState.FAIRSHARE_NAME, record,
+                site="policy.fairshare",
+            )
+        except NotFound:
+            try:
+                store.create(
+                    PolicyState(
+                        meta=Meta(name=PolicyState.FAIRSHARE_NAME),
+                        usage=usage,
+                        generation=1,
+                    ),
+                    site="policy.fairshare",
+                )
+            except AlreadyExists:  # racing writer: its value is newer
+                pass
+        self._usage_dirty = False
 
     def class_rank_of_job(self, j: int) -> int:
         """Class rank of reordered pending job ``j`` (default rank when
@@ -250,9 +302,14 @@ class PlacementPolicy:
     # ---- backfill ----
 
     def backfill(
-        self, snapshot, batch, placement, n_pending: int
+        self, snapshot, batch, placement, n_pending: int, *, rank_of=None
     ) -> list[tuple[int, int]]:
         """Second-pass hole filling after the main solve.
+
+        ``rank_of`` (optional) maps a batch job index to its class rank;
+        the default reads the engine's own reordered-pending table. The
+        sharded executor passes a shard-local → global translation here
+        — per-shard batches index their own job lists, not the tick's.
 
         Everything the solve left unplaced gets one exact, bounded
         second chance against ``placement.free_after``: smallest total
@@ -268,6 +325,8 @@ class PlacementPolicy:
         Returns ``(shard_row, node_index)`` assignments.
         """
         cfg = self.config
+        if rank_of is None:
+            rank_of = self.class_rank_of_job
         self.backfill_candidates_last = 0
         self.backfill_binds_last = 0
         unplaced = ~placement.placed & (batch.job_of >= 0) & (
@@ -303,7 +362,7 @@ class PlacementPolicy:
                 {
                     "rows": g_rows,
                     "need": len(g_rows),
-                    "rank": self.class_rank_of_job(int(batch.job_of[r0])),
+                    "rank": rank_of(int(batch.job_of[r0])),
                     "d": batch.demand[r0],
                     "part": part,
                     "req": int(batch.req_features[r0]),

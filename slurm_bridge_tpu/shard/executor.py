@@ -1,0 +1,622 @@
+"""Per-shard encode+solve fan-out + merge — the sharded tick engine.
+
+One :class:`ShardExecutor` replaces the scheduler's monolithic
+``_solve_local`` when sharding is on (``PlacementScheduler(shard=...)``):
+
+1. **plan** — the partition/island shard layout (planner.py), cached
+   while the partition layout is unchanged;
+2. **route** — every pending job and incumbent to one shard (gangs
+   whole, rank-aware locality score);
+3. **encode** — per shard, against per-shard :class:`EncodedInventory` /
+   :class:`JobRowCache` instances that carry across ticks exactly like
+   the monolithic caches (identity window, column-diff delta, row
+   reuse). The feature-code table is SHARED across shards — one bit
+   space, assigned in serial shard order — so feature masks stay
+   comparable when the reconcile pass mixes rows from different shards;
+4. **solve** — per shard, fanned across a lazily-built worker pool (the
+   same reuse-across-ticks / ``with_current_span`` discipline as the
+   provider pod-sync pool). The per-shard router mirrors the monolithic
+   one (greedy pin-through, indexed native below the dispatch floor)
+   and PROMOTES big shards to the multi-device shard_map sweep
+   (``solver/sharded.py`` — the MULTICHIP_r05 dp4×mp2 parity dryrun,
+   now on the routed path) with a CPU fallback to the native packer if
+   the device solve is unavailable or raises;
+5. **merge + reconcile** — per-shard placements map back to global job
+   indices; per-shard residuals scatter onto the global node axis and
+   gangs no shard could place get the cross-shard all-or-nothing pass
+   (reconcile.py).
+
+Determinism: routing, encode order, merge order and reconciliation are
+all keyed on shard/job ids — the worker pool only changes WHEN a shard
+solves, never what it returns, so any ``workers`` width produces the
+same tick byte-for-byte (shard-smoke double-runs it).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import numpy as np
+
+from slurm_bridge_tpu.obs.metrics import REGISTRY, Histogram
+from slurm_bridge_tpu.obs.tracing import TRACER, with_current_span
+from slurm_bridge_tpu.shard.planner import (
+    ShardConfig,
+    ShardPlan,
+    build_plan,
+    plan_token,
+    route_jobs,
+    sub_partitions,
+)
+from slurm_bridge_tpu.shard.reconcile import reconcile_gangs
+from slurm_bridge_tpu.solver import greedy_place
+from slurm_bridge_tpu.solver.encoder import EncodedInventory, JobRowCache
+from slurm_bridge_tpu.solver.snapshot import PAD_PARTITION, Placement, pad_batch
+
+log = logging.getLogger("sbt.shard")
+
+_shard_solve_seconds = REGISTRY.histogram(
+    "sbt_shard_solve_seconds",
+    "per-shard encode+solve wall time",
+    buckets=Histogram.FAST_BUCKETS,
+)
+_shard_ticks = REGISTRY.counter(
+    "sbt_shard_ticks_total", "sharded solve ticks executed"
+)
+_shard_count = REGISTRY.gauge(
+    "sbt_shard_count", "shards in the current plan"
+)
+_shard_route = REGISTRY.counter(
+    "sbt_shard_route_total", "per-shard solves by engine chosen"
+)
+_shard_jobs = REGISTRY.counter(
+    "sbt_shard_jobs_routed_total", "jobs routed into shards"
+)
+_shard_reconcile = REGISTRY.counter(
+    "sbt_shard_reconcile_gangs_total",
+    "cross-shard reconcile outcomes, labeled placed|unplaced",
+)
+
+
+class _ShardState:
+    """Cross-tick caches for one shard (mirrors the monolithic pair)."""
+
+    __slots__ = ("inv", "rows", "solver")
+
+    def __init__(self, feature_codes: dict):
+        self.inv = EncodedInventory()
+        # ONE feature-bit space across every shard: _rebuild grows this
+        # dict in place and never replaces it, so sharing the object is
+        # enough to keep masks comparable cross-shard
+        self.inv.feature_codes = feature_codes
+        self.rows = JobRowCache()
+        self.solver = None  # DeviceSolver, built on first device route
+
+
+class ShardExecutor:
+    def __init__(
+        self,
+        config: ShardConfig | None = None,
+        *,
+        backend: str = "auto",
+        auction_config=None,
+        bucket: int = 1024,
+    ):
+        from slurm_bridge_tpu.solver import AuctionConfig
+
+        self.config = config or ShardConfig()
+        self.backend = backend
+        self.auction_config = auction_config or AuctionConfig()
+        self.bucket = bucket
+        self._plan: ShardPlan | None = None
+        self._plan_key: tuple | None = None
+        self._states: dict[int, _ShardState] = {}
+        self._feature_codes: dict[str, int] = {}
+        #: per-tick sub-list cache: same global (nodes, partitions) lists
+        #: (the scheduler's inventory_ttl window) reuse the same sub-list
+        #: objects, so per-shard EncodedInventory identity hits fire.
+        #: Holds the list objects themselves (identity-compared) — a bare
+        #: id() key could false-hit when a freed list's address is
+        #: recycled and silently serve last tick's inventory
+        self._sub_cache: tuple[object, object, dict] | None = None
+        self._pool = None
+        self._pool_lock = threading.Lock()
+        #: features-tuple → folded uint32 mask, invalidated when the
+        #: shared code table grows (reconcile's idle-shard fold)
+        self._feat_memo: dict[tuple, int] = {}
+        self._feat_memo_token = -1
+        #: device solves serialize — one accelerator, many shards
+        self._device_lock = threading.Lock()
+        # ---- per-tick observability (the scheduler/harness read these)
+        self.last_encode_ms = 0.0
+        self.last_shards_used = 0
+        self.last_reconcile_attempts = 0
+        self.last_reconcile_placed = 0
+        self.last_routes: dict[str, int] = {}
+        # ---- run aggregates (determinism/quality sections) ----
+        self.ticks_total = 0
+        self.reconcile_attempts_total = 0
+        self.reconcile_placed_total = 0
+        self.locality_sum = 0.0
+        self.locality_count = 0
+
+    # ---- plan + sub-inventory caching ----
+
+    def _ensure_plan(self, partitions, nodes) -> ShardPlan:
+        key = plan_token(partitions, nodes, self.config)
+        if self._plan is None or key != self._plan_key:
+            self._plan = build_plan(partitions, nodes, self.config)
+            self._plan_key = key
+            # a re-plan re-keys every shard's node set: drop shard states
+            # whose ids fall away; survivors keep their caches (their
+            # EncodedInventory rebuilds itself on the first refresh)
+            self._states = {
+                sid: st
+                for sid, st in self._states.items()
+                if sid < self._plan.num_shards
+            }
+            self._sub_cache = None
+            _shard_count.set(self._plan.num_shards)
+        return self._plan
+
+    def _sub_lists(self, plan, partitions, nodes, sid):
+        if (
+            self._sub_cache is None
+            or self._sub_cache[0] is not nodes
+            or self._sub_cache[1] is not partitions
+        ):
+            self._sub_cache = (nodes, partitions, {})
+        cache = self._sub_cache[2]
+        ent = cache.get(sid)
+        if ent is None:
+            shard = plan.shards[sid]
+            ent = (
+                [nodes[int(i)] for i in shard.node_idx],
+                sub_partitions(plan, partitions, sid),
+            )
+            cache[sid] = ent
+        return ent
+
+    def _state(self, sid: int) -> _ShardState:
+        st = self._states.get(sid)
+        if st is None:
+            st = self._states[sid] = _ShardState(self._feature_codes)
+        return st
+
+    # ---- the sharded solve ----
+
+    def solve(
+        self,
+        partitions,
+        nodes,
+        demands,
+        all_pods,
+        n_pending,
+        *,
+        priorities=None,
+        demand_key=None,
+        policy=None,
+    ) -> tuple[dict[int, list[str]], list[int]]:
+        """The sharded equivalent of ``PlacementScheduler._solve_local``:
+        returns (global job index → assigned node names, global
+        incumbent indices that lost their nodes)."""
+        plan = self._ensure_plan(partitions, nodes)
+        _shard_ticks.inc()
+        self.ticks_total += 1
+        free = np.asarray(
+            [
+                (nd.free_cpus, nd.free_memory_mb, nd.free_gpus)
+                if nd.schedulable
+                else (0.0, 0.0, 0.0)
+                for nd in nodes
+            ],
+            np.float32,
+        )
+        routed = route_jobs(
+            plan, free, demands, all_pods, n_pending, priorities
+        )
+        _shard_jobs.inc(len(all_pods))
+        self.last_shards_used = len(routed)
+        if demand_key is None:
+            demand_key = lambda pod: id(pod)  # noqa: E731 - test seam
+
+        # ---- encode (serial: the shared feature table must grow in
+        # deterministic shard order) ----
+        t0 = time.perf_counter()
+        work: list[tuple] = []
+        for sid in sorted(routed):
+            jobs_s = routed[sid]
+            with TRACER.span("scheduler.shard.encode") as enc_span:
+                enc_span.set_tag("shard", str(sid))
+                st = self._state(sid)
+                sub_nodes, sub_parts = self._sub_lists(
+                    plan, partitions, nodes, sid
+                )
+                snapshot = st.inv.refresh(sub_nodes, sub_parts)
+                demands_s = [demands[j] for j in jobs_s]
+                prio_s = (
+                    [priorities[j] for j in jobs_s]
+                    if priorities is not None
+                    else None
+                )
+                batch = st.rows.encode(
+                    [demand_key(all_pods[j]) for j in jobs_s],
+                    demands_s,
+                    snapshot,
+                    codes_token=st.inv.codes_token(),
+                    priorities=prio_s,
+                )
+                enc_span.count("rows", int(batch.num_shards))
+                enc_span.count("jobs", len(jobs_s))
+                n_pend_local = sum(1 for j in jobs_s if j < n_pending)
+                incumbent, shard_rows = self._pin_incumbents(
+                    st, snapshot, batch, all_pods, jobs_s, n_pend_local
+                )
+            work.append(
+                (sid, st, snapshot, batch, incumbent, shard_rows, jobs_s,
+                 n_pend_local)
+            )
+        self.last_encode_ms = (time.perf_counter() - t0) * 1e3
+
+        # ---- solve (fanned; results keyed by shard id) ----
+        self.last_routes = {}
+        results: dict[int, Placement] = {}
+
+        def run_one(item):
+            sid, st, snapshot, batch, incumbent = item[:5]
+            t1 = time.perf_counter()
+            with TRACER.span("scheduler.shard.solve") as span:
+                span.set_tag("shard", str(sid))
+                placement, engine = self._solve_shard(
+                    st, snapshot, batch, incumbent
+                )
+                span.set_tag("engine", engine)
+                span.count("shards", int(batch.num_shards))
+                span.count("nodes", snapshot.num_nodes)
+            _shard_solve_seconds.observe(time.perf_counter() - t1)
+            _shard_route.inc(engine=engine)
+            return sid, placement, engine
+
+        workers = max(1, self.config.workers)
+        if workers > 1 and len(work) > 1:
+            parent = TRACER.current()
+            pool = self._get_pool(workers)
+
+            def run_traced(item):
+                with with_current_span(parent):
+                    return run_one(item)
+
+            outs = list(pool.map(run_traced, work))
+        else:
+            outs = [run_one(item) for item in work]
+        for sid, placement, engine in outs:
+            results[sid] = placement
+            self.last_routes[engine] = self.last_routes.get(engine, 0) + 1
+
+        return self._merge(
+            plan, free, work, results, demands, all_pods, n_pending, policy,
+            nodes,
+        )
+
+    def _get_pool(self, workers: int):
+        with self._pool_lock:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="sbt-shard"
+                )
+            return self._pool
+
+    def close(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    # ---- per-shard internals (mirror _solve_local / _solve) ----
+
+    def _pin_incumbents(
+        self, st, snapshot, batch, all_pods, jobs_s, n_pend_local
+    ):
+        """Streaming-incumbent pinning, per shard: release usage, pin
+        rows to held nodes, drop shards whose hint vanished, +0.5
+        tie-break — exactly the monolithic semantics."""
+        name_idx = st.inv.name_idx
+        incumbent = np.full(batch.num_shards, -1, np.int32)
+        shard_rows: dict[int, list[int]] = {}
+        for row in range(batch.num_shards):
+            shard_rows.setdefault(int(batch.job_of[row]), []).append(row)
+        for lj in range(n_pend_local, len(jobs_s)):
+            pod = all_pods[jobs_s[lj]]
+            hints = getattr(pod, "hint", None) or getattr(
+                getattr(pod, "spec", None), "placement_hint", ()
+            )
+            for k, row in enumerate(shard_rows.get(lj, [])):
+                node = name_idx.get(hints[k]) if k < len(hints) else None
+                if node is not None:
+                    incumbent[row] = node
+                    snapshot.free[node] += batch.demand[row]
+                else:
+                    batch.partition_of[row] = PAD_PARTITION
+                    batch.demand[row] = 0.0
+        if n_pend_local < len(jobs_s):
+            batch.priority[batch.job_of >= n_pend_local] += 0.5
+        return incumbent, shard_rows
+
+    def _solve_shard(self, st, snapshot, batch, incumbent):
+        """Route ONE shard's solve; returns (placement, engine name)."""
+        if self.backend == "greedy":
+            return (
+                greedy_place(snapshot, batch, incumbent=incumbent),
+                "greedy",
+            )
+        # promoted device path: a shard big enough to amortize the mesh
+        # collectives rides the shard_map sweep whenever ≥2 devices
+        # exist (MULTICHIP_r05: dp4×mp2 parity ≥90% vs single-device);
+        # anything that goes wrong degrades to the native packer — the
+        # CPU fallback that keeps a device-less (or wedged-chip) host
+        # solving every tick
+        cells = batch.num_shards * snapshot.num_nodes
+        if self.config.device_solve is not False:
+            forced = self.config.device_solve is True
+            if forced or cells >= self.config.sharded_threshold:
+                placement = self._try_device_sharded(
+                    snapshot, batch, incumbent, forced
+                )
+                if placement is not None:
+                    return placement, "auction-sharded"
+        from slurm_bridge_tpu.solver.routing import (
+            choose_path,
+            gang_shard_fraction,
+            incumbent_fraction,
+            native_fit_policy,
+        )
+
+        if self.backend == "auto":
+            route = choose_path(
+                batch.num_shards,
+                snapshot.num_nodes,
+                gang_fraction=gang_shard_fraction(batch.gang_id),
+                inc_fraction=incumbent_fraction(incumbent),
+            )
+            if route == "native":
+                from slurm_bridge_tpu.solver.indexed_native import (
+                    indexed_place_native,
+                )
+
+                return (
+                    indexed_place_native(
+                        snapshot,
+                        batch,
+                        incumbent=incumbent,
+                        policy=native_fit_policy(bool((incumbent >= 0).any())),
+                    ),
+                    "native",
+                )
+        # single-device auction (explicit auction pin, or auto-device):
+        # serialized — shards share one accelerator
+        from slurm_bridge_tpu.solver.session import DeviceSolver
+
+        p_real = batch.num_shards
+        if self.bucket:
+            batch = pad_batch(batch, self.bucket)
+            if batch.num_shards != p_real:
+                incumbent = np.concatenate(
+                    [incumbent, np.full(batch.num_shards - p_real, -1, np.int32)]
+                )
+        with self._device_lock:
+            if st.solver is None:
+                st.solver = DeviceSolver(snapshot, self.auction_config)
+            else:
+                st.solver.update_snapshot(snapshot)
+            placement = st.solver.solve(batch, incumbent=incumbent)
+        if placement.node_of.shape[0] != p_real:
+            placement = Placement(
+                node_of=placement.node_of[:p_real],
+                placed=placement.placed[:p_real],
+                free_after=placement.free_after,
+            )
+        return placement, "auction"
+
+    def _try_device_sharded(
+        self, snapshot, batch, incumbent, forced: bool
+    ) -> Placement | None:
+        """The shard_map sweep, or None (→ native fallback). Import and
+        device probing both live inside the guard: a host without JAX
+        devices must not pay (or crash on) backend init per tick."""
+        try:
+            from slurm_bridge_tpu.parallel.backend import ensure_backend
+
+            ensure_backend()
+            import jax
+
+            if len(jax.devices()) < 2:
+                return None
+            from slurm_bridge_tpu.solver.sharded import sharded_place
+
+            p_real = batch.num_shards
+            inc = incumbent
+            if self.bucket:
+                batch = pad_batch(batch, self.bucket)
+                if batch.num_shards != p_real:
+                    inc = np.concatenate(
+                        [inc, np.full(batch.num_shards - p_real, -1, np.int32)]
+                    )
+            with self._device_lock:
+                placement = sharded_place(
+                    snapshot, batch, self.auction_config, incumbent=inc
+                )
+            if placement.node_of.shape[0] != p_real:
+                placement = Placement(
+                    node_of=placement.node_of[:p_real],
+                    placed=placement.placed[:p_real],
+                    free_after=placement.free_after,
+                )
+            return placement
+        except Exception:
+            # wedged chip / missing mesh / OOM: the tick must still
+            # solve — log once per occurrence and take the CPU path
+            log.warning(
+                "device shard_map solve failed%s; falling back to the "
+                "native packer for this shard",
+                " (forced)" if forced else "",
+                exc_info=True,
+            )
+            return None
+
+    # ---- merge + reconcile ----
+
+    def _merge(
+        self, plan, free, work, results, demands, all_pods, n_pending,
+        policy, nodes,
+    ):
+        by_job_names: dict[int, list[str]] = {}
+        lost_jobs: list[int] = []
+        residual = free.copy()
+        failed_gangs: list[dict] = []
+        names_of = plan.pos_name
+        for item in work:
+            (sid, st, snapshot, batch, incumbent, shard_rows, jobs_s,
+             n_pend_local) = item
+            placement = results[sid]
+            node_idx = plan.shards[sid].node_idx
+            residual[node_idx] = placement.free_after
+            by_local = placement.by_job(batch)
+            if policy is not None and policy.config.backfill:
+                for row, node in policy.backfill(
+                    snapshot, batch, placement, n_pend_local,
+                    rank_of=lambda lj, js=jobs_s: policy.class_rank_of_job(js[lj]),
+                ):
+                    by_local.setdefault(int(batch.job_of[row]), []).append(node)
+                    residual[int(node_idx[node])] -= batch.demand[row]
+            for lj, idxs in by_local.items():
+                by_job_names[jobs_s[lj]] = [
+                    snapshot.node_names[i] for i in idxs
+                ]
+            for lj in range(n_pend_local, len(jobs_s)):
+                if any(
+                    incumbent[r] >= 0 and placement.node_of[r] != incumbent[r]
+                    for r in shard_rows.get(lj, [])
+                ):
+                    lost_jobs.append(jobs_s[lj])
+            # fully-unplaced pending gangs → reconcile candidates
+            if self.config.reconcile:
+                for lj in range(n_pend_local):
+                    rows = shard_rows.get(lj, [])
+                    if len(rows) <= 1 or lj in by_local:
+                        continue
+                    if any(placement.placed[r] for r in rows):
+                        continue  # partial remnants are dead this tick
+                    r0 = rows[0]
+                    j = jobs_s[lj]
+                    failed_gangs.append({
+                        "j": j,
+                        "d": batch.demand[r0].copy(),
+                        "need": len(rows),
+                        "part": demands[j].partition,
+                        "req": int(batch.req_features[r0]),
+                        "rank": (
+                            policy.class_rank_of_job(j)
+                            if policy is not None
+                            else 0
+                        ),
+                        "prio": float(batch.priority[r0]),
+                    })
+        lost_jobs.sort()
+
+        self.last_reconcile_attempts = len(failed_gangs)
+        self.last_reconcile_placed = 0
+        if failed_gangs:
+            placed = reconcile_gangs(
+                failed_gangs,
+                residual,
+                self._global_features(plan, work, nodes),
+                plan.part_nodes,
+                limit=self.config.reconcile_limit,
+            )
+            self.last_reconcile_placed = len(placed)
+            for j, positions in placed:
+                by_job_names[j] = [names_of[p] for p in positions]
+            _shard_reconcile.inc(len(placed), outcome="placed")
+            _shard_reconcile.inc(
+                len(failed_gangs) - len(placed), outcome="unplaced"
+            )
+        self.reconcile_attempts_total += self.last_reconcile_attempts
+        self.reconcile_placed_total += self.last_reconcile_placed
+        self._note_locality(plan, by_job_names, demands, n_pending)
+        return by_job_names, lost_jobs
+
+    def _global_features(self, plan, work, nodes) -> np.ndarray:
+        """Per-node uint32 feature masks on the global axis, assembled
+        from the per-shard snapshots (one shared code table ⇒ masks are
+        directly comparable). Shards NO job routed to this tick have no
+        snapshot — their nodes fold masks straight from the shared code
+        table, because leaving them 0 would make reconcile reject
+        feature-requiring gangs on exactly the idle capacity the pass
+        exists to reach."""
+        feats = np.zeros(plan.node_shard.shape[0], np.uint32)
+        covered: set[int] = set()
+        for item in work:
+            sid, _st, snapshot = item[0], item[1], item[2]
+            feats[plan.shards[sid].node_idx] = snapshot.features
+            covered.add(sid)
+        codes = self._feature_codes
+        if self._feat_memo_token != len(codes):
+            # a grown code table re-resolves previously-unknown features
+            self._feat_memo = {}
+            self._feat_memo_token = len(codes)
+        memo = self._feat_memo
+        for shard in plan.shards:
+            if shard.sid in covered:
+                continue
+            for pos in shard.node_idx.tolist():
+                ft = nodes[pos].features
+                m = memo.get(ft)
+                if m is None:
+                    m = 0
+                    for f in ft:
+                        bit = codes.get(f)
+                        if bit is not None:
+                            m |= 1 << bit
+                    memo[ft] = m
+                feats[pos] = np.uint32(m)
+        return feats
+
+    def _note_locality(self, plan, by_job_names, demands, n_pending) -> None:
+        """Rank-locality accounting: for every placed pending gang, the
+        fraction of its shards inside ONE island (1.0 = fully
+        ICI-local). The scorecard reports the run mean."""
+        for j, names in by_job_names.items():
+            if j >= n_pending or len(names) <= 1:
+                continue
+            isl = [
+                int(plan.node_island[plan.name_pos[n]])
+                for n in names
+                if n in plan.name_pos
+            ]
+            if not isl:
+                continue
+            counts = np.bincount(np.asarray([i for i in isl if i >= 0]))
+            best = int(counts.max()) if counts.size else 0
+            self.locality_sum += best / len(names)
+            self.locality_count += 1
+
+    # ---- observability rollups ----
+
+    def stats(self) -> dict:
+        """Deterministic run aggregates (harness determinism/quality)."""
+        return {
+            "shard_count": self._plan.num_shards if self._plan else 0,
+            "shard_ticks": self.ticks_total,
+            "reconcile_attempts": self.reconcile_attempts_total,
+            "reconcile_placed": self.reconcile_placed_total,
+            "gang_rank_locality_mean": (
+                round(self.locality_sum / self.locality_count, 4)
+                if self.locality_count
+                else None
+            ),
+            "gangs_scored": self.locality_count,
+        }

@@ -29,6 +29,7 @@ from slurm_bridge_tpu.sim.scenarios import (
     CHAOS_SCENARIOS,
     QUALITY_SCENARIOS,
     SCENARIOS,
+    SHARD_SCENARIOS,
     SMOKE_SCENARIOS,
 )
 
@@ -139,6 +140,8 @@ def _smoke(names: tuple[str, ...] = SMOKE_SCENARIOS, label: str = "sim-smoke") -
             "flight_phase_sum_p50_ms": a.flight_record.get("phase_sum_p50_ms"),
             "flight_commits_total": a.flight_record.get("commits_total"),
         }
+        if a.scenario.sharding is not None:
+            line["shard"] = a.determinism.get("shard")
         print(json.dumps(line))
         if det_a != det_b:
             failures.append(f"{name}: determinism broke (same seed, different run)")
@@ -196,6 +199,25 @@ def _smoke(names: tuple[str, ...] = SMOKE_SCENARIOS, label: str = "sim-smoke") -
                 failures.append(
                     f"{name}: post-recovery {key} diverged from the "
                     "crash-free run at the same seed"
+                )
+        if a.scenario.sharding is not None:
+            # shard-specific gates: the plan must actually shard, and
+            # the reconciliation scenario must actually reconcile —
+            # either degrading silently would leave the subsystem
+            # untested while the smoke line stays green
+            sh = a.determinism.get("shard") or {}
+            if (sh.get("shard_count") or 0) < 2:
+                failures.append(
+                    f"{name}: sharding on but the plan built "
+                    f"{sh.get('shard_count')} shard(s) — the fan-out "
+                    "never engaged"
+                )
+            if name == "sharded_gang_split" and not sh.get(
+                "reconcile_placed"
+            ):
+                failures.append(
+                    f"{name}: no gang placed via cross-shard "
+                    "reconciliation — the pass is dead"
                 )
     if failures:
         for f in failures:
@@ -369,6 +391,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="CI gate: the placement-quality scenarios "
                         "(double-run + policy-on/off arms + scorecard "
                         "floors — fairness, wait bounds, backfill)")
+    parser.add_argument("--shard", action="store_true",
+                        help="CI gate: the sharded-placement scenarios "
+                        "(double-run determinism + invariants + shard/"
+                        "reconcile engagement gates)")
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--scale", type=float, default=1.0,
                         help="multiply pod/node counts (default 1.0)")
@@ -387,14 +413,21 @@ def main(argv: list[str] | None = None) -> int:
         return _smoke(CHAOS_SCENARIOS, label="chaos-smoke")
     if args.quality:
         return _quality()
+    if args.shard:
+        return _smoke(SHARD_SCENARIOS, label="shard-smoke")
     if args.smoke:
         return _smoke()
 
     names = args.scenarios or (
-        # --all = every fast scenario, chaos + quality subsets included
-        # (the smoke GATES keep the sets disjoint; a human asking for
-        # "all" wants all)
-        [*SMOKE_SCENARIOS, *CHAOS_SCENARIOS, *QUALITY_SCENARIOS]
+        # --all = every fast scenario, chaos + quality + shard subsets
+        # included (the smoke GATES keep the sets disjoint; a human
+        # asking for "all" wants all)
+        [
+            *SMOKE_SCENARIOS,
+            *CHAOS_SCENARIOS,
+            *QUALITY_SCENARIOS,
+            *(n for n in SHARD_SCENARIOS if n not in SMOKE_SCENARIOS),
+        ]
         if args.all
         else []
     )
@@ -405,6 +438,7 @@ def main(argv: list[str] | None = None) -> int:
         parser.error(f"unknown scenarios {unknown}; see --list")
 
     results = []
+    gate_failures: list[str] = []
     for name in names:
         sc = _build(name, seed=args.seed, scale=args.scale, ticks=args.ticks)
         print(f"# running {name} "
@@ -413,11 +447,22 @@ def main(argv: list[str] | None = None) -> int:
         result = run_scenario(sc)
         results.append(result)
         print(json.dumps(result.as_dict()), flush=True)
-        if name == "full_50kx10k":
+        if name.startswith("full_") and "crash" not in name:
+            # every full_* headline scenario emits its metric line +
+            # flight diagnostics (full_50kx10k since PR-5, the sharded
+            # full_500kx100k since PR-10)
             print(json.dumps(_headline(result)), flush=True)
             path = _write_flight_diagnostics(result)
             if path:
                 print(f"# flight record: {path}", file=sys.stderr)
+        if (
+            sc.p50_gate_ms is not None
+            and result.timing["tick_p50_ms"] > sc.p50_gate_ms
+        ):
+            gate_failures.append(
+                f"{name}: tick_p50_ms {result.timing['tick_p50_ms']} over "
+                f"the {sc.p50_gate_ms} ms gate"
+            )
         if name == "full_50kx10k_crash":
             # the recovery-at-scale record BASELINE.md tracks
             print(json.dumps({
@@ -441,6 +486,10 @@ def main(argv: list[str] | None = None) -> int:
     ]
     if bad:
         print(f"# invariant violations in: {', '.join(bad)}", file=sys.stderr)
+        return 1
+    if gate_failures:
+        for f in gate_failures:
+            print(f"# p50 gate FAIL: {f}", file=sys.stderr)
         return 1
     return 0
 

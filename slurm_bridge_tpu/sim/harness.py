@@ -151,6 +151,13 @@ class Scenario:
     #: (repair off, few rounds) so the backfill pass has real
     #: fragmentation holes to fill — the shape the quality gate measures
     auction_config: object | None = None
+    #: sharded-placement config (shard.ShardConfig) — partition/island
+    #: fan-out + cross-shard gang reconciliation. None = sharding OFF,
+    #: the monolithic tick byte-for-byte (fixture-pinned, like policy)
+    sharding: object | None = None
+    #: CLI-enforced tick p50 ceiling (ms) for slow headline scenarios;
+    #: None = record only
+    p50_gate_ms: float | None = None
 
 
 @dataclass
@@ -476,6 +483,10 @@ class SimHarness:
             preemption=scenario.preemption,
             inventory_ttl=0.0,  # virtual time: always take a fresh snapshot
             policy=self.policy_engine,
+            # a fresh executor per stack incarnation: its per-shard caches
+            # are in-memory tick state, rebuilt from scratch after a crash
+            # exactly like the monolithic encode caches
+            shard=scenario.sharding,
         )
         self._pod_watch = self.store.watch((Pod.KIND,))
         self._node_watch = self.store.watch((VirtualNode.KIND,))
@@ -505,6 +516,8 @@ class SimHarness:
         # every VirtualNode in the store (the ADVICE #1 contract; the
         # failover scenarios assert zero node deletions)
         self.configurator.stop()
+        if self.scheduler.shard is not None:
+            self.scheduler.shard.close()
         self.store.unwatch(self._pod_watch)
         self.store.unwatch(self._node_watch)
 
@@ -1169,6 +1182,12 @@ class SimHarness:
             "final_outcome_digest": self._final_outcome_digest(),
             "digest": self._digest.hexdigest(),
         }
+        if self.scheduler.shard is not None:
+            # sharded-tick aggregates (plan size, routing, reconcile
+            # outcomes, rank-locality) are id-keyed and deterministic —
+            # they ride the determinism section so the double-run gate
+            # covers the fan-out, and the shard-smoke gate reads them
+            determinism["shard"] = self.scheduler.shard.stats()
         phase_arr = {
             k: np.asarray([p.get(k, 0.0) for p in self._tick_phases])
             for k in (*PHASES, "tick", "cpu")
@@ -1258,6 +1277,11 @@ class SimHarness:
                     self.policy_engine.pool_excluded_last
                 ),
             }
+        if self.scheduler.shard is not None:
+            # the rank-locality score + reconcile outcomes belong on the
+            # quality scorecard: they are placement-quality facts of the
+            # sharded tick (ISSUE 10 acceptance)
+            policy_extra["shard"] = self.scheduler.shard.stats()
         result = ScenarioResult(
             scenario=sc,
             determinism=determinism,

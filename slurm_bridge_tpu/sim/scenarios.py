@@ -14,6 +14,7 @@ pods × 10k nodes, reported as ``full_tick_p50_ms_50kx10k``.
 from __future__ import annotations
 
 from slurm_bridge_tpu.policy.engine import PolicyConfig
+from slurm_bridge_tpu.shard.planner import ShardConfig
 from slurm_bridge_tpu.sim.faults import Fault, FaultPlan
 from slurm_bridge_tpu.sim.harness import Scenario
 from slurm_bridge_tpu.sim.trace import ClusterSpec, WorkloadSpec
@@ -556,6 +557,104 @@ def elastic_resize(scale: float = 1.0, seed: int = 57) -> Scenario:
     )
 
 
+def sharded_smoke(scale: float = 1.0, seed: int = 58) -> Scenario:
+    """The fast sharded-tick gate (ISSUE 10): a gang-heavy mixed
+    workload on 3 partitions, each split across several shards
+    (``max_nodes_per_shard`` ≈ nodes/9), with a 2-wide solve fan-out.
+    Double-run determinism proves the fan-out merges id-keyed; the
+    shard-smoke gate additionally requires the plan to actually shard
+    (≥2 shards) — a silently-monolithic run is a failed gate, not a
+    pass."""
+    n_nodes = _n(900, scale)
+    return Scenario(
+        name="sharded_smoke",
+        description="partition/island fan-out on split partitions; "
+        "double-run deterministic, invariants hold",
+        cluster=ClusterSpec(
+            num_nodes=n_nodes,
+            num_partitions=3,
+            partition_features=("tier0", "tier1"),
+        ),
+        workload=WorkloadSpec(
+            jobs=_n(2400, scale, floor=60),
+            arrival="poisson",
+            spread_ticks=8,
+            gang_fraction=0.2,
+        ),
+        ticks=16,
+        seed=seed,
+        sharding=ShardConfig(
+            max_nodes_per_shard=max(12, n_nodes // 9), workers=2
+        ),
+    )
+
+
+def sharded_gang_split(scale: float = 1.0, seed: int = 59) -> Scenario:
+    """The cross-shard reconciliation shape: gangs of 8 on partitions
+    deliberately split into shards too small to host them
+    (``max_nodes_per_shard`` < gang size at smoke scale) — every gang
+    FAILS its home shard and must place through the merged-residual
+    reconcile pass, all-or-nothing. The shard-smoke gate requires
+    ``reconcile_placed ≥ 1`` so the pass can never silently stop
+    running."""
+    n_nodes = _n(240, scale)
+    return Scenario(
+        name="sharded_gang_split",
+        description="8-node gangs vs sub-gang-size shards; gangs place "
+        "only via cross-shard reconciliation",
+        cluster=ClusterSpec(
+            num_nodes=n_nodes, num_partitions=2, gpu_fraction=0.0
+        ),
+        workload=WorkloadSpec(
+            jobs=_n(400, scale, floor=40),
+            arrival="poisson",
+            spread_ticks=6,
+            gang_fraction=0.5,
+            gang_size=8,
+            gpu_fraction=0.0,
+        ),
+        ticks=14,
+        seed=seed,
+        sharding=ShardConfig(
+            max_nodes_per_shard=max(6, n_nodes // 40), workers=2
+        ),
+    )
+
+
+def full_500kx100k(scale: float = 1.0, seed: int = 42) -> Scenario:
+    """The 10×-scale headline (ISSUE 10, slow — tens of minutes): 500k
+    pods × 100k nodes through the FULL bridge pipeline with the
+    partition/island shard fan-out on. 16 partitions of ~6.2k nodes
+    each split across ~8k-node shards; gangs straddling split
+    partitions place all-or-nothing (reconcile pass), with the
+    rank-locality score on the quality scorecard. Records
+    ``full_tick_p50_ms_500kx100k`` with the standard phase breakdown,
+    gated by ``p50_gate_ms``."""
+    return Scenario(
+        name="full_500kx100k",
+        description="full-bridge sharded reconcile tick at the "
+        "500k x 100k product shape (slow)",
+        cluster=ClusterSpec(num_nodes=_n(100_000, scale), num_partitions=16),
+        workload=WorkloadSpec(
+            jobs=_n(500_000, scale, floor=200),
+            arrival="front",
+            gang_fraction=0.05,
+            gpu_fraction=0.15,
+            duration_range=(30.0, 120.0),
+        ),
+        ticks=3,
+        expect_drain=False,
+        drain_grace_ticks=0,
+        seed=seed,
+        slow=True,
+        sharding=ShardConfig(max_nodes_per_shard=8192, workers=2),
+        # headline gate: comfortably above the measured p50 (see
+        # BASELINE.md PR-10) so CI noise can't flake it, low enough
+        # that an O(cluster) regression in the fan-out trips it
+        p50_gate_ms=120_000.0,
+    )
+
+
 def full_50kx10k(scale: float = 1.0, seed: int = 42) -> Scenario:
     """The headline: 50k pods × 10k nodes through the FULL bridge
     pipeline. Slow (minutes); records ``full_tick_p50_ms_50kx10k`` with
@@ -630,6 +729,9 @@ SCENARIOS = {
         multi_tenant_storm,
         priority_inversion,
         elastic_resize,
+        sharded_smoke,
+        sharded_gang_split,
+        full_500kx100k,
         full_50kx10k,
         full_50kx10k_crash,
     )
@@ -655,9 +757,21 @@ QUALITY_SCENARIOS = (
     "elastic_resize",
 )
 
+#: the sharded-placement subset `make shard-smoke` double-runs (ISSUE
+#: 10): determinism + invariants on the fan-out, plus shard-specific
+#: gates (the plan actually shards; sharded_gang_split actually
+#: reconciles). ``sharded_smoke`` ALSO rides sim-smoke — the tentpole
+#: wants the fast sharded scenario in the default gate, and the extra
+#: run is seconds at smoke scale
+SHARD_SCENARIOS = (
+    "sharded_smoke",
+    "sharded_gang_split",
+)
+
 #: the fast set `make sim-smoke` double-runs: everything not slow-marked,
-#: MINUS the chaos and quality subsets — `make check` and CI run
-#: sim-smoke, chaos-smoke and quality-smoke side by side, so overlap
+#: MINUS the chaos and quality subsets (and the shard subset except
+#: sharded_smoke, see above) — `make check` and CI run sim-smoke,
+#: chaos-smoke, quality-smoke and shard-smoke side by side, so overlap
 #: would execute each scenario (and its twin arms) twice for zero added
 #: coverage
 SMOKE_SCENARIOS = tuple(
@@ -665,4 +779,5 @@ SMOKE_SCENARIOS = tuple(
     if not f().slow
     and n not in CHAOS_SCENARIOS
     and n not in QUALITY_SCENARIOS
+    and (n not in SHARD_SCENARIOS or n == "sharded_smoke")
 )

@@ -252,7 +252,17 @@ class PlacementSolverServicer:
                 rows_dem.append(dem)
                 rows_part.append(this_part)
                 rows_feat.append(feat)
-                rows_prio.append(float(job.priority) + (0.5 if pinned else 0.0))
+                # policy effective priorities ride the wire (PR-10): an
+                # override replaces the raw CR priority so the bridge's
+                # class/fair-share admission order is enforced INSIDE the
+                # sidecar solve; the +0.5 incumbent tie-break stacks on
+                # top exactly like the in-process path
+                base = (
+                    float(job.priority_override)
+                    if job.has_priority_override
+                    else float(job.priority)
+                )
+                rows_prio.append(base + (0.5 if pinned else 0.0))
                 rows_job.append(j)
                 rows_inc.append(inc)
         if zero_demand:

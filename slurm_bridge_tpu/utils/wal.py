@@ -5,10 +5,12 @@ and the agent's job-state journal (``agent/journal.py``) ride ONE
 implementation of the on-disk contract:
 
 - **Framing**: length-prefixed, CRC32-checksummed records
-  (``<u32 len><u32 crc><json payload>``). :func:`read_wal` detects a torn
-  tail (crash mid-append — expected, not an error) or a corrupt record
-  and returns everything before the first defect — prior state is never
-  lost.
+  (``<u32 len><u32 crc><json payload>``). The length word's high bit
+  marks a zlib-compressed payload (:data:`COMPRESSED_FLAG`, PR-10) —
+  old files can never set it, so replay stays format-compatible both
+  ways. :func:`read_wal` detects a torn tail (crash mid-append —
+  expected, not an error) or a corrupt record and returns everything
+  before the first defect — prior state is never lost.
 - **Group-commit fsync** (:class:`WalWriter`): appends are ordered under
   one lock; ``sync_to(offset)`` is the durability barrier. When several
   threads reach the barrier concurrently (the agent's batched-submit
@@ -37,6 +39,17 @@ import zlib
 
 #: WAL record framing: little-endian (payload_len, crc32(payload))
 RECORD_HDR = struct.Struct("<II")
+
+#: high bit of the length word marks a zlib-compressed payload (PR-10).
+#: Pre-compression files can never set it — a record would need 2 GiB of
+#: JSON — so old WALs replay unchanged. The reverse direction is LOSSY:
+#: an old reader treats the flagged length as a >2 GiB record and stops
+#: at the first compressed frame as a "torn" tail, keeping only what
+#: precedes it — so compact (fold the WAL into the snapshot) BEFORE
+#: downgrading a binary across this format change. The CRC covers the
+#: compressed bytes: corruption is detected before inflate ever runs.
+COMPRESSED_FLAG = 0x8000_0000
+_LEN_MASK = COMPRESSED_FLAG - 1
 
 #: process-wide simulated fsync latency (seconds); per-writer override
 #: takes precedence when set. See set_fsync_delay().
@@ -67,9 +80,23 @@ def durable_fsync(fd: int, *, delay_s: float | None = None) -> None:
         time.sleep(d)
 
 
-def pack_record(payload: dict) -> bytes:
-    body = json.dumps(payload, separators=(",", ":")).encode()
+def frame_body(body: bytes, *, compress: bool = False) -> bytes:
+    """Frame an already-serialized JSON body. ``compress=True`` deflates
+    it (zlib level 1 — the WAL is write-latency-bound, not ratio-bound)
+    and sets the length word's :data:`COMPRESSED_FLAG`."""
+    if compress:
+        body = zlib.compress(body, 1)
+        return RECORD_HDR.pack(
+            len(body) | COMPRESSED_FLAG, zlib.crc32(body)
+        ) + body
     return RECORD_HDR.pack(len(body), zlib.crc32(body)) + body
+
+
+def pack_record(payload: dict, *, compress: bool = False) -> bytes:
+    return frame_body(
+        json.dumps(payload, separators=(",", ":")).encode(),
+        compress=compress,
+    )
 
 
 def read_wal(path: str) -> tuple[list[dict], int, str | None]:
@@ -90,7 +117,8 @@ def read_wal(path: str) -> tuple[list[dict], int, str | None]:
     while off < n:
         if off + RECORD_HDR.size > n:
             return records, off, "torn"
-        length, crc = RECORD_HDR.unpack_from(data, off)
+        word, crc = RECORD_HDR.unpack_from(data, off)
+        length = word & _LEN_MASK
         end = off + RECORD_HDR.size + length
         if end > n:
             return records, off, "torn"
@@ -98,8 +126,10 @@ def read_wal(path: str) -> tuple[list[dict], int, str | None]:
         if zlib.crc32(body) != crc:
             return records, off, "corrupt"
         try:
+            if word & COMPRESSED_FLAG:
+                body = zlib.decompress(body)
             records.append(json.loads(body))
-        except ValueError:
+        except (ValueError, zlib.error):
             return records, off, "corrupt"
         off = end
     return records, off, None

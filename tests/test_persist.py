@@ -489,3 +489,124 @@ def test_restart_resume_running_job(fake_slurm, tmp_path):
             b.stop()
     finally:
         server.stop(None)
+
+
+# ------------------------------------- WAL batching + compression (PR-10)
+
+
+def test_wal_batch_envelope_and_compression_round_trip(tmp_path):
+    """The default writer frames ONE batch envelope per flush and
+    deflates it past the floor; replay restores every object."""
+    from slurm_bridge_tpu.bridge.persist import read_wal
+    from slurm_bridge_tpu.utils.wal import COMPRESSED_FLAG, RECORD_HDR
+
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False, compress_floor=64)
+    for i in range(50):
+        store.create(_job(f"j{i:03d}"))
+    assert p.flush() == 50
+    assert p.wal_batches == 1
+    # on disk: exactly one frame, compressed flag set, smaller than raw
+    data = open(p.wal_path, "rb").read()
+    word, _crc = RECORD_HDR.unpack_from(data, 0)
+    assert word & COMPRESSED_FLAG
+    assert RECORD_HDR.size + (word & (COMPRESSED_FLAG - 1)) == len(data)
+    assert len(data) < p.wal_bytes_raw, "compression bought nothing"
+    records, _, defect = read_wal(p.wal_path)
+    assert defect is None and len(records) == 1
+    assert records[0]["op"] == "batch" and records[0]["count"] == 50
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 50
+
+
+def test_wal_unbatched_writer_still_replays(tmp_path):
+    """``batch=False`` writes the pre-PR-10 per-record frames; replay
+    handles both formats through one loop."""
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False, batch=False)
+    store.create(_job("old-style"))
+    assert p.flush() == 1
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 1
+    assert fresh.try_get(BridgeJob.KIND, "old-style") is not None
+
+
+def test_wal_batch_below_compress_floor_stays_plain(tmp_path):
+    from slurm_bridge_tpu.utils.wal import COMPRESSED_FLAG, RECORD_HDR
+
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False, compress_floor=1 << 20)
+    store.create(_job("tiny"))
+    p.flush()
+    word, _ = RECORD_HDR.unpack_from(open(p.wal_path, "rb").read(), 0)
+    assert not (word & COMPRESSED_FLAG)
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 1
+
+
+def test_wal_compressed_batch_corruption_detected(tmp_path):
+    """A flipped byte inside a compressed envelope fails the CRC —
+    replay keeps everything before the defect, exactly like the
+    uncompressed format."""
+    from slurm_bridge_tpu.bridge.persist import read_wal
+
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False, compress_floor=64)
+    for i in range(20):
+        store.create(_job(f"a{i:02d}"))
+    p.flush()
+    for i in range(20):
+        store.create(_job(f"b{i:02d}"))
+    p.flush()
+    data = bytearray(open(p.wal_path, "rb").read())
+    data[-3] ^= 0xFF
+    open(p.wal_path, "wb").write(bytes(data))
+    records, _, defect = read_wal(p.wal_path)
+    assert defect == "corrupt" and len(records) == 1
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 20
+
+
+def test_wal_batch_delete_replay(tmp_path):
+    """Deletes ride the batch envelope with the same incarnation/rv
+    skip semantics as puts."""
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False)
+    store.create(_job("keep"))
+    store.create(_job("drop"))
+    p.flush()
+    store.delete(BridgeJob.KIND, "drop")
+    p.flush()
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 1
+    assert fresh.try_get(BridgeJob.KIND, "keep") is not None
+    assert fresh.try_get(BridgeJob.KIND, "drop") is None
+
+
+def test_wal_batch_foreign_incarnation_tail_skipped(tmp_path):
+    """A batch envelope stamped by a DEAD incarnation must not replay
+    over the new incarnation's snapshot (the crash-between-snapshot-
+    install-and-truncate window, batched form)."""
+    store = ObjectStore()
+    path = str(tmp_path / "state.json")
+    p = StorePersistence(store, path, auto_flush=False)
+    store.create(_job("kept"))
+    p.flush()
+    p.compact()  # snapshot carries incarnation A, WAL empty
+    # a leftover tail from ANOTHER incarnation deleting the object
+    from slurm_bridge_tpu.utils.wal import pack_record
+
+    with open(p.wal_path, "ab") as f:
+        f.write(pack_record({
+            "op": "batch", "inc": "dead-incarnation", "count": 1,
+            "records": [{"op": "del", "kind": BridgeJob.KIND,
+                         "name": "kept", "rv": 10**9}],
+        }))
+    fresh = ObjectStore()
+    assert load_into(fresh, path) == 1
+    assert fresh.try_get(BridgeJob.KIND, "kept") is not None

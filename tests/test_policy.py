@@ -416,3 +416,85 @@ def test_policy_off_matches_pr8_baseline_fixture():
         assert d["events"] == want["events"], f"{name}: event counts drifted"
         assert d["bound_total"] == want["bound_total"]
         assert d["preempted_total"] == want["preempted_total"]
+
+
+# ----------------------------------- durable fair share (PR-10 satellite)
+
+
+def test_fairshare_ledger_rides_the_wal(tmp_path):
+    """save_to_store → WAL flush → load_into → load_from_store restores
+    the accumulated per-tenant service exactly."""
+    from slurm_bridge_tpu.bridge.objects import PolicyState
+    from slurm_bridge_tpu.bridge.persist import StorePersistence, load_into
+    from slurm_bridge_tpu.bridge.store import ObjectStore
+
+    store = ObjectStore()
+    p = StorePersistence(store, str(tmp_path / "state.json"), auto_flush=False)
+    engine = PlacementPolicy(PolicyConfig())
+    engine.fair.charge("tenant-a", 0.25)
+    engine.fair.charge("tenant-b", 0.0625)
+    engine._usage_dirty = True
+    engine.save_to_store(store)
+    p.flush()
+
+    fresh = ObjectStore()
+    assert load_into(fresh, str(tmp_path / "state.json")) == 1
+    reborn = PlacementPolicy(PolicyConfig())
+    reborn.load_from_store(fresh)
+    assert reborn.fair.usage == {"tenant-a": 0.25, "tenant-b": 0.0625}
+    obj = fresh.try_get(PolicyState.KIND, PolicyState.FAIRSHARE_NAME)
+    assert obj is not None and obj.generation == 1
+
+
+def test_fairshare_save_is_dirty_gated():
+    """A tick that admitted nothing writes NOTHING — the steady-state
+    zero-writes discipline holds with the ledger attached."""
+    from slurm_bridge_tpu.bridge.objects import PolicyState
+    from slurm_bridge_tpu.bridge.store import ObjectStore
+
+    store = ObjectStore()
+    engine = PlacementPolicy(PolicyConfig())
+    engine.save_to_store(store)  # never charged: no object appears
+    assert store.try_get(PolicyState.KIND, PolicyState.FAIRSHARE_NAME) is None
+    engine._tick_jobs = [("tenant-a", 0.5, 1)]
+    engine.note_admitted([0])
+    engine.save_to_store(store)
+    obj = store.try_get(PolicyState.KIND, PolicyState.FAIRSHARE_NAME)
+    assert obj is not None and obj.usage == {"tenant-a": 0.5}
+    rv = obj.meta.resource_version
+    engine.save_to_store(store)  # clean again: no second write
+    assert (
+        store.get(PolicyState.KIND, PolicyState.FAIRSHARE_NAME)
+        .meta.resource_version
+        == rv
+    )
+
+
+def test_fairshare_survives_crash_restart_jain_tolerance():
+    """The ROADMAP regression: a bridge crash mid-storm must NOT reset
+    tenant service — the crashed run's Jain index stays within
+    tolerance of the crash-free twin at the same seed (the ledger
+    reloads from snapshot+WAL through PolicyState)."""
+    import dataclasses
+
+    from slurm_bridge_tpu.sim.faults import Fault, FaultPlan
+    from slurm_bridge_tpu.sim.harness import run_scenario
+    from slurm_bridge_tpu.sim.scenarios import SCENARIOS
+
+    sc = SCENARIOS["multi_tenant_storm"](scale=0.12)
+    crashed = run_scenario(
+        dataclasses.replace(
+            sc,
+            faults=FaultPlan(
+                (Fault(kind="crash_restart", start_tick=4, end_tick=5),)
+            ),
+            persistence=True,
+        )
+    )
+    twin = run_scenario(sc)
+    assert crashed.determinism["restarts"] == 1
+    ja = crashed.quality["jain_fairness"]
+    jt = twin.quality["jain_fairness"]
+    assert abs(ja - jt) <= 0.05, (
+        f"fair share reset across the crash: Jain {ja} vs twin {jt}"
+    )

@@ -501,3 +501,47 @@ def test_zero_demand_wire_skew_guard():
         None,
     )
     assert _zero_demand_total.value() - before == 3
+
+
+def test_place_priority_override_rides_the_wire(solver_client):
+    """PR-10: a policy effective priority (priority_override +
+    has_priority_override) replaces the raw CR priority inside the
+    sidecar solve — the bridge's class/fair-share admission order
+    survives the hop. A zero override is a LEGITIMATE value (rank 0,
+    slot 0), carried by the explicit presence bool."""
+    # raw priorities say "lo" wins; the overrides invert that
+    resp = solver_client.Place(
+        pb.PlaceRequest(
+            jobs=[
+                pb.PlaceJob(id="lo", cpus=4, mem_mb=1024, partition="p",
+                            priority=9, priority_override=1.0,
+                            has_priority_override=True),
+                pb.PlaceJob(id="hi", cpus=4, mem_mb=1024, partition="p",
+                            priority=1, priority_override=5.0,
+                            has_priority_override=True),
+            ],
+            inventory=_inventory(1, cpus=4),
+            partitions=_partitions({"p": ["n0"]}),
+            solver="auction",
+        )
+    )
+    names = {a.job_id: list(a.node_names) for a in resp.assignments}
+    assert names["hi"] == ["n0"] and names["lo"] == []
+    # zero-valued override is honored (not read as "absent")
+    resp = solver_client.Place(
+        pb.PlaceRequest(
+            jobs=[
+                pb.PlaceJob(id="zero", cpus=4, mem_mb=1024, partition="p",
+                            priority=9, priority_override=0.0,
+                            has_priority_override=True),
+                pb.PlaceJob(id="one", cpus=4, mem_mb=1024, partition="p",
+                            priority=1, priority_override=1.0,
+                            has_priority_override=True),
+            ],
+            inventory=_inventory(1, cpus=4),
+            partitions=_partitions({"p": ["n0"]}),
+            solver="auction",
+        )
+    )
+    names = {a.job_id: list(a.node_names) for a in resp.assignments}
+    assert names["one"] == ["n0"] and names["zero"] == []
