@@ -5,7 +5,8 @@
 
 .PHONY: help test fast check generate apidoc hygiene bench bench-smoke \
         sim-smoke chaos-smoke quality-smoke shard-smoke sim sim-bench \
-        sim-bench-crash sim-bench-500k wal-fsync-bench scenarios \
+        sim-bench-crash sim-bench-500k sim-bench-steady \
+        sim-bench-steady-500k wal-fsync-bench scenarios \
         docker-build install uninstall deploy undeploy run demo
 
 help: ## Display this help.
@@ -59,6 +60,12 @@ sim-bench-crash: ## Crash recovery at the 50k×10k headline shape (minutes).
 
 sim-bench-500k: ## The 10×-scale sharded headline: 500k×100k (slow, ~10 min).
 	python -m slurm_bridge_tpu.sim full_500kx100k
+
+sim-bench-steady: ## Steady-state headline: 50k×10k, steady ticks gated ≤50 ms.
+	python -m slurm_bridge_tpu.sim full_50kx10k_steady
+
+sim-bench-steady-500k: ## Steady-state 10×-scale: 500k×100k, gated ≤1 s (slow).
+	python -m slurm_bridge_tpu.sim full_500kx100k_steady
 
 wal-fsync-bench: ## WAL overhead at 0/1/5 ms simulated fsync latency (record, not gate).
 	python -m benchmarks.ticksmoke --wal-fsync

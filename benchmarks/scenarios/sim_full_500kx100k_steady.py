@@ -1,0 +1,21 @@
+"""Sim scenario: the 10×-scale STEADY-STATE headline — 500k pods ×
+100k nodes, sharded, plus three post-convergence ticks (ISSUE 11,
+slow, ~10+ min). Records ``steady_tick_p50_ms`` gated ≤1,000 ms: the
+"heavy traffic from millions of users" bar, where arrivals are a
+trickle against the standing state.
+
+    python -m benchmarks.scenarios.sim_full_500kx100k_steady
+
+Canonical definition:
+``slurm_bridge_tpu.sim.scenarios.full_500kx100k_steady``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import full_500kx100k_steady as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "full_500kx100k_steady"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

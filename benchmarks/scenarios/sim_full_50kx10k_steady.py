@@ -1,0 +1,19 @@
+"""Sim scenario: the STEADY-STATE headline at 50k pods × 10k nodes
+(ISSUE 11, slow) — the ``full_50kx10k`` shape plus three
+post-convergence ticks, recording ``steady_tick_p50_ms`` gated ≤50 ms.
+
+    python -m benchmarks.scenarios.sim_full_50kx10k_steady
+
+Canonical definition:
+``slurm_bridge_tpu.sim.scenarios.full_50kx10k_steady``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import full_50kx10k_steady as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "full_50kx10k_steady"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

@@ -1,0 +1,19 @@
+"""Sim scenario: standing load + permanent unschedulable backlog whose
+ticks 2+ are genuinely steady — the shape ``steady_tick_p50_ms`` and
+the bench-smoke zero-work gate (0 store commits, 0 solver invocations,
+≤1 status RPC per shard) measure (ISSUE 11).
+
+    python -m benchmarks.scenarios.sim_steady_state_soak
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.steady_state_soak``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import steady_state_soak as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "steady_state_soak"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

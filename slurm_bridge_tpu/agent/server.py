@@ -229,6 +229,33 @@ class WorkloadServicer:
         self.ledger = SubmitLedger(ledger_file, journal=self.journal)
         self.uid = str(uuid.uuid4())
         self.tail_poll_interval = tail_poll_interval
+        # ---- incremental-sync cursors (PR-11) ----
+        # The real agent must exec Slurm CLIs to know current state either
+        # way; what the cursor saves is the RESPONSE — an unchanged job is
+        # omitted, an unchanged inventory answers `unchanged=true` — so
+        # the caller's decode/diff work is O(changes). Versions start at a
+        # NANOSECOND wall-clock stamp so a restarted agent's version base
+        # sits above any version a caller could hold from the previous
+        # incarnation: the base grows ~1e9/s while bumps add +1 per
+        # changed job, so even pathological churn cannot outrun the clock
+        # between restarts — a caller's stale cursor is always below the
+        # fresh base and the first post-restart response re-delivers
+        # everything (full resync, never a lost update).
+        self._sync_lock = threading.Lock()
+        self._jobs_version = time.time_ns()
+        self._job_sigs: dict[int, tuple] = {}
+        self._job_versions: dict[int, int] = {}
+        #: per requested-name-set: (content signature, version)
+        self._nodes_sync: dict[tuple, tuple[bytes, int]] = {}
+        #: cursor-state bounds: a long-lived agent serving a job-cycling
+        #: bridge must not accumulate signature entries forever. When the
+        #: job maps outgrow the bound, the oldest-changed half is dropped
+        #: (versions are monotonic ⇒ sort-by-version IS change order); a
+        #: dropped id simply re-signs (and re-delivers once) on its next
+        #: appearance. Name-set slots each pin an O(nodes) signature, so
+        #: they get a small hard cap with clear-all overflow.
+        self._JOB_SIG_LIMIT = 500_000
+        self._NODES_SYNC_LIMIT = 32
 
     @staticmethod
     def _job_doc(req: pb.SubmitJobRequest, job_id: int) -> dict:
@@ -359,11 +386,70 @@ class WorkloadServicer:
 
         ids = [int(j) for j in request.job_ids]
         if len(ids) <= 1:
-            return pb.JobsInfoResponse(jobs=[one(i) for i in ids])
-        from concurrent.futures import ThreadPoolExecutor
+            entries = [one(i) for i in ids]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=min(8, len(ids))) as pool:
-            return pb.JobsInfoResponse(jobs=list(pool.map(one, ids)))
+            with ThreadPoolExecutor(max_workers=min(8, len(ids))) as pool:
+                entries = list(pool.map(one, ids))
+        return self._jobs_cursor_filter(entries, request.since_version)
+
+    @staticmethod
+    def _entry_sig(entry: pb.JobsInfoEntry) -> tuple:
+        """The mirror-visible signature of one job's entry: everything
+        Slurm can change on a live job EXCEPT the always-ticking
+        ``run_time_s`` (the mirror's own "not a change" rule)."""
+        return tuple(
+            (m.status, m.node_list, m.batch_host, m.reason, m.exit_code,
+             m.start_time)
+            for m in entry.info
+        )
+
+    def _jobs_cursor_filter(
+        self, entries: list, since: int
+    ) -> pb.JobsInfoResponse:
+        """The JobsInfo cursor (PR-11): track each job's signature across
+        calls, stamp a monotonically-growing version on every change, and
+        — when the caller carries a cursor — omit entries that have not
+        moved since it. found=false entries always ride along (an unknown
+        id has no version). since=0 callers get the full pre-PR-11
+        response, with the version field offering the cursor for next
+        time."""
+        with self._sync_lock:
+            for entry in entries:
+                if not entry.found:
+                    continue
+                jid = int(entry.job_id)
+                sig = self._entry_sig(entry)
+                if self._job_sigs.get(jid) != sig:
+                    self._job_sigs[jid] = sig
+                    self._jobs_version += 1
+                    self._job_versions[jid] = self._jobs_version
+            if len(self._job_sigs) > self._JOB_SIG_LIMIT:
+                keep = sorted(
+                    self._job_versions,
+                    key=self._job_versions.__getitem__,
+                )[len(self._job_versions) // 2 :]
+                keep_set = set(keep)
+                self._job_sigs = {
+                    j: s for j, s in self._job_sigs.items() if j in keep_set
+                }
+                self._job_versions = {
+                    j: v
+                    for j, v in self._job_versions.items()
+                    if j in keep_set
+                }
+            ver = self._jobs_version
+            if since:
+                entries = [
+                    e
+                    for e in entries
+                    if not e.found
+                    or self._job_versions.get(int(e.job_id), ver) > since
+                ]
+        resp = pb.JobsInfoResponse(jobs=entries)
+        resp.version = ver
+        return resp
 
     def JobSteps(self, request: pb.JobStepsRequest, context) -> pb.JobStepsResponse:
         try:
@@ -475,7 +561,31 @@ class WorkloadServicer:
             nodes = self.driver.nodes(list(request.names))
         except SlurmError as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
-        return pb.NodesResponse(nodes=[node_to_proto(n) for n in nodes])
+        resp = pb.NodesResponse(nodes=[node_to_proto(n) for n in nodes])
+        # the Nodes cursor (PR-11): signature per requested NAME SET (two
+        # callers asking for different slices must not churn each other's
+        # version), version bumped on content change. The scontrol exec
+        # already happened — the cursor saves the wire + caller decode.
+        key = tuple(request.names)
+        sig = resp.SerializeToString(deterministic=True)
+        with self._sync_lock:
+            ent = self._nodes_sync.get(key)
+            if ent is None or ent[0] != sig:
+                # ns-stamped base for the same restart-monotonicity
+                # argument as the jobs cursor (content changes bump +1,
+                # the clock outruns them between restarts)
+                ver = (ent[1] if ent else time.time_ns()) + 1
+                if ent is None and len(self._nodes_sync) >= self._NODES_SYNC_LIMIT:
+                    # each slot pins an O(nodes) signature: cap hard,
+                    # clear-all on overflow (callers just resync once)
+                    self._nodes_sync.clear()
+                self._nodes_sync[key] = (sig, ver)
+            else:
+                ver = ent[1]
+        if request.since_version and request.since_version == ver:
+            return pb.NodesResponse(version=ver, unchanged=True)
+        resp.version = ver
+        return resp
 
     def WorkloadInfo(self, request: pb.WorkloadInfoRequest, context) -> pb.WorkloadInfoResponse:
         try:

@@ -36,6 +36,8 @@ class Configurator:
         node_sync_interval: float = 1.0,
         pod_sync_workers: int = 10,
         provider_inventory_ttl: float | None = None,
+        provider_status_interval: float | None = None,
+        incremental: bool = False,
     ):
         self.store = store
         self.client = client
@@ -49,6 +51,12 @@ class Configurator:
         #: forwarded to each provider; ``None`` keeps the provider default
         #: (the sim sets 0 so no wall-clock cache window leaks in)
         self.provider_inventory_ttl = provider_inventory_ttl
+        #: forwarded heartbeat interval; ``None`` keeps the provider
+        #: default (the sim passes inf so steady ticks stay write-free
+        #: regardless of how slow the box runs the tick)
+        self.provider_status_interval = provider_status_interval
+        #: event-driven incremental mirror (PR-11), forwarded per provider
+        self.incremental = incremental
         self.providers: dict[str, VirtualNodeProvider] = {}
         self._tickers: dict[str, Ticker] = {}
         self._watch = Ticker(watch_interval, self.reconcile, name="configurator")
@@ -127,6 +135,8 @@ class Configurator:
         kwargs = {}
         if self.provider_inventory_ttl is not None:
             kwargs["inventory_ttl"] = self.provider_inventory_ttl
+        if self.provider_status_interval is not None:
+            kwargs["status_interval"] = self.provider_status_interval
         provider = VirtualNodeProvider(
             self.store,
             self.client,
@@ -134,6 +144,7 @@ class Configurator:
             agent_endpoint=self.agent_endpoint,
             events=self.events,
             sync_workers=self.pod_sync_workers,
+            incremental=self.incremental,
             **kwargs,
         )
         provider.register()

@@ -58,6 +58,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--policy-max-preemptions", type=int, default=64,
                         help="churn bound: incumbents displaceable per "
                              "scheduler tick (with --policy)")
+    parser.add_argument("--no-incremental", action="store_true",
+                        help="disable the event-driven incremental tick "
+                             "(PR-11): cursor-scoped mirror sync, "
+                             "dirty-set pending scan and warm-start "
+                             "solve reuse — on by default, this flag "
+                             "restores the full O(cluster) tick")
     parser.add_argument("--threads", type=int, default=2,
                         help="operator reconciler workers (--slurm-bridge-operator-threads)")
     parser.add_argument("--configurator-interval", type=float, default=30.0)
@@ -139,6 +145,7 @@ def main(argv: list[str] | None = None) -> int:
         preemption=args.preemption,
         policy=policy,
         shard=shard,
+        incremental=not args.no_incremental,
         state_file=args.state_file,
         configurator_interval=args.configurator_interval,
         operator_workers=args.threads,

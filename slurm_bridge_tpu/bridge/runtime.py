@@ -66,6 +66,7 @@ class Bridge:
         state_file: str = "",
         policy=None,
         shard=None,
+        incremental: bool = True,
     ):
         self.agent_endpoint = agent_endpoint
         self.store = ObjectStore()
@@ -110,6 +111,7 @@ class Bridge:
             watch_interval=configurator_interval,
             node_sync_interval=node_sync_interval,
             pod_sync_workers=pod_sync_workers,
+            incremental=incremental,
         )
         self.scheduler = PlacementScheduler(
             self.store,
@@ -122,6 +124,7 @@ class Bridge:
             sharded=sharded,
             policy=policy,
             shard=shard,
+            incremental=incremental,
         )
         self._sched_ticker = Ticker(
             scheduler_interval, self.scheduler.tick, name="scheduler"

@@ -202,6 +202,12 @@ class ObjectStore:
         #: and the tombstone side: kind -> name -> rv at delete
         self._changed: dict[str, dict[str, int]] = {}
         self._tombstones: dict[str, dict[str, int]] = {}
+        #: per-kind high-water mark: the global rv of the kind's LAST
+        #: change or delete. ``changes_since`` answers "nothing moved"
+        #: in O(1) off this — the incremental tick (PR-11) probes the
+        #: Pod dirty-set several times per tick, and enumerating a 50k-
+        #: name dict per probe was most of a steady tick's residual cost
+        self._kind_rv: dict[str, int] = {}
         self._rv = 0
         #: SimpleQueue, not Queue: put() is C-implemented and lock-free
         #: on the GIL — _notify runs under the store lock for EVERY
@@ -279,6 +285,7 @@ class ObjectStore:
 
     def _record_change(self, kind: str, name: str) -> None:
         self._changed.setdefault(kind, {})[name] = self._rv
+        self._kind_rv[kind] = self._rv
         tombs = self._tombstones.get(kind)
         if tombs is not None:
             tombs.pop(name, None)
@@ -328,6 +335,7 @@ class ObjectStore:
 
     def _record_delete(self, kind: str, name: str) -> None:
         self._changed.get(kind, {}).pop(name, None)
+        self._kind_rv[kind] = self._rv
         tombs = self._tombstones.setdefault(kind, {})
         tombs[name] = self._rv
         # compact with 25% slack so the sort amortizes over many deletes
@@ -665,6 +673,10 @@ class ObjectStore:
         """
         with self._lock:
             rv = self._rv
+            if self._kind_rv.get(kind, 0) <= since_rv:
+                # O(1) idle probe: the kind's last change/delete is at or
+                # before the caller's cursor — nothing to enumerate
+                return rv, [], []
             changed = sorted(
                 n
                 for n, r in self._changed.get(kind, {}).items()
@@ -774,6 +786,7 @@ class ObjectStore:
                 else [names[p] for p in sel.tolist()]
             )
             changed.update(zip(names_sel, new_rvs.tolist()))
+            self._kind_rv[kind] = self._rv
             if tombs:
                 for name in names_sel:
                     tombs.pop(name, None)
@@ -832,6 +845,7 @@ class ObjectStore:
             for name, row in zip(names_sel, row_list):
                 self._index_add_node(kind, name, adapter.node_value(table, row))
             changed.update(zip(names_sel, new_rvs.tolist()))
+            self._kind_rv[kind] = self._rv
             if tombs:
                 for name in names_sel:
                     tombs.pop(name, None)

@@ -60,6 +60,7 @@ from slurm_bridge_tpu.obs.tracing import TRACER, with_current_span
 from slurm_bridge_tpu.wire import ServiceClient, pb
 from slurm_bridge_tpu.wire.convert import (
     NodesDecodeCache,
+    PartitionDecodeCache,
     demand_to_submit,
     fill_submit_request,
     job_info_from_proto,
@@ -153,6 +154,32 @@ class _RefreshBatch(NamedTuple):
     istart: np.ndarray
     ilen: np.ndarray
 
+
+class _MirrorCache(NamedTuple):
+    """The incremental mirror's cross-tick working set (PR-11): the last
+    classification's refresh batch plus everything derived from it — the
+    unique job-id list, the PRE-BUILT chunked ``JobsInfoRequest`` protos
+    (``since_version`` is restamped per tick), and the job-id → batch
+    index map that routes an agent-reported change back to its pod.
+    Valid exactly while the store's Pod dirty-set stays empty; any pod
+    write (ours included) invalidates it and the next sync reclassifies.
+    """
+
+    rb: _RefreshBatch
+    ids: list
+    reqs: list
+    idx_of_jid: dict
+    #: per-request flag: True = the chunk holds at least one job id this
+    #: provider has NEVER applied a response for, so it must query at
+    #: since_version=0. The trap it closes: a job submitted THIS tick
+    #: carries a version the same tick's status pass already advanced the
+    #: cursor past (the response version is global), and a cursor-scoped
+    #: query would omit it until its NEXT transition — a RUNNING pod
+    #: stuck visibly Pending. New ids sit in tail chunks (the id list is
+    #: ordered applied-first), so one arrival re-queries one chunk, not
+    #: the cluster.
+    full_chunk: list
+
 #: gRPC codes meaning "the agent is unreachable / busy", not "the request
 #: is bad" — submissions stay Pending and retry on the next sync instead
 #: of failing the pod (the reference fails it either way, provider.go:54).
@@ -241,6 +268,7 @@ class VirtualNodeProvider:
         inventory_ttl: float = 5.0,
         sync_workers: int = 10,
         status_interval: float = 10.0,
+        incremental: bool = False,
     ):
         self.store = store
         self.client = client
@@ -284,6 +312,35 @@ class VirtualNodeProvider:
         #: tick's Nodes response is byte-identical to the last one, so
         #: the per-partition proto decode is skipped
         self._nodes_decode = NodesDecodeCache()
+        #: event-driven incremental mirror (PR-11). Off (the default) is
+        #: the PR-10 tick byte-for-byte. On, the provider keeps cursors
+        #: against BOTH change sources — the store's Pod dirty-set (pod
+        #: classification) and the agent's jobs/nodes state versions
+        #: (status + inventory) — so a sync tick in which nothing moved
+        #: costs the same RPC COUNT as the full tick (fault-injection
+        #: parity: each call is one injection draw) but O(changes)
+        #: response bytes, decode, diff and store work. Requires the
+        #: columnar store + bulk RPCs; anything on a fallback path runs
+        #: the full mirror unchanged.
+        self.incremental = incremental
+        self._part_decode = PartitionDecodeCache()
+        #: store-side cursor: Pod rv watermark of the last classification
+        self._scan_rv = 0
+        self._mirror_cache: _MirrorCache | None = None
+        #: agent-side cursors: jobs-state / nodes-state versions last
+        #: fully applied (0 = no cursor yet → full responses)
+        self._jobs_cursor = 0
+        #: job ids a status response has actually been APPLIED for — the
+        #: cursor is only trusted for these; anything else queries full
+        self._applied_ids: set[int] = set()
+        self._nodes_cursor = 0
+        self._nodes_cache: list[NodeInfo] | None = None
+        self._nodes_req: object | None = None
+        self._nodes_req_names: tuple | None = None
+        #: serializes the cursor fetch (shared request proto + RPC)
+        self._nodes_fetch_lock = threading.Lock()
+        #: (nodes list ref) → summed capacity memo for register()
+        self._cap_memo: tuple | None = None
 
     # ---- inventory / capacity ----
 
@@ -295,20 +352,81 @@ class VirtualNodeProvider:
         with self._inv_lock:
             if self._inv is not None and time.monotonic() - self._inv[0] < ttl:
                 return self._inv[1], self._inv[2]
-        part = partition_from_proto(
-            self.client.Partition(pb.PartitionRequest(partition=self.partition))
+        part_resp = self.client.Partition(
+            pb.PartitionRequest(partition=self.partition)
         )
-        nodes = self._nodes_decode.decode(
-            self.client.Nodes(pb.NodesRequest(names=list(part.nodes)))
-        )
+        if self.incremental:
+            part = self._part_decode.decode(part_resp)
+            nodes = self._nodes_incremental(part)
+            if nodes is None:
+                # degenerate serve-once empty view (see
+                # _nodes_incremental): must NOT enter the TTL cache —
+                # callers within the window would get zero capacity
+                # without even the retry RPC that heals it
+                return part, []
+        else:
+            part = partition_from_proto(part_resp)
+            nodes = self._nodes_decode.decode(
+                self.client.Nodes(pb.NodesRequest(names=list(part.nodes)))
+            )
         with self._inv_lock:
             self._inv = (time.monotonic(), part, nodes)
         return part, nodes
+
+    def _nodes_incremental(self, part: PartitionInfo) -> list[NodeInfo] | None:
+        """The cursor-bearing Nodes fetch (PR-11): one RPC either way —
+        same injection-draw count as the full path — but when the agent's
+        nodes-state version matches the cursor the response carries zero
+        rows and the previously-decoded list (identity-stable, so every
+        downstream memo holds) is replayed.
+
+        Held under ``_nodes_fetch_lock`` for the whole stamp+RPC: the
+        cached request proto is shared across ticks, and a concurrent
+        ``inventory()`` caller restamping ``since_version`` while gRPC
+        serializes it would race (the full path builds a fresh request
+        per call and has no such hazard). Fetches serialize; the TTL
+        window keeps that off the common path."""
+        with self._nodes_fetch_lock:
+            if self._nodes_req is None or self._nodes_req_names != part.nodes:
+                # first fetch or membership change: a cursor is only
+                # valid against the exact name set its response answered
+                self._nodes_req = pb.NodesRequest(names=list(part.nodes))
+                self._nodes_req_names = part.nodes
+                self._nodes_cursor = 0
+                self._nodes_cache = None
+            req = self._nodes_req
+            req.since_version = (
+                self._nodes_cursor if self._nodes_cache is not None else 0
+            )
+            resp = self.client.Nodes(req)
+            if resp.unchanged:
+                if self._nodes_cache is not None:
+                    return self._nodes_cache
+                # degenerate: an "unchanged" answer with no local cache
+                # (a frozen stale_snapshot window replaying across a
+                # provider rebuild). Adopting the empty row set as the
+                # inventory — and worse, CACHING it against the frozen
+                # version — would zero this partition's capacity for
+                # good. None = serve an empty view once, cache nothing
+                # (cursor, decode cache AND the caller's TTL slot),
+                # advance nothing: the next fetch retries at since=0 and
+                # heals the moment a real response arrives.
+                return None
+            nodes = self._nodes_decode.decode(resp)
+            self._nodes_cache = nodes
+            self._nodes_cursor = int(resp.version)
+            return nodes
 
     def capacity(self) -> tuple[dict[str, float], dict[str, float]]:
         """(capacity, allocatable) summed over member nodes
         (GetPartitionCapacity node.go:169-199)."""
         _, nodes = self.inventory()
+        if self.incremental:
+            memo = self._cap_memo
+            if memo is not None and memo[0] is nodes:
+                # identity-stable node list (the cursor hit): the summed
+                # capacity is definitionally unchanged
+                return memo[1], memo[2]
         cap = {"cpu": 0.0, "memory_mb": 0.0, "gpu": 0.0, "pods": 0.0}
         free = {"cpu": 0.0, "memory_mb": 0.0, "gpu": 0.0, "pods": 0.0}
         for n in nodes:
@@ -321,6 +439,8 @@ class VirtualNodeProvider:
         # reference: pods capacity = cpu count (node.go:197)
         cap["pods"] = cap["cpu"]
         free["pods"] = free["cpu"]
+        if self.incremental:
+            self._cap_memo = (nodes, cap, free)
         return cap, free
 
     def pod_stats(self) -> list[tuple[Pod, dict]]:
@@ -477,7 +597,30 @@ class VirtualNodeProvider:
         """One provider tick on columns: vectorized classification, the
         batched submit fed straight from spec columns, and the status
         mirror as one vectorized column compare (45k Python object diffs
-        become one ``!=`` reduction per field)."""
+        become one ``!=`` reduction per field).
+
+        Incremental mode (PR-11) consults the store's Pod dirty-set
+        first: when no pod has been written since the last
+        classification, the whole rows_by_node scan + mask
+        classification is skipped and the cached working set drives a
+        cursor-bearing status pass — an idle shard's mirror is a probe
+        plus one cheap RPC per id-chunk and zero decode/diff work."""
+        if self.incremental:
+            rv, changed, deleted = self.store.changes_since(
+                Pod.KIND, self._scan_rv
+            )
+            mc = self._mirror_cache
+            if not changed and not deleted and mc is not None:
+                span.count("converge_pods", 0)
+                span.count("refresh_pods", len(mc.rb.names))
+                t1 = time.perf_counter()
+                self._refresh_statuses_cols_incr(table, mc)
+                t2 = time.perf_counter()
+                _status_seconds.observe(t2 - t1)
+                _sync_seconds.observe(t2 - t0)
+                return
+            self._scan_rv = rv
+            self._mirror_cache = None
         c = table.cols
         with self.store.locked():
             # names→rows resolved under the SAME lock hold as the column
@@ -541,10 +684,56 @@ class VirtualNodeProvider:
             ]
             self._pool_map(self._submit_chunk_cols_safe, chunks)
         t1 = time.perf_counter()
-        self._refresh_statuses_cols(table, refresh)
+        if self.incremental:
+            mc = self._build_mirror_cache(refresh)
+            # the cache survives to the next tick ONLY when this sync had
+            # no per-pod converge work: a submit that failed TRANSIENTLY
+            # (agent unavailable) leaves no store trace, and a cached
+            # steady skip would silently drop the level-triggered retry
+            # the full mirror repeats every sync. A successful converge
+            # wrote job ids anyway, so the next tick reclassifies either
+            # way — one extra O(pods-on-node) pass per converge tick.
+            self._mirror_cache = (
+                mc if not items and not work_names else None
+            )
+            self._refresh_statuses_cols_incr(table, mc)
+        else:
+            self._refresh_statuses_cols(table, refresh)
         t2 = time.perf_counter()
         _status_seconds.observe(t2 - t1)
         _sync_seconds.observe(t2 - t0)
+
+    def _build_mirror_cache(self, rb: _RefreshBatch) -> _MirrorCache:
+        """Derive the cursor sync's cross-tick state from one
+        classification: unique job ids — already-applied ids first, ids
+        this provider has never applied a response for appended last —
+        pre-built chunk requests (chunk COUNT equals the full path's for
+        the same working set, which is what keeps fault-injection draw
+        sequences identical between modes), and the jid → batch-index
+        route."""
+        applied = self._applied_ids
+        old_ids: list[int] = []
+        new_ids: list[int] = []
+        seen: set[int] = set()
+        idx_of: dict[int, tuple] = {}
+        for i, jt in enumerate(rb.job_ids):
+            for jid in jt:
+                if jid not in seen:
+                    seen.add(jid)
+                    (old_ids if jid in applied else new_ids).append(jid)
+                prev = idx_of.get(jid)
+                idx_of[jid] = (i,) if prev is None else prev + (i,)
+        ids = old_ids + new_ids
+        reqs = [
+            pb.JobsInfoRequest(job_ids=ids[lo : lo + _BULK_CHUNK])
+            for lo in range(0, len(ids), _BULK_CHUNK)
+        ]
+        n_old = len(old_ids)
+        full_chunk = [
+            lo + _BULK_CHUNK > n_old and lo < len(ids)
+            for lo in range(0, len(ids), _BULK_CHUNK)
+        ]
+        return _MirrorCache(rb, ids, reqs, idx_of, full_chunk)
 
     def _fail_pod_name(self, name: str, reason: str) -> None:
         def record(p: Pod):
@@ -847,6 +1036,183 @@ class VirtualNodeProvider:
                         infos.append(_unknown_info(jid))
                     else:
                         infos.extend(scratch.info_object(k) for k in ks)
+                self._record_status(pod, queried, infos)
+
+    def _refresh_statuses_cols_incr(self, table, mc: _MirrorCache) -> None:
+        """The cursor-scoped status mirror (PR-11): the same chunked
+        JobsInfo round-trips as the full pass (call-count parity — each
+        call is one fault-injection draw), but already-applied chunks
+        carry ``since_version`` so an idle tick's responses are empty and
+        the diff/write machinery runs over RETURNED jobs only. Writes are
+        the full path's writes exactly: the agent's contract is that an
+        omitted job has not changed since the cursor, so the full diff
+        would have found nothing for it."""
+        if not mc.rb.names:
+            return
+        with TRACER.span("vnode.status") as span:
+            span.count("pods", len(mc.rb.names))
+            self._refresh_statuses_incr_traced(table, mc, span)
+
+    def _refresh_statuses_incr_traced(self, table, mc: _MirrorCache, span) -> None:
+        rb = mc.rb
+        cursor = self._jobs_cursor
+        scratch = InfoScratch()
+        versions: list[int] = []
+        for req, full in zip(mc.reqs, mc.full_chunk):
+            req.since_version = 0 if full else cursor
+            try:
+                resp = self.client.JobsInfo(req)
+            except grpc.RpcError as e:
+                if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    self._bulk_supported = False
+                    _bulk_fallbacks.inc()
+                    log.warning(
+                        "agent does not implement JobsInfo; "
+                        "falling back to per-pod status queries"
+                    )
+                    self._converge_names(rb.names)
+                    return
+                # transient: apply NOTHING and keep the cursor — the next
+                # successful pass re-delivers everything missed (exactly
+                # the full path's keep-current-statuses posture)
+                log.warning("bulk status query failed: %s", e.details())
+                return
+            _bulk_queries.inc()
+            versions.append(int(resp.version))
+            for entry in resp.jobs:
+                jid = int(entry.job_id)
+                if not entry.found or not len(entry.info):
+                    scratch.add_unknown(jid)
+                    continue
+                for m in entry.info:
+                    scratch.add_proto(jid, m)
+        span.count("jobs_queried", len(mc.ids))
+        span.count("rows_decoded", len(scratch.jid))
+        new_cursor = min(versions) if versions else 0
+        if scratch.jid:
+            self._apply_status_changes(table, mc, scratch, span)
+        else:
+            span.count("writes", 0)
+        self._jobs_cursor = new_cursor
+        self._applied_ids = set(mc.ids)
+        # every id in the working set is now applied: later passes over
+        # the SAME cache must query every chunk at the cursor — leaving a
+        # tail chunk flagged "full" would re-deliver its ~2000 unchanged
+        # entries every steady tick (decode cost for nothing)
+        for k in range(len(mc.full_chunk)):
+            mc.full_chunk[k] = False
+
+    def _apply_status_changes(self, table, mc: _MirrorCache, scratch, span) -> None:
+        """Diff + write for the pods owning a RETURNED job — the full
+        path's locked vectorized compare and row-write, restricted to
+        candidates (everything else is unchanged by the cursor contract).
+        """
+        rb = mc.rb
+        arr = scratch.finalize()
+        row_of_jid = scratch.row_of_jid
+        cand: list[int] = []
+        seen: set[int] = set()
+        for jid in row_of_jid:
+            for i in mc.idx_of_jid.get(jid, ()):
+                if i not in seen:
+                    seen.add(i)
+                    cand.append(i)
+        cand.sort()
+        cand_arr = np.asarray(cand, np.int64)
+        names_cand = [rb.names[i] for i in cand]
+        rv_cand = rb.rv[cand_arr]
+        n = len(cand)
+        sidx = np.full(n, -1, np.int64)
+        fallback: list[int] = []  # rb indices
+        for k, i in enumerate(cand):
+            jt = rb.job_ids[i]
+            if len(jt) == 1 and rb.ilen[i] <= 1:
+                s = row_of_jid.get(jt[0], -1)
+                if s >= 0:
+                    sidx[k] = s
+                    continue
+            fallback.append(i)
+        fi = np.nonzero(sidx >= 0)[0]
+        h = table.adapter.infos
+        c = table.cols
+        ci = np.empty(0, np.int64)
+        if fi.size:
+            with self.store.locked():
+                rws = table.rows_for([names_cand[int(k)] for k in fi])
+                ok = rws >= 0
+                cur_rv = c.rv[np.where(ok, rws, 0)]
+                ok &= cur_rv == rv_cand[fi]
+                ilen = c.ilen[np.where(ok, rws, 0)]
+                ok &= ilen <= 1
+                stale = fi[~ok]
+                fi = fi[ok]
+                s = sidx[fi]
+                rws = rws[ok]
+                prev = c.ilen[rws] == 1
+                g = np.where(prev, c.istart[rws], 0)
+                diff = ~prev  # no stored info row yet ⇒ changed
+                for hcol, acol in _SIGNAL_DIFF_COLS:
+                    diff = diff | (getattr(h, hcol)[g] != arr[acol][s])
+                phase_stored = c.phase[rws]
+            fallback.extend(cand_arr[stale].tolist())
+            if fi.size:
+                phase_new = PHASE_OF_SINGLE_STATE[arr["state"][s]]
+                diff = diff | (phase_new != phase_stored)
+                _vector_diff_rows.inc(int(fi.size))
+                ci = fi[diff]
+        span.count("writes", int(ci.size))
+        if ci.size:
+            s_changed = sidx[ci]
+            phase_w = PHASE_OF_SINGLE_STATE[arr["state"][s_changed]]
+            names_c = [names_cand[int(k)] for k in ci]
+            expected = rv_cand[ci]
+            full = scratch.full_cols(s_changed)
+
+            def writer(rws, sel):
+                nc = int(rws.size)
+                start = h.alloc(nc)
+                tgt = np.arange(start, start + nc, dtype=np.int64)
+                for hcol, acol in _WRITE_COLS:
+                    getattr(h, hcol)[tgt] = full[acol][sel]
+                h.submit[tgt] = LAZY_DT
+                h.start[tgt] = LAZY_DT
+                h.retire(int(c.ilen[rws].sum()))
+                c.istart[rws] = tgt
+                c.ilen[rws] = 1
+                c.phase[rws] = phase_w[sel]
+                table.adapter._maybe_compact_infos(table)
+
+            results = self.store.update_rows(
+                Pod.KIND, names_c, expected, writer, site="vnode.status"
+            )
+            for k, rc in zip(ci.tolist(), results.tolist()):
+                if rc <= 0:
+                    fallback.append(int(cand_arr[k]))
+        if fallback:
+            _diff_fallback_rows.inc(len(fallback))
+            rows_by_jid: dict[int, list[int]] = {}
+            for k2, jid in enumerate(scratch.jid):
+                rows_by_jid.setdefault(jid, []).append(k2)
+            for i in sorted(set(fallback)):
+                pod = self.store.try_get(Pod.KIND, rb.names[i])
+                if pod is None:
+                    continue
+                queried = tuple(rb.job_ids[i])
+                stored_by_id: dict[int, list] = {}
+                for info in pod.status.job_infos:
+                    stored_by_id.setdefault(info.id, []).append(info)
+                infos: list[JobInfo] = []
+                for jid in queried:
+                    ks = rows_by_jid.get(jid)
+                    if ks:
+                        infos.extend(scratch.info_object(k2) for k2 in ks)
+                    elif jid in stored_by_id:
+                        # omitted by the cursor ⇒ unchanged: the stored
+                        # rows ARE the agent's state (modulo the ticking
+                        # run_time counter, which the diff ignores)
+                        infos.extend(stored_by_id[jid])
+                    else:
+                        infos.append(_unknown_info(jid))
                 self._record_status(pod, queried, infos)
 
     def _converge_names(self, names: list[str]) -> None:

@@ -247,21 +247,37 @@ class FlightRecorder:
         tree by path, aggregate top self-time, summed commit breakdown."""
         if not self.records:
             return {}
-        # per-path p50 over ticks
-        paths: dict[str, list[float]] = {}
+        # Per-path p50 over ticks. A path ABSENT from a record counts as
+        # 0.0 ms in that record — the span genuinely cost nothing that
+        # tick. Medianing only over records where the path appeared gave
+        # each path its own support: a child that exists only in the one
+        # cold tick (sim.arrive/operator.reconcile — 50k reconciles at
+        # tick 0, none after) medianed to its cold-tick cost while its
+        # every-tick parent medianed to ~0, printing a tree where a
+        # child "takes" 5,884 ms inside a 0.025 ms parent (ISSUE 11).
+        # With one shared support per record, a sequential child's p50
+        # can never exceed its parent's (parallel fan-outs can still sum
+        # children past the parent's wall time — that is real
+        # concurrency, not an aggregation artifact).
+        per_rec: list[dict[str, float]] = []
 
-        def walk(name: str, node: dict, prefix: str):
+        def walk(name: str, node: dict, prefix: str, acc: dict):
             path = f"{prefix}/{name}" if prefix else name
-            paths.setdefault(path, []).append(node["ms"])
+            acc[path] = node["ms"]
             for child_name, child in node.get("children", {}).items():
-                walk(child_name, child, path)
+                walk(child_name, child, path, acc)
 
         for rec in self.records:
+            acc: dict[str, float] = {}
             for name, node in rec["tree"].items():
-                walk(name, node, "")
+                walk(name, node, "", acc)
+            per_rec.append(acc)
+        all_paths = sorted({p for acc in per_rec for p in acc})
         tree_p50 = {
-            path: round(float(np.median(ms)), 3)
-            for path, ms in sorted(paths.items())
+            path: round(
+                float(np.median([acc.get(path, 0.0) for acc in per_rec])), 3
+            )
+            for path in all_paths
         }
         commits: dict[str, int] = {}
         for rec in self.records:

@@ -205,18 +205,25 @@ class ShardExecutor:
         plan = self._ensure_plan(partitions, nodes)
         _shard_ticks.inc()
         self.ticks_total += 1
-        free = np.asarray(
-            [
-                (nd.free_cpus, nd.free_memory_mb, nd.free_gpus)
-                if nd.schedulable
-                else (0.0, 0.0, 0.0)
-                for nd in nodes
-            ],
-            np.float32,
-        )
-        routed = route_jobs(
-            plan, free, demands, all_pods, n_pending, priorities
-        )
+        # demand routing gets its own span (ISSUE 11 satellite): at the
+        # 500k shape the free-array build + rank-aware routing is most of
+        # the solve time the shard.encode/solve children did not explain
+        with TRACER.span("scheduler.shard.route") as route_span:
+            free = np.asarray(
+                [
+                    (nd.free_cpus, nd.free_memory_mb, nd.free_gpus)
+                    if nd.schedulable
+                    else (0.0, 0.0, 0.0)
+                    for nd in nodes
+                ],
+                np.float32,
+            )
+            routed = route_jobs(
+                plan, free, demands, all_pods, n_pending, priorities
+            )
+            route_span.count("jobs", len(all_pods))
+            route_span.count("shards", len(routed))
+            route_span.count("nodes", len(nodes))
         _shard_jobs.inc(len(all_pods))
         self.last_shards_used = len(routed)
         if demand_key is None:
@@ -473,6 +480,19 @@ class ShardExecutor:
         self, plan, free, work, results, demands, all_pods, n_pending,
         policy, nodes,
     ):
+        with TRACER.span("scheduler.shard.merge") as merge_span:
+            out = self._merge_traced(
+                plan, free, work, results, demands, all_pods, n_pending,
+                policy, nodes,
+            )
+            merge_span.count("jobs_placed", len(out[0]))
+            merge_span.count("lost", len(out[1]))
+            return out
+
+    def _merge_traced(
+        self, plan, free, work, results, demands, all_pods, n_pending,
+        policy, nodes,
+    ):
         by_job_names: dict[int, list[str]] = {}
         lost_jobs: list[int] = []
         residual = free.copy()
@@ -530,13 +550,19 @@ class ShardExecutor:
         self.last_reconcile_attempts = len(failed_gangs)
         self.last_reconcile_placed = 0
         if failed_gangs:
-            placed = reconcile_gangs(
-                failed_gangs,
-                residual,
-                self._global_features(plan, work, nodes),
-                plan.part_nodes,
-                limit=self.config.reconcile_limit,
-            )
+            # the cross-shard pass runs ONLY when some shard reported
+            # spill — zero failed gangs means zero reconcile cost (and no
+            # span: absence in the tree IS the attribution)
+            with TRACER.span("scheduler.shard.reconcile") as rec_span:
+                placed = reconcile_gangs(
+                    failed_gangs,
+                    residual,
+                    self._global_features(plan, work, nodes),
+                    plan.part_nodes,
+                    limit=self.config.reconcile_limit,
+                )
+                rec_span.count("attempts", len(failed_gangs))
+                rec_span.count("placed", len(placed))
             self.last_reconcile_placed = len(placed)
             for j, positions in placed:
                 by_job_names[j] = [names_of[p] for p in positions]

@@ -128,6 +128,12 @@ class SimJob:
     #: proportional to actual transitions, not queue length (a failed
     #: start re-checks every pending job every step). Pure memo.
     journal_sig: tuple | None = field(default=None, repr=False, compare=False)
+    #: jobs-state version at this job's last mutable-state change — the
+    #: per-job half of the ``JobsInfo`` cursor contract (PR-11): a
+    #: request carrying ``since_version >= version`` may omit this job.
+    version: int = field(default=0, repr=False, compare=False)
+    #: last signature the version counter saw. Pure memo.
+    sync_sig: tuple | None = field(default=None, repr=False, compare=False)
 
     def _run_time(self, now: float | None) -> int:
         # elapsed runtime like Slurm's RunTime: virtual now, capped at the
@@ -232,6 +238,17 @@ class SimCluster:
         self._ledger: dict[str, int] = {}
         self._next_id = 1000
         self._queue: list[int] = []  # PENDING job ids, submit order
+        #: jobs-state version (PR-11): bumped on every job mutable-state
+        #: transition (submit/start/complete/cancel/reason change) — the
+        #: ``JobsInfo`` cursor an incremental mirror hands back so an
+        #: idle tick's status query returns no rows. Starts at 1 so a
+        #: first response already carries a usable cursor (0 on the wire
+        #: means "no cursor support").
+        self.state_version = 1
+        #: nodes-state version: bumped on any allocation/drain change —
+        #: the ``Nodes`` cursor that turns an idle inventory fetch into
+        #: one tiny unchanged=true round-trip.
+        self.nodes_version = 1
         #: the agent job-state journal (PR-8): when attached, every
         #: ledger entry and job lifecycle transition is appended durably,
         #: and :meth:`crash_reload` rebuilds the whole agent-process
@@ -305,6 +322,19 @@ class SimCluster:
             job.start_vt, job.end_vt,
         )
 
+    def _touch(self, job: SimJob) -> None:
+        """Advance the jobs-state version iff this job's mirror-visible
+        state (state machine, assignment, reason — the ``pb_cache``
+        signature) actually moved. Called at every transition site, so
+        ``job.version`` is exactly the cursor the JobsInfo contract
+        promises: unchanged jobs sit at or below any applied cursor."""
+        sig = (job.state, job.assigned, job.reason)
+        if job.sync_sig == sig:
+            return
+        job.sync_sig = sig
+        self.state_version += 1
+        job.version = self.state_version
+
     def _journal_job(self, job: SimJob) -> None:
         if self.journal is None:
             return
@@ -355,6 +385,16 @@ class SimCluster:
             elif job.state == JobStatus.PENDING:
                 self._queue.append(job.id)  # ids are submit-ordered
         self._next_id = max(self.jobs, default=self._next_id - 1) + 1
+        # cursor hygiene: every caller-held cursor predates this reload's
+        # rebuilt state, so every job must read as "changed" — one shared
+        # bump past every outstanding cursor does it (versions only need
+        # to EXCEED cursors, not be distinct per job). Node state was
+        # rebuilt too, so the inventory cursor moves with it.
+        self.state_version += 1
+        for job in self.jobs.values():
+            job.version = self.state_version
+            job.sync_sig = (job.state, job.assigned, job.reason)
+        self.nodes_version += 1
         # rebase: fold the replayed state into a fresh snapshot under the
         # new incarnation (mirrors Bridge.start()'s compact-first)
         ledger, jobs = self.journal_state()
@@ -386,12 +426,14 @@ class SimCluster:
             node = self.nodes.get(n)
             if node is not None and not node.drained:
                 node.state = "DRAINED"
+                self.nodes_version += 1
 
     def resume(self, names: list[str]) -> None:
         for n in names:
             node = self.nodes.get(n)
             if node is not None and node.drained:
                 node.state = "IDLE"
+                self.nodes_version += 1
 
     def hide_partition(self, name: str) -> None:
         self.hidden.add(name)
@@ -436,6 +478,7 @@ class SimCluster:
         started = self._try_start(job)
         if not started:
             self._queue.append(job.id)
+        self._touch(job)
         if self.journal is not None:
             # ledger + post-placement job state behind ONE durability
             # barrier (the dedupe token is what a crashed agent must
@@ -453,6 +496,7 @@ class SimCluster:
         job.state = JobStatus.CANCELLED
         job.end_vt = self.clock()
         self.stats.cancelled += 1
+        self._touch(job)
         self._journal_job(job)
 
     def step(self) -> None:
@@ -464,6 +508,7 @@ class SimCluster:
                 self._free(job)
                 job.state = JobStatus.COMPLETED
                 self.stats.completed += 1
+                self._touch(job)
                 self._journal_job(job)
         still: list[int] = []
         for jid in self._queue:
@@ -477,6 +522,7 @@ class SimCluster:
             # crash replaying the stale reason would diverge from the
             # crash-free twin when agent_crash composes with
             # drain/vanish windows
+            self._touch(job)
             self._journal_job(job)
         self._queue = still
 
@@ -513,6 +559,7 @@ class SimCluster:
         if len(chosen) < job.num_nodes:
             job.reason = "Resources"
             return False
+        self.nodes_version += 1
         for name in chosen:
             node = self.nodes[name]
             node.job_cpus += job.cpus_per_node
@@ -535,6 +582,7 @@ class SimCluster:
         return True
 
     def _free(self, job: SimJob) -> None:
+        self.nodes_version += 1
         for name in job.assigned:
             node = self.nodes.get(name)
             if node is None:
@@ -580,10 +628,22 @@ class SimWorkloadClient:
 
     def __init__(self, cluster: SimCluster):
         self.cluster = cluster
+        #: RPC calls served, per method — the steady-state zero-work gate
+        #: reads this (one dict increment per call; the real agent's
+        #: Prometheus counters play this role in production)
+        self.calls: dict[str, int] = {}
+        #: partition responses are immutable at sim scope (membership and
+        #: node capacities never change), so each is built once and the
+        #: SAME proto object is replayed — identity-stable responses are
+        #: what lets caller-side decode memos run at O(1)
+        self._part_cache: dict[str, pb.PartitionResponse] = {}
         from slurm_bridge_tpu.obs.tracing import TRACER, current_span
+
+        calls = self.calls
 
         def traced(name, fn):
             def call(request, timeout=None):
+                calls[name] = calls.get(name, 0) + 1
                 parent = current_span()
                 if parent is None or not parent.sampled:
                     return fn(request, timeout=timeout)
@@ -612,11 +672,24 @@ class SimWorkloadClient:
             raise SimRpcError(
                 grpc.StatusCode.NOT_FOUND, f"partition {name!r} not found"
             )
-        return partition_to_proto(self.cluster.partition_info(name))
+        resp = self._part_cache.get(name)
+        if resp is None:
+            resp = partition_to_proto(self.cluster.partition_info(name))
+            self._part_cache[name] = resp
+        return resp
 
     def Nodes(self, request, timeout=None) -> pb.NodesResponse:
+        # the cursor short-circuit (PR-11): a caller whose last applied
+        # inventory is still exact gets `unchanged=true` and NO node rows
+        # — an idle mirror's fetch skips the O(nodes) proto build AND the
+        # caller's decode. since_version=0 (old caller) = full response.
+        ver = self.cluster.nodes_version
+        if request.since_version and request.since_version == ver:
+            return pb.NodesResponse(version=ver, unchanged=True)
         infos = self.cluster.node_infos(list(request.names))
-        return pb.NodesResponse(nodes=[node_to_proto(n) for n in infos])
+        resp = pb.NodesResponse(nodes=[node_to_proto(n) for n in infos])
+        resp.version = ver
+        return resp
 
     # ---- job RPCs ----
 
@@ -666,6 +739,15 @@ class SimWorkloadClient:
         now = self.cluster.clock()
         jobs = self.cluster.jobs
         resp = pb.JobsInfoResponse()
+        ver = self.cluster.state_version
+        resp.version = ver
+        since = request.since_version
+        if since and since >= ver:
+            # no job anywhere has changed since the caller's cursor: the
+            # whole chunk is unchanged — O(1), no id scan at all (unknown
+            # ids were already reported found=false when first seen, and
+            # an id can't become unknown without a state transition)
+            return resp
         add = resp.jobs.add
         append = resp.jobs.append
         for job_id in request.job_ids:
@@ -673,6 +755,8 @@ class SimWorkloadClient:
             if job is None:
                 add(job_id=job_id, found=False)
                 continue
+            if since and job.version <= since:
+                continue  # unchanged since the caller's cursor: omitted
             cache = job.pb_cache
             sig = (job.state, job.assigned, job.reason)
             if cache is None or cache[2] != sig:

@@ -61,6 +61,8 @@ def _headline(result) -> dict:
         "value": t["tick_p50_ms"],
         "unit": "ms",
         "p95_ms": t["tick_p95_ms"],
+        "steady_tick_p50_ms": t.get("steady_tick_p50_ms"),
+        "steady_ticks": t.get("steady_ticks"),
         "phases_p50_ms": t["phases_p50_ms"],
         # the per-phase split under its contract name, so BENCH json
         # consumers can track phase-level regressions (PR-3 satellite)
@@ -200,6 +202,31 @@ def _smoke(names: tuple[str, ...] = SMOKE_SCENARIOS, label: str = "sim-smoke") -
                     f"{name}: post-recovery {key} diverged from the "
                     "crash-free run at the same seed"
                 )
+        if a.scenario.incremental:
+            # the PR-11 acceptance gate: the event-driven incremental
+            # tick must be byte-identical IN OUTCOME to the full tick —
+            # same determinism digest (every bind/preempt/pending count,
+            # in order) and same final state — at the same seed, faults
+            # included. O(changes) may only change WHERE time goes.
+            off = run_scenario(
+                dataclasses.replace(a.scenario, incremental=False)
+            )
+            inc_same = (
+                off.determinism["digest"] == a.determinism["digest"]
+                and off.determinism["final_state_digest"]
+                == a.determinism["final_state_digest"]
+            )
+            print(json.dumps({
+                "scenario": f"{name}[full-tick twin]",
+                "incremental_identical": inc_same,
+                "steady_ticks": a.timing.get("steady_ticks"),
+                "steady_tick_p50_ms": a.timing.get("steady_tick_p50_ms"),
+            }))
+            if not inc_same:
+                failures.append(
+                    f"{name}: incremental tick diverged from the full "
+                    "tick at the same seed"
+                )
         if a.scenario.sharding is not None:
             # shard-specific gates: the plan must actually shard, and
             # the reconciliation scenario must actually reconcile —
@@ -270,6 +297,22 @@ def _quality(label: str = "quality-smoke") -> int:
         if a.determinism["invariant_violations"]:
             first = a.determinism["invariant_violations"][0]
             failures.append(f"{name}: invariant violated: {first}")
+        if a.scenario.incremental:
+            # PR-11: the incremental tick must not move a single quality
+            # number either — same digest, same final state, same
+            # scorecard as the full tick at the same seed
+            full = run(name, incremental=False)
+            inc_same = (
+                full.determinism["digest"] == a.determinism["digest"]
+                and full.determinism["final_state_digest"]
+                == a.determinism["final_state_digest"]
+                and full.quality == a.quality
+            )
+            if not inc_same:
+                failures.append(
+                    f"{name}: incremental tick diverged from the full "
+                    "tick (digest/state/scorecard) at the same seed"
+                )
         q = a.quality
         line = {
             "scenario": name,
@@ -463,6 +506,18 @@ def main(argv: list[str] | None = None) -> int:
                 f"{name}: tick_p50_ms {result.timing['tick_p50_ms']} over "
                 f"the {sc.p50_gate_ms} ms gate"
             )
+        if sc.steady_gate_ms is not None and sc.incremental:
+            steady = result.timing.get("steady_tick_p50_ms")
+            if steady is None:
+                gate_failures.append(
+                    f"{name}: steady_gate_ms set but the run never "
+                    "reached a steady tick"
+                )
+            elif steady > sc.steady_gate_ms:
+                gate_failures.append(
+                    f"{name}: steady_tick_p50_ms {steady} over the "
+                    f"{sc.steady_gate_ms} ms gate"
+                )
         if name == "full_50kx10k_crash":
             # the recovery-at-scale record BASELINE.md tracks
             print(json.dumps({

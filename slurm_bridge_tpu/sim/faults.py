@@ -417,7 +417,17 @@ class FaultyClient:
                 and self._plan.active("lost_status", self.tick)
             )
             if freeze:
-                key = (method, request.SerializeToString(deterministic=True))
+                if method == "Nodes":
+                    # key on the NAME SET, not the serialized bytes: the
+                    # incremental caller restamps `since_version` every
+                    # tick, and a bytes key would mint a fresh freeze
+                    # slot per tick — the window would serve live state
+                    # and the fault would silently stop testing staleness
+                    key = (method, tuple(request.names))
+                else:
+                    key = (
+                        method, request.SerializeToString(deterministic=True)
+                    )
                 if key not in self._stale:
                     self._stale[key] = inner_fn(request, timeout=timeout)
                 return self._stale[key]

@@ -158,6 +158,17 @@ class Scenario:
     #: CLI-enforced tick p50 ceiling (ms) for slow headline scenarios;
     #: None = record only
     p50_gate_ms: float | None = None
+    #: event-driven incremental tick (PR-11): cursor-scoped mirror sync,
+    #: dirty-set pending scan, warm-start solve reuse. On by default —
+    #: byte-identical determinism digest and final_state_digest to the
+    #: full tick is the acceptance bar (the smoke gates run an
+    #: incremental=False twin per scenario to prove it); False is the
+    #: PR-10 tick byte-for-byte (fixture-pinned)
+    incremental: bool = True
+    #: CLI-enforced STEADY-STATE tick p50 ceiling (ms): the median over
+    #: ticks in which nothing arrived, bound, preempted, faulted or
+    #: wrote — the O(changes) acceptance number; None = record only
+    steady_gate_ms: float | None = None
 
 
 @dataclass
@@ -308,6 +319,10 @@ class SimHarness:
             tick_interval_s=scenario.tick_interval_s,
         )
         base_client = SimWorkloadClient(self.cluster)
+        #: the unwrapped fake agent — the steady-state gate reads its
+        #: per-method call counter (calls that reach the agent, so
+        #: injected failures don't count — the gate runs fault-free)
+        self.agent_client = base_client
         #: the FaultyClient (tick advance + injection counters) — kept
         #: separate from ``self.client`` because a retry wrapper may
         #: stack on top of it
@@ -361,6 +376,11 @@ class SimHarness:
         self._bound_total = 0
         self._preempted_total = 0
         self._tick_phases: list[dict[str, float]] = []
+        #: per-tick steady-state accounting (PR-11): arrivals, binds,
+        #: commits, agent RPCs, solver invocations and the derived
+        #: ``steady`` verdict — what ``steady_tick_p50_ms`` and the
+        #: bench-smoke zero-work gate read
+        self._tick_meta: list[dict] = []
         self._arrive_ms: list[float] = []
         self._pending_by_tick: list[int] = []
         self._drained_at: int | None = None
@@ -466,6 +486,13 @@ class SimHarness:
             node_sync_interval=0.0,  # no tickers: the harness drives sync
             pod_sync_workers=1,  # serial converge: deterministic order
             provider_inventory_ttl=0.0,  # no wall-clock cache window
+            # heartbeat never forces a node write: the 10 s default is a
+            # WALL clock, so a slow box running a long tick would write
+            # VirtualNode heartbeats mid-run — nondeterministic commit
+            # counts and a false "not steady" verdict on idle ticks.
+            # Capacity changes still rewrite the node.
+            provider_status_interval=float("inf"),
+            incremental=scenario.incremental,
         )
         # fresh policy engine per stack incarnation: a crash loses the
         # in-memory fair-share accumulator exactly as production would
@@ -487,6 +514,7 @@ class SimHarness:
             # are in-memory tick state, rebuilt from scratch after a crash
             # exactly like the monolithic encode caches
             shard=scenario.sharding,
+            incremental=scenario.incremental,
         )
         self._pod_watch = self.store.watch((Pod.KIND,))
         self._node_watch = self.store.watch((VirtualNode.KIND,))
@@ -817,11 +845,22 @@ class SimHarness:
 
     def _run_tick(self, tick: int, *, arrivals: bool = True) -> dict[str, float]:
         cpu0 = time.process_time()
+        rpc0 = sum(self.agent_client.calls.values())
+        ji0 = self.agent_client.calls.get("JobsInfo", 0)
+        restarts0 = self._restarts + self._agent_restarts
+        fault_boundary = any(
+            f.start_tick == tick or f.end_tick == tick
+            for f in self.scenario.faults.faults
+        )
         if self.faulty is not None:
             self.faulty.set_tick(tick)
         self._agent_faults(tick)
         self._bridge_faults(tick)
         self._apply_fault_boundaries(tick)
+        # store/scheduler may have been replaced by a bridge fault above —
+        # snapshot the write/solve baselines on the objects this tick runs
+        commits0 = sum(self.store.commit_counts_snapshot().values())
+        solves0 = self.scheduler.solves_total
 
         t0 = time.perf_counter()
         with TRACER.span("sim.arrive") as arrive_span:
@@ -941,6 +980,35 @@ class SimHarness:
 
         tick_ms = sum(phases.get(k, 0.0) for k in PHASES)
         phases["tick"] = tick_ms
+        # ---- steady-state verdict (PR-11) ----
+        # A tick is STEADY when nothing arrived, bound or was preempted,
+        # no fault window opened or closed, no stack restarted, and —
+        # the hard part — the whole control plane performed ZERO store
+        # commits. steady_tick_p50_ms over these ticks is the O(changes)
+        # acceptance number; the bench-smoke gate additionally pins the
+        # RPC and solver-invocation budgets per steady tick.
+        commits = sum(self.store.commit_counts_snapshot().values()) - commits0
+        self._tick_meta.append({
+            "tick": tick,
+            "arrived": n_arrived,
+            "bound": len(newly_bound),
+            "preempted": len(preempted),
+            "commits": commits,
+            "rpc_calls": sum(self.agent_client.calls.values()) - rpc0,
+            "jobsinfo_calls": self.agent_client.calls.get("JobsInfo", 0) - ji0,
+            "solves": self.scheduler.solves_total - solves0,
+            "steady": (
+                tick > 0
+                and self._stack_up
+                and n_arrived == 0
+                and not newly_bound
+                and not preempted
+                and commits == 0
+                and not fault_boundary
+                and self._restarts + self._agent_restarts == restarts0
+            ),
+            "tick_ms": tick_ms,
+        })
         # CPU seconds actually burned this tick (whole run_tick, including
         # the arrive/invariant bookkeeping outside the phase clock):
         # divergence between this and wall time is noisy-neighbor steal,
@@ -1204,6 +1272,22 @@ class SimHarness:
                 k: round(float(np.percentile(phase_arr[k], 95)), 3) for k in PHASES
             },
             "arrive_p50_ms": round(float(np.median(self._arrive_ms)), 3),
+            # the steady-state headline (PR-11): tick p50 over ticks in
+            # which nothing arrived/bound/preempted/faulted and the
+            # control plane wrote NOTHING — the cost of observing an
+            # unchanged cluster, which the incremental tick drives to
+            # O(changes). None = the run never reached a steady tick.
+            "steady_tick_p50_ms": (
+                round(
+                    float(np.median(
+                        [m["tick_ms"] for m in self._tick_meta if m["steady"]]
+                    )),
+                    3,
+                )
+                if any(m["steady"] for m in self._tick_meta)
+                else None
+            ),
+            "steady_ticks": sum(1 for m in self._tick_meta if m["steady"]),
             # view-materialization pressure (PR-6): frozen views built /
             # commits through the columnar row path over the whole run,
             # so re-anchors can see whether reads are eating the columnar

@@ -557,6 +557,33 @@ def elastic_resize(scale: float = 1.0, seed: int = 57) -> Scenario:
     )
 
 
+def steady_state_soak(scale: float = 1.0, seed: int = 60) -> Scenario:
+    """The O(changes) acceptance shape (PR-11): a front-loaded standing
+    load whose jobs outlive the whole run, deliberately oversubscribed so
+    an unschedulable backlog pends forever. Tick 0 is the cold bind,
+    tick 1 mirrors the Pending→Running transitions, and every later tick
+    is GENUINELY steady — nothing arrives, binds, completes or writes —
+    which is what ``steady_tick_p50_ms`` medians over and what the
+    bench-smoke zero-work gate (0 store commits, 0 solver invocations,
+    ≤1 status RPC per shard) pins hard."""
+    return Scenario(
+        name="steady_state_soak",
+        description="standing load + unschedulable backlog; ticks 2+ are "
+        "zero-work steady state",
+        cluster=ClusterSpec(num_nodes=_n(300, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(1200, scale, floor=60),
+            arrival="front",
+            # far beyond the run horizon: the standing state never drains
+            duration_range=(100_000.0, 200_000.0),
+        ),
+        ticks=10,
+        expect_drain=False,
+        drain_grace_ticks=0,
+        seed=seed,
+    )
+
+
 def sharded_smoke(scale: float = 1.0, seed: int = 58) -> Scenario:
     """The fast sharded-tick gate (ISSUE 10): a gang-heavy mixed
     workload on 3 partitions, each split across several shards
@@ -655,6 +682,42 @@ def full_500kx100k(scale: float = 1.0, seed: int = 42) -> Scenario:
     )
 
 
+def full_500kx100k_steady(scale: float = 1.0, seed: int = 42) -> Scenario:
+    """The 10×-scale STEADY-STATE headline (ISSUE 11, slow): the
+    ``full_500kx100k`` shape run three ticks longer, so after the cold
+    bind (tick 1), the submit fan-out (tick 1's mirror) and the
+    Running-status sweep (tick 2), ticks 3-5 are genuinely steady —
+    nothing arrives, binds, completes or writes (job durations outlive
+    the horizon by construction at 5 s/tick). Records
+    ``steady_tick_p50_ms`` over those ticks, gated at ≤1 s — the
+    "heavy traffic from millions of users" acceptance bar, where
+    arrivals are a trickle against 500k standing pods. Kept separate
+    from ``full_500kx100k`` so that scenario's 3-tick
+    ``full_tick_p50_ms`` lineage (PR-2 → PR-10) stays comparable."""
+    return Scenario(
+        name="full_500kx100k_steady",
+        description="steady-state sharded tick at 500k x 100k: ticks 3-5 "
+        "must be O(changes) (slow)",
+        cluster=ClusterSpec(num_nodes=_n(100_000, scale), num_partitions=16),
+        workload=WorkloadSpec(
+            jobs=_n(500_000, scale, floor=200),
+            arrival="front",
+            gang_fraction=0.05,
+            gpu_fraction=0.15,
+            duration_range=(30.0, 120.0),
+        ),
+        ticks=6,
+        expect_drain=False,
+        drain_grace_ticks=0,
+        seed=seed,
+        slow=True,
+        sharding=ShardConfig(max_nodes_per_shard=8192, workers=2),
+        # the PR-11 acceptance bar: a steady-state tick at 500k×100k —
+        # standing state unchanged, arrivals zero — completes within 1 s
+        steady_gate_ms=1_000.0,
+    )
+
+
 def full_50kx10k(scale: float = 1.0, seed: int = 42) -> Scenario:
     """The headline: 50k pods × 10k nodes through the FULL bridge
     pipeline. Slow (minutes); records ``full_tick_p50_ms_50kx10k`` with
@@ -676,6 +739,34 @@ def full_50kx10k(scale: float = 1.0, seed: int = 42) -> Scenario:
         drain_grace_ticks=0,
         seed=seed,
         slow=True,
+    )
+
+
+def full_50kx10k_steady(scale: float = 1.0, seed: int = 42) -> Scenario:
+    """The STEADY-STATE headline at 50k×10k (ISSUE 11, slow): the
+    ``full_50kx10k`` shape plus three post-convergence ticks (see
+    ``full_500kx100k_steady`` for the tick anatomy). Records
+    ``steady_tick_p50_ms`` over ticks 3-5, gated at ≤50 ms."""
+    return Scenario(
+        name="full_50kx10k_steady",
+        description="steady-state full-bridge tick at 50k x 10k: ticks "
+        "3-5 must be O(changes) (slow)",
+        cluster=ClusterSpec(num_nodes=_n(10_000, scale)),
+        workload=WorkloadSpec(
+            jobs=_n(50_000, scale, floor=100),
+            arrival="front",
+            gang_fraction=0.05,
+            gpu_fraction=0.15,
+            duration_range=(30.0, 120.0),
+        ),
+        ticks=6,
+        expect_drain=False,
+        drain_grace_ticks=0,
+        seed=seed,
+        slow=True,
+        # the PR-11 acceptance bar: a steady-state tick at 50k×10k —
+        # standing state unchanged, arrivals zero — completes within 50 ms
+        steady_gate_ms=50.0,
     )
 
 
@@ -729,10 +820,13 @@ SCENARIOS = {
         multi_tenant_storm,
         priority_inversion,
         elastic_resize,
+        steady_state_soak,
         sharded_smoke,
         sharded_gang_split,
         full_500kx100k,
+        full_500kx100k_steady,
         full_50kx10k,
+        full_50kx10k_steady,
         full_50kx10k_crash,
     )
 }

@@ -347,6 +347,43 @@ class TestFlightRecorder:
         store_node = sched["children"]["scheduler.store"]
         assert store_node["counters"]["commits.Thing.scheduler.bind"] == 1
 
+    def test_aggregate_child_p50_cannot_exceed_parent(self):
+        """ISSUE 11 satellite: a child span present only in the one cold
+        tick used to median over its OWN support (just that tick) while
+        its every-tick parent medianed over all ticks — the 500k record
+        printed `sim.arrive` at 0.025 ms with a 5,884 ms
+        `operator.reconcile` child inside it. Absent paths now count as
+        0.0 in every record, so a sequential child's aggregated time can
+        never exceed its parent's."""
+        import time as _time
+
+        rec = FlightRecorder(tracer=TRACER, root_name="sim.tick")
+        with rec.tick(0):  # the cold tick: heavy child work
+            with TRACER.span("sim.arrive"):
+                with TRACER.span("operator.reconcile"):
+                    _time.sleep(0.02)
+        for tick in (1, 2):  # steady ticks: the child never runs
+            with rec.tick(tick):
+                with TRACER.span("sim.arrive"):
+                    pass
+        tree = rec.aggregate()["span_tree_p50_ms"]
+        parent = tree["sim.tick/sim.arrive"]
+        child = tree["sim.tick/sim.arrive/operator.reconcile"]
+        assert child <= parent, (
+            f"child p50 {child} ms exceeds parent p50 {parent} ms — the "
+            "median-support artifact is back"
+        )
+        # the cold tick's cost is still visible where it belongs: the
+        # per-tick record and the self-time aggregate
+        assert rec.records[0]["tree"]["sim.tick"]["children"]["sim.arrive"][
+            "children"
+        ]["operator.reconcile"]["ms"] >= 20.0
+        agg_self = {
+            row["name"]: row["self_ms"]
+            for row in rec.aggregate()["top_self_ms"]
+        }
+        assert agg_self.get("operator.reconcile", 0.0) >= 20.0
+
     def test_overflow_keeps_newest_spans_phase_tree_intact(self):
         """A front-loaded cold tick floods the window with per-arrival
         reconcile spans; the ring must evict THOSE and keep the phase

@@ -1,0 +1,370 @@
+"""ISSUE 11: the event-driven incremental tick.
+
+Three contracts, in rising order of paranoia:
+
+1. **Off = PR-10 byte-for-byte** — with ``incremental=False`` today's
+   tree reproduces the committed pre-change fixture exactly (digests,
+   final state, event counts), the same pinning pattern as
+   ``shard_off_baseline.json`` / ``policy_off_baseline.json``.
+2. **On ≡ off** — the incremental tick's determinism digest and
+   ``final_state_digest`` are byte-identical to the full tick at the
+   same seed, across arrival/drain/fault shapes (the smoke gates rerun
+   this per scenario in CI; the fuzz below additionally asserts it at
+   EVERY tick boundary, the oracle pattern from ``test_colstore.py``).
+3. **Steady state is zero-work** — a converged provider's sync tick and
+   a no-change scheduler tick perform 0 store writes, ≤1 status RPC per
+   provider and 0 solver invocations (the bench-smoke gate pins the
+   same facts on the full harness).
+"""
+
+import dataclasses
+import hashlib
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from slurm_bridge_tpu.bridge.objects import (
+    Meta,
+    Pod,
+    PodPhase,
+    PodRole,
+    PodSpec,
+    partition_node_name,
+)
+from slurm_bridge_tpu.bridge.store import ObjectStore
+from slurm_bridge_tpu.bridge.vnode import VirtualNodeProvider
+from slurm_bridge_tpu.core.types import JobDemand
+from slurm_bridge_tpu.obs.events import EventRecorder
+from slurm_bridge_tpu.sim.agent import SimCluster, SimNode, SimWorkloadClient
+from slurm_bridge_tpu.sim.faults import Fault, FaultPlan
+from slurm_bridge_tpu.sim.harness import Scenario, SimHarness, run_scenario
+from slurm_bridge_tpu.sim.scenarios import SCENARIOS
+from slurm_bridge_tpu.sim.trace import ClusterSpec, WorkloadSpec
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+# ------------------------------------------ off ≡ PR-10 baseline oracle
+
+
+def test_incremental_off_matches_pre_change_fixture():
+    """``incremental=False`` must be the pre-change tick byte-for-byte:
+    the committed fixture was captured from the tree BEFORE the
+    incremental layer landed (regenerating it to paper over a diff
+    defeats the test)."""
+    base = json.loads((FIXTURES / "incremental_off_baseline.json").read_text())
+    for name, want in sorted(base.items()):
+        sc = dataclasses.replace(
+            SCENARIOS[name](scale=want["scale"], seed=want["seed"]),
+            incremental=False,
+        )
+        d = run_scenario(sc).determinism
+        assert d["digest"] == want["digest"], f"{name}: tick digest drifted"
+        assert d["final_state_digest"] == want["final_state_digest"], (
+            f"{name}: final state drifted"
+        )
+        assert d["events"] == want["events"], f"{name}: event counts drifted"
+        assert d["bound_total"] == want["bound_total"]
+        assert d["preempted_total"] == want["preempted_total"]
+
+
+def test_incremental_on_matches_fixture_too():
+    """The stronger statement: the incremental tick ITSELF reproduces
+    the pre-change digests — O(changes) may move where time goes, never
+    what happens. (crash_restart in the set proves the incremental
+    caches rebuild losslessly across a crash.)"""
+    base = json.loads((FIXTURES / "incremental_off_baseline.json").read_text())
+    for name, want in sorted(base.items()):
+        sc = SCENARIOS[name](scale=want["scale"], seed=want["seed"])
+        assert sc.incremental  # the default
+        d = run_scenario(sc).determinism
+        assert d["digest"] == want["digest"], f"{name}: tick digest drifted"
+        assert d["final_state_digest"] == want["final_state_digest"], (
+            f"{name}: final state drifted"
+        )
+        assert d["events"] == want["events"], f"{name}: event counts drifted"
+
+
+# ------------------------------------- fuzzed per-tick on ≡ off oracle
+
+
+def _random_scenario(rng: np.random.Generator, case: int) -> Scenario:
+    """One randomized arrival/drain/fault shape at toy scale."""
+    arrival = rng.choice(["poisson", "front", "burst"])
+    faults = []
+    if rng.random() < 0.6:
+        start = int(rng.integers(2, 5))
+        faults.append(Fault(
+            kind="drain_nodes",
+            start_tick=start,
+            end_tick=start + int(rng.integers(2, 5)),
+            node_fraction=float(rng.uniform(0.1, 0.3)),
+        ))
+    if rng.random() < 0.6:
+        start = int(rng.integers(2, 6))
+        faults.append(Fault(
+            kind="rpc_error",
+            start_tick=start,
+            end_tick=start + int(rng.integers(2, 4)),
+            methods=("SubmitJob", "JobsInfo", "Nodes"),
+            rate=float(rng.uniform(0.1, 0.3)),
+        ))
+    if rng.random() < 0.4:
+        start = int(rng.integers(2, 6))
+        faults.append(Fault(
+            kind="lost_status",
+            start_tick=start,
+            end_tick=start + int(rng.integers(2, 4)),
+        ))
+    if rng.random() < 0.4:
+        start = int(rng.integers(2, 6))
+        faults.append(Fault(
+            kind="stale_snapshot",
+            start_tick=start,
+            end_tick=start + int(rng.integers(2, 4)),
+        ))
+    return Scenario(
+        name=f"fuzz-{case}",
+        cluster=ClusterSpec(num_nodes=int(rng.integers(24, 48))),
+        workload=WorkloadSpec(
+            jobs=int(rng.integers(40, 120)),
+            arrival=str(arrival),
+            spread_ticks=int(rng.integers(2, 6)),
+            gang_fraction=float(rng.uniform(0.0, 0.2)),
+            duration_range=(20.0, float(rng.uniform(40.0, 90.0))),
+        ),
+        faults=FaultPlan(tuple(faults)),
+        ticks=int(rng.integers(8, 12)),
+        expect_drain=False,
+        drain_grace_ticks=0,
+        seed=int(rng.integers(0, 2**31)),
+        tracing=False,  # pure-speed fuzz: spans add nothing to the oracle
+    )
+
+
+def test_fuzzed_incremental_equals_full_at_every_tick():
+    """The per-tick twin oracle: drive an incremental harness and a
+    full-tick harness through the SAME randomized scenario in lockstep
+    and assert the running bind digest AND the complete store/sim state
+    digest byte-identical after EVERY tick — not just at the end."""
+    rng = np.random.default_rng(1107)
+    for case in range(4):
+        sc = _random_scenario(rng, case)
+        on = SimHarness(sc)
+        off = SimHarness(dataclasses.replace(sc, incremental=False))
+        try:
+            for tick in range(sc.ticks):
+                on.run_tick(tick)
+                off.run_tick(tick)
+                assert (
+                    on._digest.hexdigest() == off._digest.hexdigest()
+                ), f"case {case}: bind digest diverged at tick {tick}"
+                assert (
+                    on._final_state_digest() == off._final_state_digest()
+                ), f"case {case}: store state diverged at tick {tick}"
+        finally:
+            on._cleanup()
+            off._cleanup()
+
+
+# ------------------------------------------ steady-state zero work
+
+
+class CountingClient:
+    def __init__(self, inner):
+        self._inner = inner
+        self.calls: dict[str, int] = {}
+
+    def total(self) -> int:
+        return sum(self.calls.values())
+
+    def __getattr__(self, name):
+        fn = getattr(self._inner, name)
+        if not callable(fn):
+            return fn
+
+        def call(*a, **kw):
+            self.calls[name] = self.calls.get(name, 0) + 1
+            return fn(*a, **kw)
+
+        return call
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def _bound_pod(name: str) -> Pod:
+    return Pod(
+        meta=Meta(name=name),
+        spec=PodSpec(
+            role=PodRole.SIZECAR,
+            partition="part0",
+            node_name=partition_node_name("part0"),
+            demand=JobDemand(
+                partition="part0",
+                script="#!/bin/sh\ntrue\n",
+                cpus_per_task=1,
+                time_limit_s=1000,
+                job_name=name,
+            ),
+        ),
+    )
+
+
+def _converged_incremental_provider(n_pods: int = 4):
+    clock = _Clock()
+    nodes = [SimNode(name=f"n{i}", cpus=16, memory_mb=32000) for i in range(4)]
+    cluster = SimCluster(
+        nodes, {"part0": tuple(n.name for n in nodes)}, clock=clock
+    )
+    client = CountingClient(SimWorkloadClient(cluster))
+    store = ObjectStore()
+    provider = VirtualNodeProvider(
+        store,
+        client,
+        "part0",
+        events=EventRecorder(),
+        sync_workers=1,
+        inventory_ttl=0.0,  # every sync really fetches: the cursor must win
+        status_interval=3600.0,
+        incremental=True,
+    )
+    for i in range(n_pods):
+        store.create(_bound_pod(f"bp{i}"))
+    provider.sync()  # submit
+    provider.sync()  # reclassify + mirror PENDING -> RUNNING
+    provider.sync()  # settle the status writes' dirty-set
+    pods = store.list(Pod.KIND)
+    assert all(p.status.phase == PodPhase.RUNNING for p in pods)
+    return clock, cluster, client, store, provider
+
+
+def test_incremental_steady_sync_zero_writes_cursor_rpcs():
+    """A converged incremental provider's sync: 0 store writes, exactly
+    one (cursor-scoped, empty) JobsInfo plus the Partition/Nodes probes
+    — and the Nodes answer is the unchanged=true short-circuit."""
+    clock, cluster, client, store, provider = _converged_incremental_provider()
+    assert provider._jobs_cursor > 0
+    assert provider._mirror_cache is not None
+    mc_before = provider._mirror_cache
+    rv_before = store.changes_since(Pod.KIND, 0)[0]
+    calls_before = dict(client.calls)
+    provider.sync()
+    assert store.changes_since(Pod.KIND, 0)[0] == rv_before  # 0 writes
+    assert client.calls["JobsInfo"] - calls_before.get("JobsInfo", 0) == 1
+    assert client.calls.get("JobInfo", 0) == 0  # never per-pod
+    # the working set was reused, not rebuilt
+    assert provider._mirror_cache is mc_before
+    # and the agent really answered "unchanged" on the inventory cursor
+    assert provider._nodes_cursor == cluster.nodes_version
+
+
+def test_incremental_run_time_tick_is_not_a_change():
+    clock, cluster, client, store, provider = _converged_incremental_provider()
+    rv_before = store.changes_since(Pod.KIND, 0)[0]
+    clock.now += 100.0
+    cluster.step()
+    provider.sync()
+    assert store.changes_since(Pod.KIND, 0)[0] == rv_before
+
+
+def test_incremental_completion_mirrors_exactly_like_full():
+    """Completions arrive through the cursor path with one write per
+    pod, and the resulting store state matches a full-mirror twin."""
+    clock, cluster, client, store, provider = _converged_incremental_provider()
+    rv_before = store.changes_since(Pod.KIND, 0)[0]
+    clock.now += 5000.0
+    cluster.step()
+    provider.sync()
+    pods = store.list(Pod.KIND)
+    assert all(p.status.phase == PodPhase.SUCCEEDED for p in pods)
+    rv, changed, _ = store.changes_since(Pod.KIND, rv_before)
+    assert sorted(changed) == sorted(p.name for p in pods)
+
+
+def test_incremental_scheduler_skips_solver_on_unchanged_inputs():
+    """Two ticks over the same unschedulable backlog and unchanged
+    inventory: the second tick reuses the first's assignment (0 solver
+    invocations) and writes nothing."""
+    from slurm_bridge_tpu.bridge.scheduler import PlacementScheduler
+
+    clock = _Clock()
+    nodes = [SimNode(name=f"n{i}", cpus=4, memory_mb=8000) for i in range(3)]
+    cluster = SimCluster(
+        nodes, {"part0": tuple(n.name for n in nodes)}, clock=clock
+    )
+    client = SimWorkloadClient(cluster)
+    store = ObjectStore()
+    # an impossible ask: pends forever, so every tick re-solves the same
+    # backlog against the same inventory
+    pod = Pod(
+        meta=Meta(name="greedy"),
+        spec=PodSpec(
+            role=PodRole.SIZECAR,
+            partition="part0",
+            demand=JobDemand(
+                partition="part0", script="#!/bin/sh\ntrue\n",
+                cpus_per_task=64, job_name="greedy",
+            ),
+        ),
+    )
+    store.create(pod)
+    sched = PlacementScheduler(
+        store, client, inventory_ttl=0.0, incremental=True
+    )
+    assert sched.tick() == 0
+    assert sched.solves_total == 1
+    rv_after_first = store.changes_since(Pod.KIND, 0)[0]
+    assert sched.tick() == 0
+    assert sched.tick() == 0
+    assert sched.solves_total == 1  # solver never invoked again
+    assert sched.solve_reuses_total == 2
+    assert sched.last_route == "memo"
+    assert store.changes_since(Pod.KIND, 0)[0] == rv_after_first
+
+
+def test_incremental_scheduler_resolves_after_inventory_change():
+    """A capacity change invalidates the warm start: the next tick
+    solves fresh (and can now place the pod)."""
+    from slurm_bridge_tpu.bridge.objects import VirtualNode, NodeCondition
+
+    from slurm_bridge_tpu.bridge.scheduler import PlacementScheduler
+
+    clock = _Clock()
+    nodes = [SimNode(name=f"n{i}", cpus=4, memory_mb=8000) for i in range(3)]
+    cluster = SimCluster(
+        nodes, {"part0": tuple(n.name for n in nodes)}, clock=clock
+    )
+    client = SimWorkloadClient(cluster)
+    store = ObjectStore()
+    store.create(VirtualNode(
+        meta=Meta(name=partition_node_name("part0")),
+        partition="part0",
+        conditions=[NodeCondition(type="Ready", status=True)],
+    ))
+    store.create(_bound_pod("late"))
+
+    def unbind(p: Pod):
+        p.spec.node_name = ""
+
+    store.mutate(Pod.KIND, "late", unbind)
+    sched = PlacementScheduler(
+        store, client, inventory_ttl=0.0, incremental=True
+    )
+    # drain everything: the pod can't place, memo settles in
+    cluster.drain([n.name for n in nodes])
+    assert sched.tick() == 0
+    assert sched.tick() == 0
+    assert sched.solves_total == 1
+    # capacity returns: nodes_version moves, the cursor misses, the memo
+    # key's inventory identity breaks, and a REAL solve binds the pod
+    cluster.resume([n.name for n in nodes])
+    assert sched.tick() == 1
+    assert sched.solves_total == 2
