@@ -4,8 +4,8 @@
 # workflows can never drift.
 
 .PHONY: help test fast check generate apidoc hygiene bench bench-smoke \
-        sim-smoke chaos-smoke quality-smoke shard-smoke sim sim-bench \
-        sim-bench-crash sim-bench-500k sim-bench-steady \
+        sim-smoke chaos-smoke quality-smoke shard-smoke admission-smoke \
+        sim sim-bench sim-bench-crash sim-bench-500k sim-bench-steady \
         sim-bench-steady-500k wal-fsync-bench scenarios \
         docker-build install uninstall deploy undeploy run demo
 
@@ -19,7 +19,7 @@ test: ## Full suite + graft compile contracts + hygiene (ref: make test).
 fast: ## ~2-min signal: everything not marked slow.
 	python -m pytest tests/ -q -m "not slow"
 
-check: test bench-smoke sim-smoke chaos-smoke quality-smoke shard-smoke ## Alias the reference's CI verb (+ encode, sim, chaos, quality & shard gates).
+check: test bench-smoke sim-smoke chaos-smoke quality-smoke shard-smoke admission-smoke ## Alias the reference's CI verb (+ encode, sim, chaos, quality, shard & admission gates).
 
 generate: ## Regenerate protobuf bindings + API docs (ref: make generate).
 	hack/regen-proto.sh
@@ -48,6 +48,9 @@ quality-smoke: ## Placement-quality scenarios: policy-on/off arms + scorecard fl
 
 shard-smoke: ## Sharded-placement scenarios: double-run determinism + reconcile gates.
 	python -m slurm_bridge_tpu.sim --shard
+
+admission-smoke: ## Streaming-admission scenarios: fast-path p99 + admission-off twin gates.
+	python -m slurm_bridge_tpu.sim --admission
 
 sim: ## Run every fast sim scenario full-size (see --list for names).
 	python -m slurm_bridge_tpu.sim --all

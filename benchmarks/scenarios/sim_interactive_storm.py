@@ -1,0 +1,21 @@
+"""Sim scenario: streaming admission under a diurnal interactive storm.
+
+A production-class interactive stream rides a diurnal batch background;
+the always-on fast path must bind interactive arrivals in milliseconds
+(arrival→bind p99 ≤ 100 ms virtual time) while batch utilization stays
+within 1% of the admission-off twin — `make admission-smoke` gates both.
+
+    python -m benchmarks.scenarios.sim_interactive_storm [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.interactive_storm``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import interactive_storm as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "interactive_storm"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

@@ -57,6 +57,12 @@ class JournalState:
 
     ledger: dict[str, int] = field(default_factory=dict)
     jobs: dict[int, dict] = field(default_factory=dict)
+    #: incremental-sync cursor state (ISSUE 12 satellite d): the
+    #: JobsInfo/Nodes signature+version maps the real agent persists so
+    #: a restart does NOT force a full re-deliver to every cursor-
+    #: holding caller. Shape: ``{"jobs_version": int, "jobs": {jid:
+    #: [ver, sig_hash]}, "nodes": {key_hash: [ver, sig_hash]}}``.
+    cursors: dict = field(default_factory=dict)
     #: None = clean; "torn" / "corrupt" = replay stopped at a defect
     #: (prior records kept — mirror of ``utils.wal.read_wal``)
     defect: str | None = None
@@ -105,6 +111,11 @@ class AgentJournal:
         self.records = 0  # since last compaction
         self.records_total = 0
         self.snapshots_written = 0
+        #: optional () → cursors dict, installed by the owner (the real
+        #: agent's WorkloadServicer) and folded into every checkpoint so
+        #: cursor records survive WAL truncation. None keeps the PR-8
+        #: snapshot shape exactly (the sim journal never sets it).
+        self.cursors_fn = None
 
     # ---- append paths ----
 
@@ -155,6 +166,31 @@ class AgentJournal:
         if payloads:
             self._append_all(payloads)
 
+    def record_job_cursors(self, entries: list, watermark: int) -> None:
+        """Durably note JobsInfo cursor movement: ``entries`` is
+        ``[(job_id, version, sig_hash), ...]`` for the jobs whose
+        mirror-visible signature changed this call, ``watermark`` the
+        resulting jobs-state version. One record per call — the batch
+        shares one durability barrier like a batched submit."""
+        self._append({
+            "op": "jcur",
+            "v": int(watermark),
+            "e": [[int(j), int(v), str(h)] for j, v, h in entries],
+        })
+
+    def record_nodes_cursor(
+        self, key_hash: str, sig_hash: str, version: int
+    ) -> None:
+        """Durably note one Nodes cursor slot's movement (keyed by the
+        requested-name-set hash — the raw name set would bloat records
+        for zero recovery value)."""
+        self._append({
+            "op": "ncur",
+            "k": str(key_hash),
+            "h": str(sig_hash),
+            "v": int(version),
+        })
+
     @property
     def needs_compaction(self) -> bool:
         return (
@@ -191,15 +227,20 @@ class AgentJournal:
 
         with self._barrier:
             ledger, jobs = state_fn()
+            payload = {
+                "version": 1,
+                "incarnation": self.incarnation,
+                "ledger": ledger,
+                "jobs": {str(k): v for k, v in jobs.items()},
+            }
+            if self.cursors_fn is not None:
+                # the sync cursors ride every checkpoint, so truncating
+                # the WAL can never lose them (satellite d)
+                payload["cursors"] = self.cursors_fn()
             atomic_write(
                 self.path,
                 json.dumps(
-                    {
-                        "version": 1,
-                        "incarnation": self.incarnation,
-                        "ledger": ledger,
-                        "jobs": {str(k): v for k, v in jobs.items()},
-                    },
+                    payload,
                     separators=(",", ":"),
                 ),
                 # honor the journal's flush mode: the simulator's
@@ -232,6 +273,9 @@ class AgentJournal:
                 state.jobs = {
                     int(k): v for k, v in data.get("jobs", {}).items()
                 }
+                cur = data.get("cursors")
+                if isinstance(cur, dict):
+                    state.cursors = cur
             except (OSError, ValueError, TypeError) as exc:
                 log.warning(
                     "agent journal snapshot %s unreadable (%s); "
@@ -255,6 +299,19 @@ class AgentJournal:
                 state.ledger[str(rec.get("sid"))] = int(rec.get("id", 0))
             elif op == "job":
                 state.jobs[int(rec.get("id", 0))] = rec.get("doc") or {}
+            elif op == "jcur":
+                cur = state.cursors
+                cur["jobs_version"] = max(
+                    int(cur.get("jobs_version") or 0), int(rec.get("v", 0))
+                )
+                jmap = cur.setdefault("jobs", {})
+                for ent in rec.get("e") or []:
+                    jmap[str(int(ent[0]))] = [int(ent[1]), str(ent[2])]
+            elif op == "ncur":
+                nmap = state.cursors.setdefault("nodes", {})
+                nmap[str(rec.get("k"))] = [
+                    int(rec.get("v", 0)), str(rec.get("h", "")),
+                ]
             else:
                 log.warning("agent journal record has unknown op %r; skipped", op)
                 continue

@@ -14,6 +14,7 @@ Reference parity: pkg/slurm-agent/api/slurm.go. Notable behaviors kept:
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -117,7 +118,8 @@ class SubmitLedger:
     #: oldest entries age out by insertion order once past this
     MAX_JOB_DOCS = 10_000
 
-    def __init__(self, state_file: str | None = None, journal=None):
+    def __init__(self, state_file: str | None = None, journal=None,
+                 preloaded=None):
         self._lock = threading.Lock()
         self._by_submitter: dict[str, int] = {}
         self._state_file = state_file
@@ -125,7 +127,11 @@ class SubmitLedger:
         self._jobs: dict[int, dict] = {}
         legacy = self._load_legacy(state_file) if state_file else {}
         if journal is not None:
-            state = journal.load()
+            # ``preloaded`` lets the owner hand in an already-replayed
+            # JournalState (the servicer reads the cursors from the same
+            # replay) — a restart then parses the snapshot and replays
+            # the WAL exactly once
+            state = preloaded if preloaded is not None else journal.load()
             # migration: an agent upgraded from --ledger to --journal
             # folds the legacy dedupe history into the first checkpoint —
             # journal entries win (they are newer); dropping the legacy
@@ -222,40 +228,119 @@ class WorkloadServicer:
         self.driver = driver
         self.partition_config = partition_config or {}
         self.journal = None
+        restored_cursors: dict = {}
+        preloaded_state = None
         if journal_file:
             from slurm_bridge_tpu.agent.journal import AgentJournal
 
             self.journal = AgentJournal(journal_file)
-        self.ledger = SubmitLedger(ledger_file, journal=self.journal)
-        self.uid = str(uuid.uuid4())
-        self.tail_poll_interval = tail_poll_interval
-        # ---- incremental-sync cursors (PR-11) ----
+            # ONE snapshot parse + WAL replay for the whole restart:
+            # the sync cursors restore from it here (BEFORE the
+            # ledger's rebase checkpoint truncates the WAL, satellite
+            # d), and the same state is handed to the SubmitLedger
+            preloaded_state = self.journal.load()
+            restored_cursors = preloaded_state.cursors or {}
+            self.journal.cursors_fn = self._cursor_state
+        # ---- incremental-sync cursors (PR-11, journaled since ISSUE 12
+        # satellite d) ----
         # The real agent must exec Slurm CLIs to know current state either
         # way; what the cursor saves is the RESPONSE — an unchanged job is
         # omitted, an unchanged inventory answers `unchanged=true` — so
         # the caller's decode/diff work is O(changes). Versions start at a
         # NANOSECOND wall-clock stamp so a restarted agent's version base
         # sits above any version a caller could hold from the previous
-        # incarnation: the base grows ~1e9/s while bumps add +1 per
-        # changed job, so even pathological churn cannot outrun the clock
-        # between restarts — a caller's stale cursor is always below the
-        # fresh base and the first post-restart response re-delivers
-        # everything (full resync, never a lost update).
+        # incarnation (the base grows ~1e9/s while bumps add +1 per
+        # changed job — the clock outruns churn between restarts).
+        # Journal-backed agents additionally PERSIST the signature/version
+        # maps: a restarted agent whose jobs have not moved keeps their
+        # old versions, so a caller's cursor still filters them — an
+        # agent restart no longer forces a full re-deliver to every
+        # caller. The restored base bumps PAST the persisted watermark,
+        # never below it, so fresh changes always exceed stale cursors.
         self._sync_lock = threading.Lock()
-        self._jobs_version = time.time_ns()
-        self._job_sigs: dict[int, tuple] = {}
-        self._job_versions: dict[int, int] = {}
-        #: per requested-name-set: (content signature, version)
-        self._nodes_sync: dict[tuple, tuple[bytes, int]] = {}
         #: cursor-state bounds: a long-lived agent serving a job-cycling
         #: bridge must not accumulate signature entries forever. When the
         #: job maps outgrow the bound, the oldest-changed half is dropped
         #: (versions are monotonic ⇒ sort-by-version IS change order); a
         #: dropped id simply re-signs (and re-delivers once) on its next
-        #: appearance. Name-set slots each pin an O(nodes) signature, so
-        #: they get a small hard cap with clear-all overflow.
+        #: appearance. Name-set slots get a small hard cap with clear-all
+        #: overflow (callers just resync once) — enforced on the
+        #: journal-restore path below too, so repeated restarts cannot
+        #: compound the maps past the bound.
         self._JOB_SIG_LIMIT = 500_000
         self._NODES_SYNC_LIMIT = 32
+        jmap = restored_cursors.get("jobs") or {}
+        self._job_sigs: dict[int, str] = {}
+        self._job_versions: dict[int, int] = {}
+        for j, ent in jmap.items():
+            try:
+                jid, ver, sig = int(j), int(ent[0]), str(ent[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            self._job_versions[jid] = ver
+            self._job_sigs[jid] = sig
+        self._jobs_version = max(
+            time.time_ns(),
+            int(restored_cursors.get("jobs_version") or 0),
+            max(self._job_versions.values(), default=0),
+        )
+        #: per requested-name-set: (sig hash, version, key hash)
+        self._nodes_sync: dict[tuple, tuple[str, int, str]] = {}
+        #: persisted Nodes cursor slots from the previous incarnation,
+        #: keyed by name-set hash: (version, sig hash) — consulted on a
+        #: slot's first request this incarnation, so an unchanged
+        #: inventory keeps its version across the restart
+        self._nodes_persisted: dict[str, tuple[int, str]] = {}
+        for k, ent in (restored_cursors.get("nodes") or {}).items():
+            try:
+                self._nodes_persisted[str(k)] = (int(ent[0]), str(ent[1]))
+            except (TypeError, ValueError, IndexError):
+                continue
+        if len(self._nodes_persisted) > self._NODES_SYNC_LIMIT:
+            # keep the newest slots (versions are monotonic): older ones
+            # just resync once, exactly like a cap overflow at runtime
+            keep = sorted(
+                self._nodes_persisted,
+                key=lambda k: self._nodes_persisted[k][0],
+            )[-self._NODES_SYNC_LIMIT:]
+            self._nodes_persisted = {
+                k: self._nodes_persisted[k] for k in keep
+            }
+        if len(self._job_versions) > self._JOB_SIG_LIMIT:
+            keep_j = sorted(
+                self._job_versions, key=self._job_versions.__getitem__
+            )[-self._JOB_SIG_LIMIT:]
+            keep_set = set(keep_j)
+            self._job_versions = {
+                j: v for j, v in self._job_versions.items() if j in keep_set
+            }
+            self._job_sigs = {
+                j: s for j, s in self._job_sigs.items() if j in keep_set
+            }
+        self.ledger = SubmitLedger(
+            ledger_file, journal=self.journal, preloaded=preloaded_state
+        )
+        self.uid = str(uuid.uuid4())
+        self.tail_poll_interval = tail_poll_interval
+
+    def _cursor_state(self) -> dict:
+        """The journal-checkpoint view of the sync cursors (satellite
+        d): jobs watermark + per-job (version, sig hash) + per-name-set
+        Nodes slots — live slots over persisted ones (live is newer)."""
+        with self._sync_lock:
+            nodes = {
+                kh: [ver, sh] for kh, (ver, sh) in self._nodes_persisted.items()
+            }
+            for _key, (sh, ver, kh) in self._nodes_sync.items():
+                nodes[kh] = [ver, sh]
+            return {
+                "jobs_version": self._jobs_version,
+                "jobs": {
+                    str(j): [v, self._job_sigs.get(j, "")]
+                    for j, v in self._job_versions.items()
+                },
+                "nodes": nodes,
+            }
 
     @staticmethod
     def _job_doc(req: pb.SubmitJobRequest, job_id: int) -> dict:
@@ -395,15 +480,19 @@ class WorkloadServicer:
         return self._jobs_cursor_filter(entries, request.since_version)
 
     @staticmethod
-    def _entry_sig(entry: pb.JobsInfoEntry) -> tuple:
+    def _entry_sig(entry: pb.JobsInfoEntry) -> str:
         """The mirror-visible signature of one job's entry: everything
         Slurm can change on a live job EXCEPT the always-ticking
-        ``run_time_s`` (the mirror's own "not a change" rule)."""
-        return tuple(
+        ``run_time_s`` (the mirror's own "not a change" rule). Hashed —
+        the digest is what the journal persists (satellite d), and the
+        field values are primitives so ``repr`` is stable across
+        processes."""
+        sig = tuple(
             (m.status, m.node_list, m.batch_host, m.reason, m.exit_code,
              m.start_time)
             for m in entry.info
         )
+        return hashlib.blake2b(repr(sig).encode(), digest_size=12).hexdigest()
 
     def _jobs_cursor_filter(
         self, entries: list, since: int
@@ -414,7 +503,10 @@ class WorkloadServicer:
         moved since it. found=false entries always ride along (an unknown
         id has no version). since=0 callers get the full pre-PR-11
         response, with the version field offering the cursor for next
-        time."""
+        time. Signature movement is journaled (satellite d), so a
+        restarted agent's unchanged jobs keep their versions and cursor-
+        holding callers are not force-fed a full re-deliver."""
+        moved: list[tuple[int, int, str]] = []
         with self._sync_lock:
             for entry in entries:
                 if not entry.found:
@@ -425,6 +517,7 @@ class WorkloadServicer:
                     self._job_sigs[jid] = sig
                     self._jobs_version += 1
                     self._job_versions[jid] = self._jobs_version
+                    moved.append((jid, self._jobs_version, sig))
             if len(self._job_sigs) > self._JOB_SIG_LIMIT:
                 keep = sorted(
                     self._job_versions,
@@ -447,6 +540,15 @@ class WorkloadServicer:
                     if not e.found
                     or self._job_versions.get(int(e.job_id), ver) > since
                 ]
+        if moved and self.journal is not None:
+            # outside the sync lock, like the ledger's appends: the WAL
+            # writer orders itself, group commit shares fsyncs
+            try:
+                self.journal.record_job_cursors(moved, ver)
+                if self.journal.needs_compaction:
+                    self.journal.checkpoint_with(self.ledger._journal_state)
+            except OSError:
+                log.warning("could not journal JobsInfo cursor movement")
         resp = pb.JobsInfoResponse(jobs=entries)
         resp.version = ver
         return resp
@@ -566,22 +668,63 @@ class WorkloadServicer:
         # callers asking for different slices must not churn each other's
         # version), version bumped on content change. The scontrol exec
         # already happened — the cursor saves the wire + caller decode.
+        # Journal-backed agents persist (version, sig hash) per slot
+        # (satellite d): an unchanged inventory keeps its version across
+        # a restart, so callers' cursors keep answering unchanged=true.
         key = tuple(request.names)
-        sig = resp.SerializeToString(deterministic=True)
+        sig = hashlib.blake2b(
+            resp.SerializeToString(deterministic=True), digest_size=12
+        ).hexdigest()
+        journal_rec = None
         with self._sync_lock:
             ent = self._nodes_sync.get(key)
+            if ent is None:
+                key_hash = hashlib.blake2b(
+                    "\x00".join(request.names).encode(), digest_size=12
+                ).hexdigest()
+                pers = self._nodes_persisted.get(key_hash)
+                if pers is not None and pers[1] == sig:
+                    # same content as the previous incarnation saw: the
+                    # persisted version still names it — no re-deliver.
+                    # The slot cap applies HERE too: restored slots must
+                    # not grow the maps past the bound the cap exists
+                    # for (callers past it just resync once), and an
+                    # adopted persisted entry moves to the live map so
+                    # checkpoints don't carry it twice forever.
+                    if len(self._nodes_sync) >= self._NODES_SYNC_LIMIT:
+                        self._nodes_sync.clear()
+                        self._nodes_persisted.clear()
+                    else:
+                        self._nodes_persisted.pop(key_hash, None)
+                        ent = (sig, pers[0], key_hash)
+                        self._nodes_sync[key] = ent
+            else:
+                key_hash = ent[2]
             if ent is None or ent[0] != sig:
                 # ns-stamped base for the same restart-monotonicity
                 # argument as the jobs cursor (content changes bump +1,
-                # the clock outruns them between restarts)
-                ver = (ent[1] if ent else time.time_ns()) + 1
+                # the clock outruns them between restarts); a persisted
+                # slot whose content moved while the agent was down
+                # bumps PAST its persisted version, never below
+                pers = self._nodes_persisted.get(key_hash)
+                base = ent[1] if ent else max(
+                    time.time_ns(), pers[0] if pers else 0
+                )
+                ver = base + 1
                 if ent is None and len(self._nodes_sync) >= self._NODES_SYNC_LIMIT:
-                    # each slot pins an O(nodes) signature: cap hard,
-                    # clear-all on overflow (callers just resync once)
+                    # each slot pins cursor state: cap hard, clear-all
+                    # on overflow (callers just resync once)
                     self._nodes_sync.clear()
-                self._nodes_sync[key] = (sig, ver)
+                    self._nodes_persisted.clear()
+                self._nodes_sync[key] = (sig, ver, key_hash)
+                journal_rec = (key_hash, sig, ver)
             else:
                 ver = ent[1]
+        if journal_rec is not None and self.journal is not None:
+            try:
+                self.journal.record_nodes_cursor(*journal_rec)
+            except OSError:
+                log.warning("could not journal Nodes cursor movement")
         if request.since_version and request.since_version == ver:
             return pb.NodesResponse(version=ver, unchanged=True)
         resp.version = ver

@@ -70,8 +70,10 @@ class _RowPod(NamedTuple):
     """One schedulable pod captured for the tick — everything the
     encode/solve/bind pipeline needs, readable straight from columns so
     the 50k-pod cold scan materializes zero frozen views. The full
-    frozen Pod rides along (``obj``) only on the object-backed store and
-    for incumbents, whose paths still want it."""
+    frozen Pod rides along (``obj``) only on the OBJECT-backed store
+    (pending and incumbents alike); columnar rows — including the
+    incumbent scan since ISSUE 12 satellite c — carry ``obj=None``,
+    and no incumbent path dereferences it."""
 
     name: str
     uid: str
@@ -144,6 +146,7 @@ class PlacementScheduler:
         policy=None,
         shard=None,
         incremental: bool = False,
+        admission=None,
     ):
         if backend not in ("auto", "auction", "greedy"):
             raise ValueError(f"unknown scheduler backend {backend!r}")
@@ -304,6 +307,48 @@ class PlacementScheduler:
         #: solver-invocation accounting the steady-state gate reads
         self.solves_total = 0
         self.solve_reuses_total = 0
+        #: streaming admission (ISSUE 12): the always-on fast path that
+        #: binds interactive-class arrivals against the residual
+        #: free_after view between batch ticks. None (the default) is
+        #: the PR-11 tick byte-for-byte — fixture-pinned like policy=None
+        #: and shard=None. With a remote solver sidecar there is no
+        #: in-process residual to window, so the fast path stays dormant
+        #: (every arrival falls through to the batch tick).
+        self.admission = None
+        if admission is not None:
+            from slurm_bridge_tpu.admission import FastPathAdmitter
+
+            self.admission = (
+                admission
+                if isinstance(admission, FastPathAdmitter)
+                else FastPathAdmitter(admission, policy=policy)
+            )
+            if solver_endpoint:
+                log.warning(
+                    "streaming admission attached with a remote solver "
+                    "sidecar: the residual view cannot be rebuilt from a "
+                    "remote solve, so the fast path will never bind"
+                )
+        #: (snapshot, post-backfill residual free) captured by the last
+        #: in-process solve — what the admission window re-bases on
+        self._adm_capture: tuple | None = None
+        #: versioned unschedulable-backlog mark (ISSUE 12 satellite b,
+        #: incremental mode only): PLACEMENT_FAILED events for an
+        #: unchanged backlog are emitted once per backlog GENERATION — a
+        #: generation being one fresh solve; warm-start (memo) ticks
+        #: re-solve nothing and re-emit nothing. name → reason emitted
+        #: in the current generation.
+        self._unsched_emitted: dict[str, str] = {}
+        #: ready-partition set of the last bind phase — the second half
+        #: of the steady-bind skip: a warm-start tick whose ready set is
+        #: unchanged provably reproduces the previous bind phase (same
+        #: assignment, same marks, zero writes, generation already
+        #: emitted), so the O(backlog) mark walk is skipped outright
+        self._last_ready: set | None = None
+        #: incumbent scan cache (ISSUE 12 satellite c): dirty-set cursor
+        #: + cached row set, mirroring the pending-scan pair above
+        self._incumbent_rv = 0
+        self._incumbent_cache: list[_RowPod] | None = None
 
     # ---- inventory ----
 
@@ -521,6 +566,90 @@ class PlacementScheduler:
             and p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
         ]
 
+    def _incumbent_rows(self) -> list[_RowPod]:
+        """The preemption pool as row records (ISSUE 12 satellite c).
+
+        Columnar stores answer it straight from the node/phase columns —
+        one vectorized mask over the table instead of a full store list
+        materializing 50k frozen views per tick — and incremental mode
+        additionally caches the row set behind the store's Pod dirty-set
+        cursor, so a steady preemption-enabled tick re-walks nothing.
+        Content and order (name-sorted, like ``store.list``) are
+        identical to :meth:`incumbent_pods` by construction.
+        """
+        if self.incremental:
+            rv, changed, deleted = self.store.changes_since(
+                Pod.KIND, self._incumbent_rv
+            )
+            if (
+                not changed
+                and not deleted
+                and self._incumbent_cache is not None
+            ):
+                return self._incumbent_cache
+            self._incumbent_rv = rv
+            self._incumbent_cache = self._incumbent_scan()
+            return self._incumbent_cache
+        return self._incumbent_scan()
+
+    def _incumbent_scan(self) -> list[_RowPod]:
+        table = self.store.table(Pod.KIND)
+        want_labels = self.policy is not None
+        if table is None:
+            return [
+                _RowPod(
+                    p.name, p.meta.uid, p.meta.resource_version,
+                    p.spec.demand, p.spec.partition, p.status.reason,
+                    p.spec.placement_hint, p,
+                    p.meta.labels if want_labels else None,
+                )
+                for p in self.incumbent_pods()
+            ]
+        from slurm_bridge_tpu.bridge.columns import PHASE_CODE
+        from slurm_bridge_tpu.bridge.objects import PodPhase as _PP
+
+        ph_pending = PHASE_CODE[_PP.PENDING]
+        ph_running = PHASE_CODE[_PP.RUNNING]
+        c = table.cols
+        with self.store.locked():
+            names = sorted(table.row_of)  # store.list order
+            if not names:
+                return []
+            rows = np.fromiter(
+                (table.row_of[n] for n in names), np.int64, len(names)
+            )
+            keep = (
+                (c.role[rows] == PodRole.SIZECAR)
+                & ~c.deleted[rows]
+                & (c.node[rows] != "")
+                & (c.njobs[rows] > 0)
+                & (
+                    (c.phase[rows] == ph_pending)
+                    | (c.phase[rows] == ph_running)
+                )
+            )
+            sel = np.nonzero(keep)[0]
+            rws = rows[sel]
+            lab = (
+                c.labels[rws].tolist()
+                if want_labels
+                else (None,) * int(sel.size)
+            )
+            return [
+                _RowPod(names[i], u, rv, d, p, r, hh, None, ll)
+                for i, u, rv, d, p, r, hh, ll in zip(
+                    sel.tolist(),
+                    c.uid[rws].tolist(),
+                    c.rv[rws].tolist(),
+                    c.demand[rws].tolist(),
+                    c.partition[rws].tolist(),
+                    c.reason[rws].tolist(),
+                    c.hint[rws].tolist(),
+                    lab,
+                )
+                if hh  # bound-with-hints: the incumbent contract
+            ]
+
     def tick(self) -> int:
         """Solve one placement round; returns the number of pods bound.
 
@@ -539,6 +668,8 @@ class PlacementScheduler:
         self.last_phase_ms = {"store": 0.0, "encode": 0.0, "solve": 0.0, "bind": 0.0}
         with TRACER.span("scheduler.store") as store_span:
             self._retry_pending_cancels()
+            if self.admission is not None:
+                self._prune_deductions()
             pods = self._pending_set()
             store_span.count("pods_pending", len(pods))
             if pods:
@@ -546,15 +677,9 @@ class PlacementScheduler:
                 # (the oracle and indexed packer reserve-first, the
                 # auction by candidate substitution), so preemption is
                 # engine-independent
-                incumbents = [
-                    _RowPod(
-                        p.name, p.meta.uid, p.meta.resource_version,
-                        p.spec.demand, p.spec.partition, p.status.reason,
-                        p.spec.placement_hint, p,
-                        p.meta.labels if self.policy is not None else None,
-                    )
-                    for p in (self.incumbent_pods() if self.preemption else [])
-                ]
+                incumbents = (
+                    self._incumbent_rows() if self.preemption else []
+                )
                 store_span.count("incumbents", len(incumbents))
                 t0 = time.perf_counter()
                 partitions, nodes = self.cluster_state()
@@ -582,6 +707,11 @@ class PlacementScheduler:
             d = pod.demand or JobDemand(partition=pod.partition)
             demands.append(d)
         n_pending = len(pods)
+        #: whether this tick ran (or will run) a FRESH solve — the
+        #: backlog-generation boundary for the versioned unschedulable
+        #: mark (satellite b): a warm-start tick re-solves nothing, so
+        #: its unchanged backlog re-emits nothing
+        fresh_solve = True
         if self._remote is not None:
             # the sidecar owns encode+solve; report the RPC as the solve
             with TRACER.span("scheduler.solve", engine="remote") as solve_span:
@@ -628,6 +758,7 @@ class PlacementScheduler:
                 self.last_route = "memo"
                 _route_total.inc(engine="memo")
                 self.solve_reuses_total += 1
+                fresh_solve = False
                 by_job_names, lost_jobs = reused
             elif self.shard is not None:
                 by_job_names, lost_jobs = self._solve_sharded(
@@ -649,6 +780,31 @@ class PlacementScheduler:
                 for vn in self.store.list(VirtualNode.KIND)
                 if vn.ready and not vn.meta.deleted
             }
+            if (
+                self.incremental
+                and not fresh_solve
+                and not lost_jobs
+                and ready_nodes == self._last_ready
+            ):
+                # the steady-bind skip (satellite b, the other half of
+                # the versioned mark): a warm-start tick whose ready set
+                # is unchanged reproduces the previous bind phase
+                # EXACTLY — the reused assignment bound nothing (a bind
+                # last tick would have changed the pending set and
+                # broken the memo), the marks rewrite nothing (reasons
+                # already written by this generation's fresh solve), and
+                # the versioned mark already emitted them — so the
+                # O(backlog) mark walk is pure replay and is skipped.
+                bind_span.count("steady_skip", 1)
+                bind_span.count("binds", 0)
+                bind_span.count("unschedulable", 0)
+                bind_s = bind_span.duration
+                self.last_phase_ms["bind"] = bind_s * 1e3
+                _bind_seconds.observe(bind_s)
+                _tick_seconds.observe(time.perf_counter() - t0)
+                _pods_unplaced.set(len(pods))
+                return 0
+            self._last_ready = ready_nodes
             binds: list[tuple[Pod, str, tuple[str, ...]]] = []
             unschedulable: list[tuple[Pod, str]] = []
             admitted_idx: list[int] = []
@@ -680,12 +836,27 @@ class PlacementScheduler:
                 # ...and the ledger rides the WAL (PR-10): a no-charge
                 # tick writes nothing
                 self.policy.save_to_store(self.store)
-            self._mark_unschedulable_batch(unschedulable)
+            # versioned unschedulable mark (satellite b): a fresh solve
+            # opens a new backlog generation — everything re-emits and
+            # the emitted ledger resets; a warm-start tick's backlog is
+            # provably IDENTICAL to the previous tick's (same inputs ⇒
+            # same assignment ⇒ same leftovers), so its events carry no
+            # information and are skipped. Incremental mode only: the
+            # full tick keeps the per-tick level-triggered emission.
+            if self.incremental and fresh_solve:
+                self._unsched_emitted = {}
+            self._mark_unschedulable_batch(
+                unschedulable, emit_all=not self.incremental
+            )
             placed = self._bind_batch(binds)
             preempted = 0
             for j in lost_jobs:
                 if self._preempt(all_pods[j]):
                     preempted += 1
+            if self.admission is not None:
+                self._rebase_admission_window(
+                    demands, by_job_names, n_pending
+                )
             bind_span.count("binds", placed)
             bind_span.count("unschedulable", len(unschedulable))
             bind_span.count("preempted", preempted)
@@ -727,6 +898,12 @@ class PlacementScheduler:
             None if priorities is None else tuple(priorities),
             inc_sig,
             n_pending,
+            # in-flight fast-path binds are subtracted from the solve's
+            # free view, so a dropped (or added) deduction is a solve-
+            # input change even when nothing else moved
+            self.admission.deduction_signature()
+            if self.admission is not None
+            else (),
         )
 
     def _solve_local(
@@ -746,6 +923,19 @@ class PlacementScheduler:
         self.solves_total += 1
         with TRACER.span("scheduler.encode") as enc_span:
             snapshot = self._encoded.refresh(nodes, partitions)
+            if self.admission is not None:
+                # in-flight fast-path binds (not yet visible agent-side)
+                # come straight off the solve's free view, so the batch
+                # tick can never double-claim fast-claimed capacity;
+                # ``free`` is a per-solve copy, the caches are untouched
+                name_idx0 = self._encoded.name_idx
+                for _nm, (hint, dvec) in sorted(
+                    self.admission.deductions_copy().items()
+                ):
+                    for h in hint:
+                        pos = name_idx0.get(h)
+                        if pos is not None:
+                            snapshot.free[pos] -= dvec
             self._prune_demand_keys(all_pods)
             batch = self._job_rows.encode(
                 [self._demand_key(p) for p in all_pods],
@@ -809,15 +999,40 @@ class PlacementScheduler:
         self.last_phase_ms["solve"] = solve_s * 1e3
         _solve_seconds.observe(solve_s)
         by_job = placement.by_job(batch)
+        backfill_takes: list[tuple[int, int]] = []
         if self.policy is not None and self.policy.config.backfill:
             # cheap second pass: whatever the solve left unplaced —
             # singles and whole gangs, all-or-nothing — into its
             # leftover holes, guarded against delaying any other
             # unplaced equal-or-higher-class gang (policy/engine.py)
-            for row, node in self.policy.backfill(
+            backfill_takes = self.policy.backfill(
                 snapshot, batch, placement, n_pending
-            ):
+            )
+            for row, node in backfill_takes:
                 by_job.setdefault(int(batch.job_of[row]), []).append(node)
+        if self.admission is not None:
+            # the residual seam: what this solve left free AFTER
+            # backfill is what the fast path may admit against — with
+            # this tick's pending binds re-subtracted at the workload
+            # manager's INTEGRAL granularity (Slurm allocates whole
+            # cpus/MBs per node; the float model's under-count would
+            # let the window overstate free capacity the moment the
+            # binds start)
+            residual = placement.free_after.copy()
+            placed_pend = np.nonzero(
+                placement.placed & (batch.job_of < n_pending)
+            )[0]
+            if placed_pend.size:
+                adj = (
+                    np.ceil(batch.demand[placed_pend])
+                    - batch.demand[placed_pend]
+                )
+                np.subtract.at(
+                    residual, placement.node_of[placed_pend], adj
+                )
+            for row, node in backfill_takes:
+                residual[node] -= np.ceil(batch.demand[row])
+            self._adm_capture = (snapshot, residual, None)
         by_job_names = {
             j: [snapshot.node_names[i] for i in idxs] for j, idxs in by_job.items()
         }
@@ -856,7 +1071,15 @@ class PlacementScheduler:
                 priorities=priorities,
                 demand_key=self._demand_key,
                 policy=self.policy,
+                deductions=(
+                    self.admission.deductions_copy()
+                    if self.admission is not None
+                    else None
+                ),
+                capture_residual=self.admission is not None,
             )
+            if self.admission is not None and self.shard.last_window is not None:
+                self._adm_capture = self.shard.last_window
             solve_span.count("shards_used", self.shard.last_shards_used)
             solve_span.count(
                 "reconciled", self.shard.last_reconcile_placed
@@ -1040,6 +1263,135 @@ class PlacementScheduler:
             )
         _route_total.inc(engine=self.last_route)
         return placement
+
+    # ---- streaming admission (ISSUE 12 tentpole) ----
+
+    def _prune_deductions(self) -> None:
+        """Drop in-flight fast-bind deductions whose pod is now visible
+        agent-side (job ids recorded — Slurm arbitrates from here), or
+        vanished/unbound (nothing left to deduct). Driven per tick off
+        the pods themselves, not a timer."""
+        adm = self.admission
+        with adm.lock:
+            if not adm.deductions:
+                return
+            table = self.store.table(Pod.KIND)
+            drops: list[str] = []
+            if table is not None:
+                with self.store.locked():
+                    c = table.cols
+                    for name in adm.deductions:
+                        row = table.row_of.get(name)
+                        if (
+                            row is None
+                            or c.deleted[row]
+                            or not c.node[row]
+                            or int(c.njobs[row]) > 0
+                        ):
+                            drops.append(name)
+            else:
+                for name in adm.deductions:
+                    p = self.store.try_get(Pod.KIND, name)
+                    if (
+                        p is None
+                        or p.meta.deleted
+                        or not p.spec.node_name
+                        or p.status.job_ids
+                    ):
+                        drops.append(name)
+            for name in drops:
+                adm.drop_deduction(name)
+
+    def _rebase_admission_window(
+        self, demands, by_job_names, n_pending
+    ) -> None:
+        """Re-base the fast path on this tick's solve: the residual
+        free_after view plus the unplaced-gang backlog the no-delay
+        guard protects. Runs after the bind commit, so arrivals between
+        this tick and the next admit against exactly what the batch
+        solve left behind."""
+        cap = self._adm_capture
+        if cap is None:
+            return  # no in-process solve yet (cold start / remote)
+        snapshot, residual, plan = cap
+        backlog = []
+        for j in range(n_pending):
+            if j in by_job_names:
+                continue
+            d = demands[j]
+            if d is None or max(1, d.nodes) <= 1:
+                continue
+            rank = (
+                self.policy.class_rank_of_job(j)
+                if self.policy is not None
+                else 0
+            )
+            backlog.append((d, rank))
+        self.admission.begin_window(snapshot, residual, backlog, plan=plan)
+
+    def admit(self, name: str):
+        """One streaming-admission attempt for a pending pod — the fast
+        path's public entry, called at ARRIVAL time (event-driven), not
+        from the tick. Interactive-class singles and small gangs bind
+        immediately against the residual view when a tight fit exists
+        under backfill's no-delay guard; everything else (and every
+        miss) falls through to the normal pending scan untouched."""
+        from slurm_bridge_tpu.admission.fastpath import AdmitResult
+
+        adm = self.admission
+        if adm is None:
+            return AdmitResult(eligible=False)
+        t0 = time.perf_counter()
+        pod = self.store.try_get(Pod.KIND, name)
+        if (
+            pod is None
+            or pod.meta.deleted
+            or pod.spec.role != PodRole.SIZECAR
+            or pod.spec.node_name
+            or pod.status.phase != PodPhase.PENDING
+        ):
+            return AdmitResult(eligible=False)
+        demand = pod.spec.demand
+        rank = adm.eligibility_rank(pod.meta.labels, demand)
+        if rank is None:
+            return AdmitResult(eligible=False)
+        with TRACER.span("admission.fastpath") as span:
+            # one critical section from reservation to commit: arrivals
+            # may run off the tick thread, and the tick's prune/
+            # subtract/re-base seams serialize on the same lock
+            with adm.lock:
+                vn = self.store.try_get(
+                    VirtualNode.KIND, partition_node_name(demand.partition)
+                )
+                if vn is None or not vn.ready or vn.meta.deleted:
+                    # same gate as the batch bind phase's ready check
+                    reason = adm.miss_only("not_ready")
+                    span.set_tag("outcome", reason)
+                    out = AdmitResult(eligible=True, reason=reason)
+                else:
+                    names, reason, token = adm.admit(demand, rank)
+                    if names and self._bind(
+                        name, partition_node_name(demand.partition), names
+                    ):
+                        adm.note_bound(name, names, token)
+                        if self.policy is not None:
+                            # fair share stays honest across both paths;
+                            # the ledger persists at the next tick's save
+                            self.policy.charge_admission(
+                                pod.meta.labels, demand
+                            )
+                        span.set_tag("outcome", "bound")
+                        span.count("bound", 1)
+                        out = AdmitResult(eligible=True, hint=names)
+                    else:
+                        if names:
+                            # store-bind conflict: release the reservation
+                            adm.rollback(token)
+                            reason = "conflict"
+                        span.set_tag("outcome", reason)
+                        out = AdmitResult(eligible=True, reason=reason)
+        adm.observe_latency(time.perf_counter() - t0)
+        return out
 
     def _preempt(self, pod: Pod) -> bool:
         """Requeue a preempted pod, then cancel its jobs: binding cleared,
@@ -1310,7 +1662,9 @@ class PlacementScheduler:
         )
         return True
 
-    def _mark_unschedulable_batch(self, marks: list[tuple[Pod, str]]) -> None:
+    def _mark_unschedulable_batch(
+        self, marks: list[tuple[Pod, str]], *, emit_all: bool = True
+    ) -> None:
         """PLACEMENT_FAILED recording for every unplaced pod of the tick
         in ONE ``update_batch`` (PR-4): the very first cold-start tick
         marks the ENTIRE backlog unschedulable (no virtual node is ready
@@ -1369,15 +1723,21 @@ class PlacementScheduler:
                     # emits its own event on success)
                     skip_event.add(pod.name)
                     self._mark_unschedulable(pod.name, reason)
+        # ``emit_all=False`` is the versioned mark (satellite b): within
+        # one backlog generation each (pod, reason) warns exactly once —
+        # the caller resets the ledger whenever a fresh solve opens a
+        # new generation, restoring the level-triggered re-emission
+        pairs = [
+            (pod.name, reason)
+            for pod, reason in marks
+            if pod.name not in skip_event
+            and (emit_all or self._unsched_emitted.get(pod.name) != reason)
+        ]
+        if not emit_all:
+            for name, reason in pairs:
+                self._unsched_emitted[name] = reason
         self.events.emit_batch(
-            Pod.KIND,
-            Reason.PLACEMENT_FAILED,
-            [
-                (pod.name, reason)
-                for pod, reason in marks
-                if pod.name not in skip_event
-            ],
-            warning=True,
+            Pod.KIND, Reason.PLACEMENT_FAILED, pairs, warning=True
         )
 
     def _mark_unschedulable(self, name: str, reason: str) -> None:

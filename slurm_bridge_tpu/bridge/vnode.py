@@ -179,6 +179,10 @@ class _MirrorCache(NamedTuple):
     #: ordered applied-first), so one arrival re-queries one chunk, not
     #: the cluster.
     full_chunk: list
+    #: pod name → batch index — the route the SCOPED rescan (ISSUE 12
+    #: satellite a) patches a changed member through without
+    #: reclassifying the whole node bucket
+    idx_of_name: dict
 
 #: gRPC codes meaning "the agent is unreachable / busy", not "the request
 #: is bad" — submissions stay Pending and retry on the next sync instead
@@ -327,6 +331,13 @@ class VirtualNodeProvider:
         #: store-side cursor: Pod rv watermark of the last classification
         self._scan_rv = 0
         self._mirror_cache: _MirrorCache | None = None
+        #: classification-work accounting (ISSUE 12 satellite a): full
+        #: node-bucket reclassifications vs dirty-set-scoped patches and
+        #: the changed rows those patches touched — the regression test
+        #: pins classification work ∝ changed names, not O(cluster)
+        self.mirror_scans_full = 0
+        self.mirror_scans_scoped = 0
+        self.mirror_scoped_rows = 0
         #: agent-side cursors: jobs-state / nodes-state versions last
         #: fully applied (0 = no cursor yet → full responses)
         self._jobs_cursor = 0
@@ -619,8 +630,26 @@ class VirtualNodeProvider:
                 _status_seconds.observe(t2 - t1)
                 _sync_seconds.observe(t2 - t0)
                 return
+            if mc is not None and self._rescope_mirror_cache(
+                table, mc, changed, deleted
+            ):
+                # satellite a: the dirty names were either foreign pods
+                # (other providers' — the O(cluster)-per-write trap) or
+                # membership-preserving status moves, patched in place —
+                # classification work was ∝ changed names, and the
+                # cursor sync below reuses the SAME working set
+                self._scan_rv = rv
+                span.count("converge_pods", 0)
+                span.count("refresh_pods", len(mc.rb.names))
+                t1 = time.perf_counter()
+                self._refresh_statuses_cols_incr(table, mc)
+                t2 = time.perf_counter()
+                _status_seconds.observe(t2 - t1)
+                _sync_seconds.observe(t2 - t0)
+                return
             self._scan_rv = rv
             self._mirror_cache = None
+            self.mirror_scans_full += 1
         c = table.cols
         with self.store.locked():
             # names→rows resolved under the SAME lock hold as the column
@@ -703,6 +732,58 @@ class VirtualNodeProvider:
         _status_seconds.observe(t2 - t1)
         _sync_seconds.observe(t2 - t0)
 
+    def _rescope_mirror_cache(
+        self, table, mc: _MirrorCache, changed, deleted
+    ) -> bool:
+        """Scoped mirror rescan (ISSUE 12 satellite a): after a pod
+        write, patch the working set for the CHANGED names only instead
+        of one full node-bucket reclassification per provider.
+
+        Exactly the membership-preserving cases are handled in place —
+        a live member's rv/phase/status-row moved (our own mirror
+        writes, agent transitions short of terminal), and writes to
+        pods on OTHER nodes, which this provider previously paid an
+        O(bucket) rescan for despite owning none of them. Anything that
+        changes membership or needs converge work — a new
+        submit-eligible pod on this node, a deletion, a terminal
+        transition, moved job ids — returns False and the caller runs
+        the full classification, as before.
+        """
+        idx_of = mc.idx_of_name
+        for name in deleted:
+            if name in idx_of:
+                return False  # tombstoned member: membership change
+        rb = mc.rb
+        with self.store.locked():
+            c = table.cols
+            row_of = table.row_of
+            for name in changed:
+                row = row_of.get(name)
+                node = c.node[row] if row is not None else None
+                if node != self.node_name:
+                    if name in idx_of:
+                        return False  # moved off this node
+                    continue  # another provider's pod: not our work
+                i = idx_of.get(name)
+                if i is None:
+                    return False  # new pod here: converge/classify
+                if (
+                    c.deleted[row]
+                    or c.role[row] != PodRole.SIZECAR
+                    or c.njobs[row] == 0
+                    or c.phase[row] == _PH_SUCCEEDED
+                    or c.phase[row] == _PH_FAILED
+                    or c.job_ids[row] != rb.job_ids[i]
+                ):
+                    return False  # left the live set / ids moved
+                rb.rv[i] = c.rv[row]
+                rb.phase[i] = c.phase[row]
+                rb.istart[i] = c.istart[row]
+                rb.ilen[i] = c.ilen[row]
+                self.mirror_scoped_rows += 1
+        self.mirror_scans_scoped += 1
+        return True
+
     def _build_mirror_cache(self, rb: _RefreshBatch) -> _MirrorCache:
         """Derive the cursor sync's cross-tick state from one
         classification: unique job ids — already-applied ids first, ids
@@ -733,7 +814,8 @@ class VirtualNodeProvider:
             lo + _BULK_CHUNK > n_old and lo < len(ids)
             for lo in range(0, len(ids), _BULK_CHUNK)
         ]
-        return _MirrorCache(rb, ids, reqs, idx_of, full_chunk)
+        idx_of_name = {name: i for i, name in enumerate(rb.names)}
+        return _MirrorCache(rb, ids, reqs, idx_of, full_chunk, idx_of_name)
 
     def _fail_pod_name(self, name: str, reason: str) -> None:
         def record(p: Pod):

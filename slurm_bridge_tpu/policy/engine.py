@@ -53,7 +53,30 @@ __all__ = [
     "TENANT_LABEL",
     "PolicyConfig",
     "PlacementPolicy",
+    "feasible_nodes",
 ]
+
+
+def feasible_nodes(
+    free: np.ndarray,
+    partition_of: np.ndarray,
+    features: np.ndarray,
+    d: np.ndarray,
+    part: int,
+    req: int,
+) -> np.ndarray:
+    """The ONE node-feasibility rule placement second passes share: a
+    node can host one shard of ``(d, part, req)`` iff it is in the
+    partition, every resource axis fits ``free``, and it carries every
+    required feature bit. Backfill's guard and the streaming-admission
+    fast path (slurm_bridge_tpu.admission) both call this, so the
+    fast-path ≡ guarded-backfill oracle holds by construction on the
+    fit half of the decision."""
+    return (
+        (partition_of == part)
+        & ((free >= d).all(axis=1))
+        & ((np.uint32(req) & ~features) == 0)
+    )
 
 
 @dataclass(frozen=True)
@@ -244,6 +267,21 @@ class PlacementPolicy:
                 self.fair.charge(tenant, share)
                 self._usage_dirty = True
 
+    def charge_admission(self, labels, demand) -> None:
+        """Fair-share charge for ONE pod admitted outside the batch tick
+        (the streaming-admission fast path). Uses the capacity totals of
+        the last ``begin_tick`` — before any tick has run there is no
+        denominator, and charging against the (1,1,1) placeholder would
+        wildly overcharge, so the pre-first-tick window charges nothing
+        (the batch tick it falls back to would not have admitted yet
+        either)."""
+        if self._totals == (1.0, 1.0, 1.0):
+            return
+        tenant = (labels.get(TENANT_LABEL, "") if labels else "") or ""
+        share = dominant_share(_demand_vec(demand), self._totals)
+        self.fair.charge(tenant, share)
+        self._usage_dirty = True
+
     # ---- durable fair share (PR-10, ROADMAP policy follow-up) ----
 
     def load_from_store(self, store) -> None:
@@ -340,11 +378,7 @@ class PlacementPolicy:
         parts = snapshot.partition_of
 
         def feas_mask(d, part, req):
-            return (
-                (parts == part)
-                & ((free >= d).all(axis=1))
-                & ((np.uint32(req) & ~feats) == 0)
-            )
+            return feasible_nodes(free, parts, feats, d, part, req)
 
         # one record per FULLY-unplaced gang (a partially-placed gang's
         # stragglers are dead this tick — the engines admit gangs

@@ -65,6 +65,17 @@ class QualityTracker:
         self._preempts: list[int] = []
         self._service: dict[str, float] = {}
         self.resizes = 0
+        # ---- streaming-admission latency axis (ISSUE 12) ----
+        # Interactive (fast-path-eligible) arrivals tracked for the
+        # arrival→bind latency scorecard. A fast-path bind's latency is
+        # the measured wall time of the admission attempt (the path runs
+        # event-driven at arrival — processing IS the wait); a batch
+        # bind's latency is its virtual wait, (wait_ticks + 0.5) ×
+        # tick_interval — the +0.5 models the expected wait for the
+        # next periodic solve to even start, which the sim's synchronous
+        # arrive-then-solve tick otherwise hides.
+        self._interactive: set[str] = set()
+        self._fastpath_ms: dict[str, float] = {}
 
     # ---- per-event hooks ----
 
@@ -80,6 +91,15 @@ class QualityTracker:
         at = self._arrived.pop(job_name, None)
         if at is not None:
             self._waits.append((job_name, tick - at, True))
+
+    def note_interactive(self, job_name: str) -> None:
+        """One fast-path-ELIGIBLE arrival (admission on, class +
+        gang-size eligible, past the cold-start warmup)."""
+        self._interactive.add(job_name)
+
+    def note_fastpath_bind(self, job_name: str, latency_ms: float) -> None:
+        """The arrival bound via the fast path in ``latency_ms`` wall ms."""
+        self._fastpath_ms[job_name] = float(latency_ms)
 
     def note_preempts(self, count: int) -> None:
         self._preempts.append(count)
@@ -161,6 +181,21 @@ class QualityTracker:
             "jain_fairness": round(jain_index(weighted), 4),
             "resizes": self.resizes,
         }
+        # ---- interactive arrival→bind latency (ISSUE 12 gate axis) ----
+        lat: list[float] = []
+        tick_ms = self.tick_interval_s * 1e3
+        by_name = {n: (w, b) for n, w, b in waits}
+        for name in self._interactive:
+            fast = self._fastpath_ms.get(name)
+            if fast is not None:
+                lat.append(fast)
+                continue
+            w, _bound = by_name.get(name, (float(final_tick), False))
+            lat.append((float(w) + 0.5) * tick_ms)
+        out["interactive_arrivals"] = len(self._interactive)
+        out["fastpath_binds"] = len(self._fastpath_ms)
+        out["interactive_latency_p50_ms"] = _pct(lat, 50)
+        out["interactive_latency_p99_ms"] = _pct(lat, 99)
         if extra:
             out.update(extra)
         return out

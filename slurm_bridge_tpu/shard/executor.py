@@ -135,6 +135,16 @@ class ShardExecutor:
         self.last_reconcile_attempts = 0
         self.last_reconcile_placed = 0
         self.last_routes: dict[str, int] = {}
+        #: streaming-admission seam (ISSUE 12): when the scheduler asks
+        #: (``capture_residual=True``), the merged post-backfill residual
+        #: is packaged as (snapshot-like, residual, plan) — the window
+        #: the fast path admits against between ticks. None otherwise:
+        #: admission-off ticks pay nothing for the seam.
+        self.last_window: tuple | None = None
+        self._capture_residual = False
+        #: (partitions ref, plan ref) → (partition_codes, partition_of)
+        #: memo for the window snapshot build
+        self._window_parts: tuple | None = None
         # ---- run aggregates (determinism/quality sections) ----
         self.ticks_total = 0
         self.reconcile_attempts_total = 0
@@ -198,10 +208,19 @@ class ShardExecutor:
         priorities=None,
         demand_key=None,
         policy=None,
+        deductions=None,
+        capture_residual: bool = False,
     ) -> tuple[dict[int, list[str]], list[int]]:
         """The sharded equivalent of ``PlacementScheduler._solve_local``:
         returns (global job index → assigned node names, global
-        incumbent indices that lost their nodes)."""
+        incumbent indices that lost their nodes).
+
+        ``deductions`` (streaming admission) — in-flight fast-path
+        binds, ``name → (hint node names, per-shard demand vec)`` —
+        are subtracted from both the routing free view and each
+        per-shard snapshot, so the fan-out can never double-claim
+        fast-claimed capacity."""
+        self._capture_residual = capture_residual
         plan = self._ensure_plan(partitions, nodes)
         _shard_ticks.inc()
         self.ticks_total += 1
@@ -218,6 +237,12 @@ class ShardExecutor:
                 ],
                 np.float32,
             )
+            if deductions:
+                for _nm, (hint, dvec) in sorted(deductions.items()):
+                    for h in hint:
+                        pos = plan.name_pos.get(h)
+                        if pos is not None:
+                            free[pos] -= dvec
             routed = route_jobs(
                 plan, free, demands, all_pods, n_pending, priorities
             )
@@ -242,6 +267,13 @@ class ShardExecutor:
                     plan, partitions, nodes, sid
                 )
                 snapshot = st.inv.refresh(sub_nodes, sub_parts)
+                if deductions:
+                    name_idx_s = st.inv.name_idx
+                    for _nm, (hint, dvec) in sorted(deductions.items()):
+                        for h in hint:
+                            spos = name_idx_s.get(h)
+                            if spos is not None:
+                                snapshot.free[spos] -= dvec
                 demands_s = [demands[j] for j in jobs_s]
                 prio_s = (
                     [priorities[j] for j in jobs_s]
@@ -496,6 +528,11 @@ class ShardExecutor:
         by_job_names: dict[int, list[str]] = {}
         lost_jobs: list[int] = []
         residual = free.copy()
+        #: integral-granularity correction for the ADMISSION window only
+        #: (reconcile keeps the float residual byte-for-byte): this
+        #: tick's pending binds re-subtracted at ceil — see the
+        #: monolithic seam in bridge/scheduler.py
+        win_adj = np.zeros_like(residual) if self._capture_residual else None
         failed_gangs: list[dict] = []
         names_of = plan.pos_name
         for item in work:
@@ -504,6 +541,15 @@ class ShardExecutor:
             placement = results[sid]
             node_idx = plan.shards[sid].node_idx
             residual[node_idx] = placement.free_after
+            if win_adj is not None:
+                pr = np.nonzero(
+                    placement.placed & (batch.job_of < n_pend_local)
+                )[0]
+                if pr.size:
+                    adj = np.ceil(batch.demand[pr]) - batch.demand[pr]
+                    np.add.at(
+                        win_adj, node_idx[placement.node_of[pr]], adj
+                    )
             by_local = placement.by_job(batch)
             if policy is not None and policy.config.backfill:
                 for row, node in policy.backfill(
@@ -512,6 +558,10 @@ class ShardExecutor:
                 ):
                     by_local.setdefault(int(batch.job_of[row]), []).append(node)
                     residual[int(node_idx[node])] -= batch.demand[row]
+                    if win_adj is not None:
+                        win_adj[int(node_idx[node])] += (
+                            np.ceil(batch.demand[row]) - batch.demand[row]
+                        )
             for lj, idxs in by_local.items():
                 by_job_names[jobs_s[lj]] = [
                     snapshot.node_names[i] for i in idxs
@@ -564,6 +614,19 @@ class ShardExecutor:
                 rec_span.count("attempts", len(failed_gangs))
                 rec_span.count("placed", len(placed))
             self.last_reconcile_placed = len(placed)
+            if win_adj is not None and placed:
+                # reconcile debits `residual` at the float model (that
+                # residual is reconcile's own byte-pinned contract);
+                # the ADMISSION window needs the integral-granularity
+                # correction for these placements too, or it would
+                # overstate free capacity on exactly the nodes the
+                # reconciled gangs are about to allocate
+                d_of = {c["j"]: c["d"] for c in failed_gangs}
+                for j, positions in placed:
+                    dv = d_of[j]
+                    adj = np.ceil(dv) - dv
+                    for p in positions:
+                        win_adj[p] += adj
             for j, positions in placed:
                 by_job_names[j] = [names_of[p] for p in positions]
             _shard_reconcile.inc(len(placed), outcome="placed")
@@ -573,7 +636,50 @@ class ShardExecutor:
         self.reconcile_attempts_total += self.last_reconcile_attempts
         self.reconcile_placed_total += self.last_reconcile_placed
         self._note_locality(plan, by_job_names, demands, n_pending)
+        if self._capture_residual:
+            self.last_window = (
+                self._window_snapshot(plan, work, nodes, demands),
+                residual - win_adj,
+                plan,
+            )
         return by_job_names, lost_jobs
+
+    def _window_snapshot(self, plan, work, nodes, demands):
+        """A global-axis ClusterSnapshot for the admission window: the
+        per-shard snapshots stitched back onto the plan's node order —
+        shared feature-code table, so demand feature masks stay
+        comparable, and a partitions-identity memo so the per-tick cost
+        is the feature scatter plus one free-array handoff."""
+        from slurm_bridge_tpu.solver.snapshot import (
+            ClusterSnapshot,
+            node_partition_map,
+        )
+
+        parts_ref = self._sub_cache[1] if self._sub_cache else None
+        memo = self._window_parts
+        if memo is None or memo[0] is not parts_ref or memo[1] is not plan:
+            # rebuild the partition coding off the CURRENT partitions
+            # list (identity-keyed, like every other per-tick memo)
+            partitions = parts_ref if parts_ref is not None else []
+            partition_codes, node_part = node_partition_map(partitions)
+            partition_of = np.fromiter(
+                (node_part.get(nm, -1) for nm in plan.pos_name),
+                np.int32,
+                len(plan.pos_name),
+            )
+            memo = self._window_parts = (
+                parts_ref, plan, partition_codes, partition_of,
+            )
+        _p, _pl, partition_codes, partition_of = memo
+        return ClusterSnapshot(
+            node_names=list(plan.pos_name),
+            capacity=np.zeros((len(plan.pos_name), 3), np.float32),
+            free=np.zeros((0, 3), np.float32),  # the window carries its own
+            partition_of=partition_of,
+            features=self._global_features(plan, work, nodes),
+            partition_codes=partition_codes,
+            feature_codes=self._feature_codes,
+        )
 
     def _global_features(self, plan, work, nodes) -> np.ndarray:
         """Per-node uint32 feature masks on the global axis, assembled

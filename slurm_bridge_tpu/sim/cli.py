@@ -26,6 +26,7 @@ import sys
 
 from slurm_bridge_tpu.sim.harness import run_scenario
 from slurm_bridge_tpu.sim.scenarios import (
+    ADMISSION_SCENARIOS,
     CHAOS_SCENARIOS,
     QUALITY_SCENARIOS,
     SCENARIOS,
@@ -43,6 +44,18 @@ QUALITY_GATES = {
     "jain_off_ceiling": 0.7,
     "util_margin": 0.02,
     "max_wait_ticks": 3.0,
+}
+
+#: admission-smoke floors/ceilings (ISSUE 12 acceptance): the
+#: interactive arrival→bind p99 the fast path must hold (virtual time —
+#: a batch-tick bind costs ≥ half a tick period, so the ceiling is only
+#: reachable through the fast path), the batch-utilization margin the
+#: admission-off twin comparison allows, and the minimum fast-path
+#: engagement below which the scenario stopped testing anything
+ADMISSION_GATES = {
+    "p99_ms": 100.0,
+    "util_margin": 0.01,
+    "min_fastpath_binds": 10,
 }
 
 
@@ -416,6 +429,132 @@ def _quality(label: str = "quality-smoke") -> int:
     return 0
 
 
+def _admission(label: str = "admission-smoke") -> int:
+    """The streaming-admission gate (ISSUE 12): each admission scenario
+    runs TWICE (double-run determinism over the decision stream —
+    attempts, binds, misses, digests), then its twin arms at the same
+    seed:
+
+    - **latency**: interactive arrival→bind p99 ≤ ``p99_ms`` in virtual
+      time. Fast-path binds cost their measured admission wall time
+      (sub-ms); a batch-tick bind costs at least half a tick period
+      (2.5 s here) — so the gate holds only if the fast path catches
+      essentially every interactive arrival;
+    - **engagement**: the fast path actually bound ≥ the floor — a
+      silently-dormant admitter is a failed gate, not a pass;
+    - **admission-off twin**: batch utilization within ``util_margin``
+      of the same scenario with ``admission=None`` (the fast path must
+      not wreck the packing it front-runs), and the twin's interactive
+      p99 must be OVER the gate — otherwise the comparison is vacuous;
+    - **full-tick twin**: the incremental tick under admission stays
+      byte-identical in outcome to the full tick, same as every other
+      subsystem.
+    """
+    import dataclasses
+
+    g = ADMISSION_GATES
+    failures: list[str] = []
+    for name in ADMISSION_SCENARIOS:
+        runs = [
+            run_scenario(_build(name, seed=None, scale=SMOKE_SCALE, ticks=None))
+            for _ in range(2)
+        ]
+        a, b = runs
+        det = a.determinism_json() == b.determinism_json()
+        q = a.quality
+        adm = a.determinism.get("admission") or {}
+        line = {
+            "scenario": name,
+            "deterministic": det,
+            "violations": len(a.determinism["invariant_violations"]),
+            "bound_total": a.determinism["bound_total"],
+            "interactive_arrivals": q["interactive_arrivals"],
+            "fastpath_binds": q["fastpath_binds"],
+            "interactive_latency_p50_ms": q["interactive_latency_p50_ms"],
+            "interactive_latency_p99_ms": q["interactive_latency_p99_ms"],
+            "admission": adm,
+            "utilization_mean": q["utilization_mean"],
+        }
+        if not det:
+            failures.append(
+                f"{name}: determinism broke (same seed, different run)"
+            )
+        if a.determinism["invariant_violations"]:
+            first = a.determinism["invariant_violations"][0]
+            failures.append(f"{name}: invariant violated: {first}")
+        if q["interactive_latency_p99_ms"] > g["p99_ms"]:
+            failures.append(
+                f"{name}: interactive p99 {q['interactive_latency_p99_ms']} "
+                f"ms over the {g['p99_ms']} ms gate"
+            )
+        if q["fastpath_binds"] < g["min_fastpath_binds"]:
+            failures.append(
+                f"{name}: only {q['fastpath_binds']} fast-path binds "
+                f"(floor {g['min_fastpath_binds']}) — the fast path is "
+                "dormant"
+            )
+        off = run_scenario(
+            dataclasses.replace(
+                _build(name, seed=None, scale=SMOKE_SCALE, ticks=None),
+                admission=None,
+            )
+        )
+        line["utilization_admission_off"] = off.quality["utilization_mean"]
+        line["p99_admission_off"] = off.quality.get(
+            "interactive_latency_p99_ms"
+        )
+        if (
+            abs(q["utilization_mean"] - off.quality["utilization_mean"])
+            > g["util_margin"]
+        ):
+            failures.append(
+                f"{name}: utilization {q['utilization_mean']} not within "
+                f"{g['util_margin']} of the admission-off twin "
+                f"{off.quality['utilization_mean']}"
+            )
+        # vacuity check on the LATENCY claim: with admission off the
+        # same interactive stream must miss the gate (it binds through
+        # the batch tick at ≥ half a tick period). The off arm tracks
+        # no interactive set, so compute from its wait distribution:
+        # every wait is ≥ 0 ticks ⇒ ≥ 2.5 s with the +0.5 model — the
+        # arithmetic floor already exceeds the gate; assert it to keep
+        # the gate honest if the latency model ever changes.
+        half_tick_ms = a.scenario.tick_interval_s * 500.0
+        if half_tick_ms <= g["p99_ms"]:
+            failures.append(
+                f"{name}: tick interval {a.scenario.tick_interval_s}s "
+                "makes the batch path faster than the gate — the "
+                "comparison is vacuous"
+            )
+        full = run_scenario(
+            dataclasses.replace(
+                _build(name, seed=None, scale=SMOKE_SCALE, ticks=None),
+                incremental=False,
+            )
+        )
+        inc_same = (
+            full.determinism["digest"] == a.determinism["digest"]
+            and full.determinism["final_state_digest"]
+            == a.determinism["final_state_digest"]
+        )
+        line["incremental_identical"] = inc_same
+        if not inc_same:
+            failures.append(
+                f"{name}: incremental tick diverged from the full tick "
+                "under admission at the same seed"
+            )
+        print(json.dumps(line))
+    if failures:
+        for f in failures:
+            print(f"# {label} FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"# {label} OK: {len(ADMISSION_SCENARIOS)} scenarios, "
+        "deterministic, latency + utilization gates held", file=sys.stderr,
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m slurm_bridge_tpu.sim",
@@ -438,6 +577,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="CI gate: the sharded-placement scenarios "
                         "(double-run determinism + invariants + shard/"
                         "reconcile engagement gates)")
+    parser.add_argument("--admission", action="store_true",
+                        help="CI gate: the streaming-admission scenarios "
+                        "(double-run determinism + interactive latency "
+                        "p99 + admission-off utilization twin)")
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--scale", type=float, default=1.0,
                         help="multiply pod/node counts (default 1.0)")
@@ -458,6 +601,8 @@ def main(argv: list[str] | None = None) -> int:
         return _quality()
     if args.shard:
         return _smoke(SHARD_SCENARIOS, label="shard-smoke")
+    if args.admission:
+        return _admission()
     if args.smoke:
         return _smoke()
 
@@ -469,6 +614,7 @@ def main(argv: list[str] | None = None) -> int:
             *SMOKE_SCENARIOS,
             *CHAOS_SCENARIOS,
             *QUALITY_SCENARIOS,
+            *ADMISSION_SCENARIOS,
             *(n for n in SHARD_SCENARIOS if n not in SMOKE_SCENARIOS),
         ]
         if args.all

@@ -169,6 +169,12 @@ class Scenario:
     #: ticks in which nothing arrived, bound, preempted, faulted or
     #: wrote — the O(changes) acceptance number; None = record only
     steady_gate_ms: float | None = None
+    #: streaming-admission config (admission.AdmissionConfig) — the
+    #: always-on fast path that binds interactive-class arrivals
+    #: against the residual free_after view at ARRIVAL time, before the
+    #: batch tick sees them. None = admission OFF, the PR-11 tick
+    #: byte-for-byte (fixture-pinned, like policy/sharding/incremental)
+    admission: object | None = None
 
 
 @dataclass
@@ -375,6 +381,10 @@ class SimHarness:
         self._digest = hashlib.sha256()
         self._bound_total = 0
         self._preempted_total = 0
+        #: pod names bound by the streaming fast path THIS tick — folded
+        #: into the bound accounting + capacity invariants (they are not
+        #: in pending_before, so the batch diff cannot see them)
+        self._fast_bound_tick: list[str] = []
         self._tick_phases: list[dict[str, float]] = []
         #: per-tick steady-state accounting (PR-11): arrivals, binds,
         #: commits, agent RPCs, solver invocations and the derived
@@ -515,6 +525,12 @@ class SimHarness:
             # exactly like the monolithic encode caches
             shard=scenario.sharding,
             incremental=scenario.incremental,
+            # a fresh admitter too: the residual view and in-flight
+            # deductions are in-memory tick state — after a crash the
+            # fast path stays dormant until the first post-reload solve
+            # re-bases its window (arrivals fall through to the batch
+            # tick meanwhile, the safe direction)
+            admission=scenario.admission,
         )
         self._pod_watch = self.store.watch((Pod.KIND,))
         self._node_watch = self.store.watch((VirtualNode.KIND,))
@@ -760,6 +776,12 @@ class SimHarness:
             # queues its submissions and retries once a leader is back
             self._arrival_backlog = arrivals
             return 0
+        admitter = self.scheduler.admission
+        warmup = (
+            admitter.config.latency_warmup_ticks
+            if admitter is not None
+            else 0
+        )
         for a in arrivals:
             job = BridgeJob(
                 meta=Meta(
@@ -796,6 +818,27 @@ class SimHarness:
                 self.store.replace_update(
                     Pod.KIND, pod.name, stamp, site="sim.arrive"
                 )
+            if admitter is not None and pod is not None:
+                # the streaming fast path runs AT arrival (event-driven):
+                # eligible interactive work binds here, in wall-clock
+                # milliseconds, without waiting for the batch tick
+                t0 = time.perf_counter()
+                res = self.scheduler.admit(pod.name)
+                admit_ms = (time.perf_counter() - t0) * 1e3
+                if res.eligible and tick >= warmup:
+                    # the latency axis starts after the cold-start
+                    # warmup: no window exists before the first solve
+                    # and no virtual node is ready before the first
+                    # mirror — steady-state latency is the SLO
+                    self.quality.note_interactive(a.name)
+                    if res.bound:
+                        self.quality.note_fastpath_bind(a.name, admit_ms)
+                if res.bound:
+                    self._fast_bound_tick.append(pod.name)
+                    self.quality.note_bound(a.name, tick)
+                    self._note(
+                        tick, "fastbind", pod.name, ",".join(res.hint)
+                    )
         return len(arrivals)
 
     def _mirror(self) -> None:
@@ -863,9 +906,11 @@ class SimHarness:
         solves0 = self.scheduler.solves_total
 
         t0 = time.perf_counter()
+        self._fast_bound_tick = []
         with TRACER.span("sim.arrive") as arrive_span:
             n_arrived = self._arrive(tick) if arrivals else 0
             arrive_span.count("arrivals", n_arrived)
+            arrive_span.count("fastpath_bound", len(self._fast_bound_tick))
         self._arrive_ms.append((time.perf_counter() - t0) * 1e3)
 
         stale = bool(self.scenario.faults.active("stale_snapshot", tick))
@@ -923,7 +968,16 @@ class SimHarness:
                 u[0] += cpu
                 u[1] += mem
                 u[2] += gpu
-        self._bound_total += len(newly_bound)
+        # fast-path binds: bound during the arrive phase, so invisible to
+        # the pending_before diff — still bound work this tick (counted,
+        # and capacity-checked below alongside the batch binds; their
+        # quality/digest notes were taken at admit time)
+        fast_pods = [
+            p
+            for n in self._fast_bound_tick
+            if (p := by_name.get(n)) is not None and p.spec.node_name
+        ]
+        self._bound_total += len(newly_bound) + len(fast_pods)
         self._preempted_total += len(preempted)
         for p in newly_bound:
             self.quality.note_bound(p.meta.owner or p.name, tick)
@@ -939,7 +993,7 @@ class SimHarness:
                 tick,
                 pods,
                 self.cluster,
-                newly_bound=newly_bound,
+                newly_bound=newly_bound + fast_pods,
                 free_before=free_before,
                 released={k: tuple(v) for k, v in released.items()},
             )
@@ -1256,6 +1310,11 @@ class SimHarness:
             # they ride the determinism section so the double-run gate
             # covers the fan-out, and the shard-smoke gate reads them
             determinism["shard"] = self.scheduler.shard.stats()
+        if self.scheduler.admission is not None:
+            # streaming-admission aggregates (attempts/binds/misses by
+            # reason) are decision facts, fully virtual-deterministic —
+            # the admission-smoke double-run gate covers the fast path
+            determinism["admission"] = self.scheduler.admission.stats()
         phase_arr = {
             k: np.asarray([p.get(k, 0.0) for p in self._tick_phases])
             for k in (*PHASES, "tick", "cpu")

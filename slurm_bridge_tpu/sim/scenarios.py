@@ -13,6 +13,7 @@ pods × 10k nodes, reported as ``full_tick_p50_ms_50kx10k``.
 
 from __future__ import annotations
 
+from slurm_bridge_tpu.admission import AdmissionConfig
 from slurm_bridge_tpu.policy.engine import PolicyConfig
 from slurm_bridge_tpu.shard.planner import ShardConfig
 from slurm_bridge_tpu.sim.faults import Fault, FaultPlan
@@ -557,6 +558,50 @@ def elastic_resize(scale: float = 1.0, seed: int = 57) -> Scenario:
     )
 
 
+def interactive_storm(scale: float = 1.0, seed: int = 61) -> Scenario:
+    """The streaming-admission gate shape (ISSUE 12): the diurnal_load
+    arrival pattern with a production-class interactive stream mixed
+    into the batch background. Interactive-eligible arrivals
+    (production singles and ≤4-node gangs, ~30% of the trace) must ride
+    the fast path — ``make admission-smoke`` gates their arrival→bind
+    p99 at ≤100 ms in virtual time (a batch-tick bind costs half a
+    tick period minimum, 2.5 s at this interval, so the gate is only
+    reachable through the fast path) — while batch utilization stays
+    within 1% of the admission-off twin (the fast path must not wreck
+    the packing it front-runs)."""
+    return Scenario(
+        name="interactive_storm",
+        description="diurnal batch background + production-class "
+        "interactive stream; fast-path p99 ≤ 100 ms, batch utilization "
+        "within 1% of the admission-off twin",
+        # roomy and CPU-only on purpose: the latency SLO is a
+        # STEADY-STATE property — interactive arrivals must find tight
+        # fits, not queue behind a saturated peak or a 3-node GPU island
+        # (saturation shapes are diurnal_load's job). Two big partitions
+        # so 4-node production gangs always have a feasible island even
+        # at smoke scale.
+        cluster=ClusterSpec(
+            num_nodes=_n(240, scale), num_partitions=2, gpu_fraction=0.0
+        ),
+        workload=WorkloadSpec(
+            jobs=_n(1100, scale, floor=110),
+            arrival="diurnal",
+            spread_ticks=16,
+            diurnal_cycles=2,
+            gang_fraction=0.15,
+            gpu_fraction=0.0,
+            duration_range=(30.0, 60.0),
+            priority_classes=(("batch", 0.7), ("production", 0.3)),
+        ),
+        ticks=24,
+        expect_drain=False,
+        drain_grace_ticks=0,
+        policy=PolicyConfig(),
+        admission=AdmissionConfig(),
+        seed=seed,
+    )
+
+
 def steady_state_soak(scale: float = 1.0, seed: int = 60) -> Scenario:
     """The O(changes) acceptance shape (PR-11): a front-loaded standing
     load whose jobs outlive the whole run, deliberately oversubscribed so
@@ -820,6 +865,7 @@ SCENARIOS = {
         multi_tenant_storm,
         priority_inversion,
         elastic_resize,
+        interactive_storm,
         steady_state_soak,
         sharded_smoke,
         sharded_gang_split,
@@ -862,6 +908,13 @@ SHARD_SCENARIOS = (
     "sharded_gang_split",
 )
 
+#: the streaming-admission subset `make admission-smoke` runs (ISSUE
+#: 12): double-run determinism, the fast-path latency gate, engagement
+#: (the fast path actually bound things), and the admission-off twin
+#: comparison (batch utilization within the margin; the twin's latency
+#: must be WORSE than the gate or the comparison is vacuous)
+ADMISSION_SCENARIOS = ("interactive_storm",)
+
 #: the fast set `make sim-smoke` double-runs: everything not slow-marked,
 #: MINUS the chaos and quality subsets (and the shard subset except
 #: sharded_smoke, see above) — `make check` and CI run sim-smoke,
@@ -873,5 +926,6 @@ SMOKE_SCENARIOS = tuple(
     if not f().slow
     and n not in CHAOS_SCENARIOS
     and n not in QUALITY_SCENARIOS
+    and n not in ADMISSION_SCENARIOS
     and (n not in SHARD_SCENARIOS or n == "sharded_smoke")
 )

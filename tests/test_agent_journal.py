@@ -135,12 +135,21 @@ def test_group_commit_batches_fsyncs(tmp_path):
     """N concurrent durable appends must share fsyncs: with a slow fake
     fsync holding the token, waiters pile onto one flush instead of
     issuing their own — the agent's batched-submit fan-out shape."""
+    import time as _time
+
     calls = []
-    gate = threading.Event()
 
     def slow_fsync(fd):
         calls.append(fd)
-        gate.wait(0.2)  # hold the first fsync while others queue
+        # hold the FIRST fsync until every thread's record is appended
+        # (appends land in the buffer BEFORE the fsync token is
+        # contended), so the pile-up this test exists to observe forms
+        # regardless of how slowly a loaded CI box starts the threads —
+        # a wall-clock gate released after thread.start() raced exactly
+        # that and flaked. Deadline-bounded so a bug can't hang the test.
+        deadline = _time.time() + 5.0
+        while w.appends < 8 and _time.time() < deadline:
+            _time.sleep(0.001)
 
     w = WalWriter(str(tmp_path / "w.wal"), _fsync=slow_fsync)
     # prime: open the file and let the first sync start
@@ -154,7 +163,6 @@ def test_group_commit_batches_fsyncs(tmp_path):
         t = threading.Thread(target=append_one, args=(i,))
         t.start()
         threads.append(t)
-    gate.set()
     for t in threads:
         t.join()
     assert w.appends == 8
@@ -357,3 +365,145 @@ def test_sim_cluster_crash_reload_is_lossless(tmp_path):
     # the pending queue still drains once capacity exists
     assert d in [j.id for j in cluster.pending_jobs()]
     assert a != b  # sanity
+
+
+# ---------------- journaled sync cursors (ISSUE 12 satellite d) ----------
+
+
+class _StubDriver:
+    """Minimal WorkloadDriver surface for the cursor tests: job state
+    and node inventory held in plain dicts, mutated by the test to
+    simulate Slurm moving while the agent is down."""
+
+    def __init__(self):
+        self.jobs: dict[int, list] = {}
+        self.nodelist: list = []
+
+    def job_info(self, jid: int):
+        from slurm_bridge_tpu.agent.cli import SlurmError
+
+        if jid not in self.jobs:
+            raise SlurmError(f"job {jid} unknown")
+        return self.jobs[jid]
+
+    def nodes(self, names):
+        return [n for n in self.nodelist if n.name in names]
+
+
+def _info(jid: int, *, state=None, nodes: str = "n0"):
+    from slurm_bridge_tpu.core.types import JobInfo, JobStatus
+
+    return JobInfo(
+        id=jid, user_id="", name=f"j{jid}", exit_code="",
+        state=state if state is not None else JobStatus.RUNNING,
+        submit_time=None, start_time=None, run_time_s=5, time_limit_s=100,
+        working_dir="", std_out="", std_err="", partition="p",
+        node_list=nodes, batch_host=nodes.split(",")[0], num_nodes=1,
+        array_id="", reason="",
+    )
+
+
+def test_jobsinfo_cursor_survives_agent_restart(tmp_path):
+    """A restarted journal-backed agent keeps unchanged jobs' versions:
+    a caller's cursor still filters them — no forced full re-deliver —
+    while the version base bumps PAST the persisted watermark so fresh
+    changes always exceed stale cursors."""
+    from slurm_bridge_tpu.agent.server import WorkloadServicer
+    from slurm_bridge_tpu.core.types import JobStatus
+    from slurm_bridge_tpu.wire import pb
+
+    jf = str(tmp_path / "agent-journal.json")
+    drv = _StubDriver()
+    drv.jobs[1] = [_info(1)]
+    drv.jobs[2] = [_info(2)]
+    s1 = WorkloadServicer(drv, journal_file=jf)
+    r1 = s1.JobsInfo(pb.JobsInfoRequest(job_ids=[1, 2]), None)
+    ver = r1.version
+    assert len(r1.jobs) == 2
+    r2 = s1.JobsInfo(
+        pb.JobsInfoRequest(job_ids=[1, 2], since_version=ver), None
+    )
+    assert len(r2.jobs) == 0  # nothing moved, nothing delivered
+    s1.journal.close()
+
+    s2 = WorkloadServicer(drv, journal_file=jf)
+    assert s2._jobs_version >= ver  # bumps past, never below
+    r3 = s2.JobsInfo(
+        pb.JobsInfoRequest(job_ids=[1, 2], since_version=ver), None
+    )
+    assert len(r3.jobs) == 0  # the restart forced NO re-deliver
+    # a job that moved while the agent was down IS re-delivered
+    drv.jobs[2] = [_info(2, state=JobStatus.COMPLETED)]
+    r4 = s2.JobsInfo(
+        pb.JobsInfoRequest(job_ids=[1, 2], since_version=ver), None
+    )
+    assert [int(e.job_id) for e in r4.jobs] == [2]
+    assert r4.version > ver
+    s2.journal.close()
+
+    # third incarnation: the rebase checkpoints carried the cursors
+    # through TWO WAL truncations — job 1 still filters, job 2's new
+    # version still exceeds the old cursor
+    s3 = WorkloadServicer(drv, journal_file=jf)
+    r5 = s3.JobsInfo(
+        pb.JobsInfoRequest(job_ids=[1, 2], since_version=ver), None
+    )
+    assert [int(e.job_id) for e in r5.jobs] == [2]
+    r6 = s3.JobsInfo(
+        pb.JobsInfoRequest(job_ids=[1, 2], since_version=r4.version), None
+    )
+    assert len(r6.jobs) == 0
+    s3.journal.close()
+
+
+def test_nodes_cursor_survives_agent_restart(tmp_path):
+    import dataclasses as dc
+
+    from slurm_bridge_tpu.agent.server import WorkloadServicer
+    from slurm_bridge_tpu.core.types import NodeInfo
+    from slurm_bridge_tpu.wire import pb
+
+    jf = str(tmp_path / "agent-journal.json")
+    drv = _StubDriver()
+    drv.nodelist = [NodeInfo(name="n0", cpus=8, memory_mb=16000)]
+    s1 = WorkloadServicer(drv, journal_file=jf)
+    r1 = s1.Nodes(pb.NodesRequest(names=["n0"]), None)
+    ver = r1.version
+    assert not r1.unchanged
+    r2 = s1.Nodes(pb.NodesRequest(names=["n0"], since_version=ver), None)
+    assert r2.unchanged
+    s1.journal.close()
+
+    s2 = WorkloadServicer(drv, journal_file=jf)
+    # unchanged inventory keeps its version across the restart: the
+    # caller's cursor answers unchanged=true with zero node rows
+    r3 = s2.Nodes(pb.NodesRequest(names=["n0"], since_version=ver), None)
+    assert r3.unchanged and r3.version == ver
+    # inventory that moved while the agent was down re-delivers with a
+    # version bumped PAST the persisted one
+    drv.nodelist[0] = dc.replace(drv.nodelist[0], alloc_cpus=4)
+    r4 = s2.Nodes(pb.NodesRequest(names=["n0"], since_version=ver), None)
+    assert not r4.unchanged and r4.version > ver
+    s2.journal.close()
+
+
+def test_cursor_records_and_snapshots_round_trip(tmp_path):
+    """The journal layer itself: jcur/ncur records replay, checkpoints
+    fold cursors, wrong-shape snapshots degrade to empty cursors."""
+    j = _journal(tmp_path)
+    j.record_job_cursors([(7, 101, "abc"), (9, 102, "def")], 102)
+    j.record_nodes_cursor("kh", "sh", 55)
+    st = AgentJournal(j.path, fsync=False).load()
+    assert st.cursors["jobs_version"] == 102
+    assert st.cursors["jobs"] == {"7": [101, "abc"], "9": [102, "def"]}
+    assert st.cursors["nodes"] == {"kh": [55, "sh"]}
+    # a checkpoint with an installed cursors_fn carries them through
+    # the WAL truncation
+    j.cursors_fn = lambda: {
+        "jobs_version": 200, "jobs": {"7": [101, "abc"]}, "nodes": {},
+    }
+    j.checkpoint({}, {})
+    st2 = AgentJournal(j.path, fsync=False).load()
+    assert st2.cursors["jobs_version"] == 200
+    assert st2.replayed == 0  # everything folded into the snapshot
+    j.close()

@@ -74,7 +74,14 @@ def test_incremental_on_matches_fixture_too():
     """The stronger statement: the incremental tick ITSELF reproduces
     the pre-change digests — O(changes) may move where time goes, never
     what happens. (crash_restart in the set proves the incremental
-    caches rebuild losslessly across a crash.)"""
+    caches rebuild losslessly across a crash.)
+
+    One deliberate exception since ISSUE 12 satellite b: incremental
+    mode emits ``PlacementFailed`` once per backlog GENERATION (a fresh
+    solve) instead of once per tick, so its count is ≤ the per-tick
+    fixture count — every OTHER event, and every digest, stays
+    byte-identical (the warm-start ticks whose re-emissions are dropped
+    provably changed nothing)."""
     base = json.loads((FIXTURES / "incremental_off_baseline.json").read_text())
     for name, want in sorted(base.items()):
         sc = SCENARIOS[name](scale=want["scale"], seed=want["seed"])
@@ -84,7 +91,17 @@ def test_incremental_on_matches_fixture_too():
         assert d["final_state_digest"] == want["final_state_digest"], (
             f"{name}: final state drifted"
         )
-        assert d["events"] == want["events"], f"{name}: event counts drifted"
+        got = dict(d["events"])
+        exp = dict(want["events"])
+        got_pf = got.pop("PlacementFailed", 0)
+        want_pf = exp.pop("PlacementFailed", 0)
+        assert got == exp, f"{name}: event counts drifted"
+        # the versioned mark may only DROP warm-start re-emissions,
+        # never add events — and the backlog must still have been
+        # warned at least once per generation
+        assert got_pf <= want_pf, f"{name}: PlacementFailed grew"
+        if want_pf:
+            assert got_pf > 0, f"{name}: unschedulable events vanished"
 
 
 # ------------------------------------- fuzzed per-tick on ≡ off oracle
@@ -328,6 +345,219 @@ def test_incremental_scheduler_skips_solver_on_unchanged_inputs():
     assert sched.solve_reuses_total == 2
     assert sched.last_route == "memo"
     assert store.changes_since(Pod.KIND, 0)[0] == rv_after_first
+
+
+# ------------------- ISSUE 12 satellites: scoped mirror, versioned
+# ------------------- unschedulable mark, indexed incumbent scan
+
+
+def _two_partition_providers(n_pods: int = 6):
+    """Two converged incremental providers over ONE store — the shape
+    satellite a is about: a pod write on one provider's node used to
+    cost a full reclassification in EVERY provider."""
+    clock = _Clock()
+    nodes = [
+        SimNode(name=f"n{i}", cpus=16, memory_mb=32000) for i in range(8)
+    ]
+    parts = {
+        "part0": tuple(n.name for n in nodes[:4]),
+        "part1": tuple(n.name for n in nodes[4:]),
+    }
+    cluster = SimCluster(nodes, parts, clock=clock)
+    client = SimWorkloadClient(cluster)
+    store = ObjectStore()
+    providers = {}
+    for part in parts:
+        providers[part] = VirtualNodeProvider(
+            store, client, part,
+            events=EventRecorder(), sync_workers=1,
+            inventory_ttl=0.0, status_interval=3600.0, incremental=True,
+        )
+    for i in range(n_pods):
+        part = "part0" if i % 2 == 0 else "part1"
+        pod = _bound_pod(f"bp{i}")
+        pod.spec.partition = part
+        pod.spec.node_name = partition_node_name(part)
+        pod.spec.demand.partition = part
+        store.create(pod)
+    for _ in range(3):  # submit → mirror → settle
+        for part in sorted(providers):
+            providers[part].sync()
+    assert all(
+        p.status.phase == PodPhase.RUNNING for p in store.list(Pod.KIND)
+    )
+    return clock, cluster, client, store, providers
+
+
+def test_scoped_mirror_rescan_work_proportional_to_changed_names():
+    """Satellite a: after a pod write, the mirror working set is
+    patched for the CHANGED names only — a foreign-partition write
+    costs this provider ZERO reclassification, a member's status write
+    costs one scoped row, and neither drops the cached working set."""
+    clock, cluster, client, store, providers = _two_partition_providers()
+    p0, p1 = providers["part0"], providers["part1"]
+    mc0, mc1 = p0._mirror_cache, p1._mirror_cache
+    assert mc0 is not None and mc1 is not None
+    full0, full1 = p0.mirror_scans_full, p1.mirror_scans_full
+    # ONE pod on part0 changes (an annotation write: live, same jobs)
+    def touch(p: Pod):
+        p.meta.annotations["x"] = "1"
+    store.mutate(Pod.KIND, "bp0", touch)
+    rows1_before = p1.mirror_scoped_rows
+    p1.sync()  # foreign write: ignored entirely, cache kept
+    assert p1._mirror_cache is mc1
+    assert p1.mirror_scans_full == full1
+    assert p1.mirror_scoped_rows == rows1_before  # zero rows touched
+    rows0_before = p0.mirror_scoped_rows
+    p0.sync()  # own member: ONE scoped row, no full rescan
+    assert p0._mirror_cache is mc0
+    assert p0.mirror_scans_full == full0
+    assert p0.mirror_scoped_rows == rows0_before + 1
+    # classification work ∝ changed names, not O(pods): touch 2 of the
+    # 3 part0 members, the scoped pass pays exactly 2 rows
+    store.mutate(Pod.KIND, "bp0", touch)
+    store.mutate(Pod.KIND, "bp2", lambda p: touch(p))
+    rows0_before = p0.mirror_scoped_rows
+    p0.sync()
+    assert p0.mirror_scoped_rows == rows0_before + 2
+    assert p0.mirror_scans_full == full0
+
+
+def test_scoped_mirror_rescan_falls_back_on_membership_change():
+    """A completion (terminal transition) leaves the live set — the
+    scoped patch refuses and the full classification runs, exactly the
+    pre-change behavior."""
+    clock, cluster, client, store, providers = _two_partition_providers()
+    p0 = providers["part0"]
+    full0 = p0.mirror_scans_full
+    clock.now += 5000.0
+    cluster.step()  # everything completes agent-side
+    # sync 1: the completions arrive THROUGH the cursor path (the store
+    # was clean, so the cached working set drove it) and write phases
+    p0.sync()
+    assert p0.mirror_scans_full == full0
+    pods = [
+        p for p in store.list(Pod.KIND) if p.spec.partition == "part0"
+    ]
+    assert all(p.status.phase == PodPhase.SUCCEEDED for p in pods)
+    # sync 2: the terminal transitions left the live set — the scoped
+    # patch refuses (membership change) and the full classification
+    # runs, exactly the pre-change behavior
+    p0.sync()
+    assert p0.mirror_scans_full == full0 + 1
+
+
+def test_versioned_unschedulable_mark_emits_once_per_generation():
+    """Satellite b: an unchanged backlog warns once per backlog
+    generation (one fresh solve), not once per tick; a capacity change
+    opens a new generation and re-emits; the full tick keeps the
+    per-tick contract."""
+    from slurm_bridge_tpu.bridge.scheduler import PlacementScheduler
+    from slurm_bridge_tpu.bridge.objects import NodeCondition, VirtualNode
+
+    def build(incremental: bool):
+        clock = _Clock()
+        nodes = [
+            SimNode(name=f"n{i}", cpus=4, memory_mb=8000) for i in range(3)
+        ]
+        cluster = SimCluster(
+            nodes, {"part0": tuple(n.name for n in nodes)}, clock=clock
+        )
+        store = ObjectStore()
+        store.create(VirtualNode(
+            meta=Meta(name=partition_node_name("part0")),
+            partition="part0",
+            conditions=[NodeCondition(type="Ready", status=True)],
+        ))
+        pod = Pod(
+            meta=Meta(name="greedy"),
+            spec=PodSpec(
+                role=PodRole.SIZECAR,
+                partition="part0",
+                demand=JobDemand(
+                    partition="part0", script="#!/bin/sh\ntrue\n",
+                    cpus_per_task=64, job_name="greedy",
+                ),
+            ),
+        )
+        store.create(pod)
+        events = EventRecorder()
+        counts = {"PlacementFailed": 0}
+
+        def sink(ev):
+            if ev.reason == "PlacementFailed":
+                counts["PlacementFailed"] += 1
+
+        events.add_sink(sink)
+        sched = PlacementScheduler(
+            store,
+            SimWorkloadClient(cluster),
+            events=events,
+            inventory_ttl=0.0,
+            incremental=incremental,
+        )
+        return cluster, sched, counts
+
+    cluster, sched, counts = build(incremental=True)
+    for _ in range(4):
+        sched.tick()
+    assert counts["PlacementFailed"] == 1  # one generation, one warn
+    # a capacity change = a fresh solve = a new generation: re-emit
+    cluster.drain(["n0"])
+    sched.tick()
+    assert counts["PlacementFailed"] == 2
+    # the FULL tick keeps the level-triggered per-tick emission
+    cluster2, sched2, counts2 = build(incremental=False)
+    for _ in range(4):
+        sched2.tick()
+    assert counts2["PlacementFailed"] == 4
+
+
+def test_incumbent_rows_match_object_scan_and_cache_on_dirty_set():
+    """Satellite c: the columnar incumbent scan returns exactly
+    ``incumbent_pods()`` (names/hints/order), and in incremental mode
+    an unchanged store serves the cached row set without a re-walk."""
+    from slurm_bridge_tpu.bridge.scheduler import PlacementScheduler
+    from slurm_bridge_tpu.bridge.objects import PodStatus
+
+    clock = _Clock()
+    nodes = [SimNode(name=f"n{i}", cpus=16, memory_mb=32000) for i in range(4)]
+    cluster = SimCluster(
+        nodes, {"part0": tuple(n.name for n in nodes)}, clock=clock
+    )
+    store = ObjectStore()
+    for i in range(5):
+        pod = _bound_pod(f"inc{i}")
+        pod.spec.placement_hint = (f"n{i % 4}",)
+        pod.status = PodStatus(
+            phase=PodPhase.RUNNING, job_ids=(1000 + i,)
+        )
+        store.create(pod)
+    # one pod that must NOT qualify (no job ids yet)
+    store.create(_bound_pod("fresh"))
+    sched = PlacementScheduler(
+        store, SimWorkloadClient(cluster),
+        preemption=True, inventory_ttl=0.0, incremental=True,
+    )
+    rows = sched._incumbent_rows()
+    oracle = sched.incumbent_pods()
+    assert [r.name for r in rows] == [p.name for p in oracle]
+    assert [r.hint for r in rows] == [
+        tuple(p.spec.placement_hint) for p in oracle
+    ]
+    assert [r.uid for r in rows] == [p.meta.uid for p in oracle]
+    # unchanged store: the cached list is served as-is
+    assert sched._incumbent_rows() is rows
+    # a write anywhere rebuilds (and picks up the change)
+    def unbind(p: Pod):
+        p.spec.node_name = ""
+        p.spec.placement_hint = ()
+    store.mutate(Pod.KIND, "inc3", unbind)
+    rows2 = sched._incumbent_rows()
+    assert rows2 is not rows
+    assert [r.name for r in rows2] == [
+        p.name for p in sched.incumbent_pods()
+    ]
 
 
 def test_incremental_scheduler_resolves_after_inventory_change():

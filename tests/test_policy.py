@@ -413,7 +413,20 @@ def test_policy_off_matches_pr8_baseline_fixture():
         assert d["final_state_digest"] == want["final_state_digest"], (
             f"{name}: final state drifted"
         )
-        assert d["events"] == want["events"], f"{name}: event counts drifted"
+        # PlacementFailed compares by bound, not equality, since the
+        # versioned unschedulable mark (ISSUE 12 satellite b): the
+        # default incremental tick emits once per backlog generation,
+        # so warm-start re-emissions are deliberately absent. Every
+        # other event count stays byte-identical.
+        got = dict(d["events"])
+        exp = dict(want["events"])
+        got_pf, want_pf = got.pop("PlacementFailed", 0), exp.pop(
+            "PlacementFailed", 0
+        )
+        assert got == exp, f"{name}: event counts drifted"
+        assert 0 < got_pf <= want_pf if want_pf else got_pf == 0, (
+            f"{name}: PlacementFailed count out of the versioned-mark bound"
+        )
         assert d["bound_total"] == want["bound_total"]
         assert d["preempted_total"] == want["preempted_total"]
 
