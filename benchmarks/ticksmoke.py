@@ -252,7 +252,7 @@ def main() -> int:
         # the non-gating fsync-realism record (see wal_fsync_profile)
         print(json.dumps(wal_fsync_profile()))
         return 0
-    from benchmarks.stages import profile_reconcile, profile_tick
+    from benchmarks.stages import profile_decode, profile_reconcile, profile_tick
 
     budget_ms = float(os.environ.get("SBT_SMOKE_ENCODE_BUDGET_MS", "50"))
     min_speedup = float(os.environ.get("SBT_SMOKE_MIN_SPEEDUP", "3"))
@@ -266,12 +266,18 @@ def main() -> int:
     steady_budget_ms = float(
         os.environ.get("SBT_SMOKE_STEADY_BUDGET_MS", "50")
     )
+    decode_floor = float(
+        os.environ.get("SBT_SMOKE_DECODE_MIN_SPEEDUP", "1.2")
+    )
     out = profile_tick(1_000, 5_000, seed=2)
     rec = profile_reconcile(500)
+    dec = profile_decode(10_000)
     trace = profile_trace_overhead()
     wal = profile_wal_overhead()
     steady = profile_steady_tick()
     out["reconcile"] = rec
+    out["decode"] = dec
+    out["decode_min_speedup"] = decode_floor
     out["tracing"] = trace
     out["wal"] = wal
     out["steady"] = steady
@@ -301,6 +307,9 @@ def main() -> int:
         and steady["steady_tick_p50_ms"] is not None
         and steady["steady_tick_p50_ms"] <= steady_budget_ms
     )
+    # the ISSUE 14 wire-decode gate: coldec must decode column-identical
+    # to the pb2 path AND beat it by the floor multiple
+    decode_ok = dec["digest_identical"] and dec["coldec_speedup"] >= decode_floor
     ok = (
         out["encode_ms"] <= budget_ms
         and out["encode_speedup_vs_loop"] >= min_speedup
@@ -311,6 +320,7 @@ def main() -> int:
         and trace_ok
         and wal_ok
         and steady_ok
+        and decode_ok
     )
     out["ok"] = ok
     print(json.dumps(out))

@@ -49,6 +49,10 @@ def main(argv: list[str] | None = None) -> int:
         partition_config=partition_config,
         ledger_file=args.ledger or None,
         journal_file=args.journal or None,
+        # the wire agent serves JobsInfo as pre-assembled bytes (ISSUE
+        # 14): byte-compatible on the wire, skips the response-message
+        # copy+re-serialization per poll
+        serve_bytes=True,
     )
 
     interceptors = (tracing_interceptor(),)

@@ -224,8 +224,18 @@ class WorkloadServicer:
         ledger_file: str | None = None,
         journal_file: str | None = None,
         tail_poll_interval: float = 0.1,
+        serve_bytes: bool = False,
     ):
         self.driver = driver
+        #: serve JobsInfo responses as pre-assembled wire bytes (ISSUE
+        #: 14): the response is concatenated from per-entry
+        #: serializations instead of copy-assembling a JobsInfoResponse
+        #: and serializing it again. Off by default — in-process callers
+        #: (tests, embedders) expect message objects; ``sbt-agent``
+        #: turns it on, and the wire is byte-compatible either way
+        #: (generic_handler passes bytes through its response
+        #: serializer untouched).
+        self.serve_bytes = serve_bytes
         self.partition_config = partition_config or {}
         self.journal = None
         restored_cursors: dict = {}
@@ -549,9 +559,29 @@ class WorkloadServicer:
                     self.journal.checkpoint_with(self.ledger._journal_state)
             except OSError:
                 log.warning("could not journal JobsInfo cursor movement")
+        if self.serve_bytes:
+            return self._assemble_jobs_bytes(entries, ver)
         resp = pb.JobsInfoResponse(jobs=entries)
         resp.version = ver
         return resp
+
+    def _assemble_jobs_bytes(self, entries: list, ver: int) -> bytes:
+        """Pre-serialized ``JobsInfoResponse`` wire bytes, assembled
+        entry by entry: skips BOTH the per-entry message copy that
+        ``JobsInfoResponse(jobs=entries)`` pays and the second full-tree
+        serialization the response serializer would run. No caching —
+        ``run_time_s`` ticks inside every live entry, so cached bytes
+        would serve stale counters (the sim agent can splice because it
+        owns the layout; real entries carry arbitrary multi-info
+        shapes). Decodes identically to the message path — ``coldec``
+        and ``FromString`` alike."""
+        from slurm_bridge_tpu.wire.coldec import uvarint
+
+        parts: list[bytes] = []
+        for entry in entries:
+            raw = entry.SerializeToString()
+            parts.append(b"\x0a" + uvarint(len(raw)) + raw)
+        return b"".join(parts) + b"\x10" + uvarint(ver)
 
     def JobSteps(self, request: pb.JobStepsRequest, context) -> pb.JobStepsResponse:
         try:

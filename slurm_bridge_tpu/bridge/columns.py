@@ -724,3 +724,166 @@ class InfoScratch:
             "array_id": m.array_id,
             "reason": m.reason,
         })
+
+
+class ColdecScratch:
+    """:class:`InfoScratch`'s zero-object sibling (ISSUE 14): the same
+    tiered surface — signal arrays for the vectorized diff, tier-2 full
+    columns for changed rows, per-row frozen-JobInfo materialization for
+    the fallback — fed from :mod:`~slurm_bridge_tpu.wire.coldec` chunk
+    decodes instead of per-proto Python reads. Chunks append in request
+    order; rows NOT returned by any chunk land as UNKNOWN placeholders
+    at the tail (exactly where the pb2 path's ``add_unknown`` loop puts
+    them), so row order — and therefore every downstream diff, write and
+    digest — is identical to the pb2 path's by construction."""
+
+    __slots__ = (
+        "chunks", "row_of_jid", "arr", "_rows", "_tail", "_bounds", "_full",
+    )
+
+    def __init__(self):
+        self.chunks: list = []  # coldec.JobsInfoChunk, request order
+        self.row_of_jid: dict[int, int] = {}
+        self.arr: dict[str, np.ndarray] | None = None
+        self._rows = 0
+        self._tail: list[int] = []  # UNKNOWN job ids appended after chunks
+        self._bounds: np.ndarray | None = None
+        self._full: dict[str, np.ndarray] | None = None
+
+    def add_chunk(self, c) -> None:
+        """Fold one decoded ``JobsInfoResponse`` in (request order)."""
+        self.chunks.append(c)
+        d = self.row_of_jid
+        base = self._rows
+        jl = c.jid.tolist()
+        if len(set(jl)) == len(jl) and d.keys().isdisjoint(jl):
+            # the dominant case — every id new, no array sub-job rows:
+            # one bulk dict update instead of a per-row probe loop
+            d.update(zip(jl, range(base, base + len(jl))))
+        else:
+            for k, j in enumerate(jl):
+                if j in d:
+                    d[j] = -1  # duplicate rows for one id: fast map off
+                else:
+                    d[j] = base + k
+        self._rows += c.rows
+
+    def add_unknown(self, jid: int) -> None:
+        if jid in self.row_of_jid:
+            self.row_of_jid[jid] = -1
+        else:
+            self.row_of_jid[jid] = self._rows
+        self._tail.append(jid)
+        self._rows += 1
+
+    @property
+    def jid(self) -> np.ndarray:
+        return self.finalize()["jid"]
+
+    def _concat(self, name: str, tail_fill, dtype) -> np.ndarray:
+        parts = [getattr(c, name) for c in self.chunks]
+        if self._tail:
+            if dtype is object:
+                t = np.full(len(self._tail), tail_fill, object)
+            else:
+                t = np.full(len(self._tail), tail_fill, dtype)
+            parts.append(t)
+        if not parts:
+            return np.empty(0, dtype)
+        out = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        return out.astype(dtype, copy=False) if dtype is not object else out
+
+    def finalize(self) -> dict[str, np.ndarray]:
+        """Signal columns (jid + :data:`SIGNAL_COLS`), dtype-for-dtype
+        what ``InfoScratch.finalize`` hands the vectorized diff."""
+        if self.arr is None:
+            tail_ids = np.asarray(self._tail, np.int64)
+            unknown = int(JobStatus.UNKNOWN)
+            arr = {
+                "jid": self._concat("jid", 0, np.int64),
+                "id": self._concat("id", 0, np.int64),
+                "state": self._concat("state", unknown, np.int8),
+                "start_ts": self._concat("start_ts", 0, np.int64),
+                "exit_code": self._concat("exit_code", "", object),
+                "reason": self._concat("reason", "", object),
+                "limit": self._concat("limit", 0, np.int64),
+            }
+            if tail_ids.size:
+                n = self._rows
+                arr["jid"][n - tail_ids.size:] = tail_ids
+                arr["id"][n - tail_ids.size:] = tail_ids
+            self._bounds = np.concatenate(
+                ([0], np.cumsum([c.rows for c in self.chunks], dtype=np.int64))
+            ) if self.chunks else np.zeros(1, np.int64)
+            self.arr = arr
+        return self.arr
+
+    def _full_numeric(self) -> dict[str, np.ndarray]:
+        if self._full is None:
+            self._full = {
+                "submit_ts": self._concat("submit_ts", 0, np.int64),
+                "run_time": self._concat("run_time", 0, np.int64),
+                "num_nodes": self._concat("num_nodes", 0, np.int32),
+            }
+        return self._full
+
+    #: tier-2 object columns (lazy string spans in the chunks)
+    _OBJ_COLS = (
+        "user_id", "name", "workdir", "stdout", "stderr",
+        "partition", "nodelist", "batch_host", "array_id",
+    )
+
+    def full_cols(self, ks) -> dict[str, np.ndarray]:
+        """The 18-column write set for global rows ``ks`` — numeric
+        columns are gathers, strings materialize from the owning chunk's
+        spans for exactly these rows (the tier-2 contract)."""
+        from slurm_bridge_tpu.wire.coldec import materialize_strings
+
+        arr = self.finalize()
+        ks = np.asarray(ks, np.int64)
+        out = {c: arr[c][ks] for c in SIGNAL_COLS}
+        num = self._full_numeric()
+        for c in ("submit_ts", "run_time", "num_nodes"):
+            out[c] = num[c][ks]
+        obj = {c: np.full(int(ks.size), "", object) for c in self._OBJ_COLS}
+        bounds = self._bounds
+        ci = np.searchsorted(bounds, ks, side="right") - 1
+        for c_idx in np.unique(ci).tolist():
+            if c_idx >= len(self.chunks):
+                continue  # tail UNKNOWN rows: all-"" defaults stand
+            sel = np.nonzero(ci == c_idx)[0]
+            local = ks[sel] - bounds[c_idx]
+            chunk = self.chunks[c_idx]
+            for cname in self._OBJ_COLS:
+                s, ln = chunk.str_spans[cname]
+                obj[cname][sel] = materialize_strings(
+                    chunk.data, s[local], ln[local]
+                )
+        out.update(obj)
+        return out
+
+    def info_object(self, i: int) -> JobInfo:
+        """One frozen JobInfo for global row ``i`` — the per-pod fallback
+        path, field-for-field ``InfoScratch.info_object``."""
+        full = self.full_cols(np.asarray([i], np.int64))
+        arr = self.finalize()
+        return _frozen_shell(JobInfo, {
+            "id": int(arr["id"][i]),
+            "user_id": full["user_id"][0],
+            "name": full["name"][0],
+            "exit_code": full["exit_code"][0],
+            "state": JOBSTATUS_BY_CODE[int(arr["state"][i])],
+            "submit_time": dt_of_ts(int(full["submit_ts"][0])),
+            "start_time": dt_of_ts(int(arr["start_ts"][i])),
+            "run_time_s": int(full["run_time"][0]),
+            "time_limit_s": int(arr["limit"][i]),
+            "working_dir": full["workdir"][0],
+            "std_out": full["stdout"][0],
+            "std_err": full["stderr"][0],
+            "partition": full["partition"][0],
+            "node_list": full["nodelist"][0],
+            "batch_host": full["batch_host"][0],
+            "num_nodes": int(full["num_nodes"][0]),
+            "array_id": full["array_id"][0],
+            "reason": full["reason"][0],
+        })

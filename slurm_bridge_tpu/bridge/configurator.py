@@ -38,6 +38,7 @@ class Configurator:
         provider_inventory_ttl: float | None = None,
         provider_status_interval: float | None = None,
         incremental: bool = False,
+        use_coldec: bool = True,
     ):
         self.store = store
         self.client = client
@@ -57,6 +58,9 @@ class Configurator:
         self.provider_status_interval = provider_status_interval
         #: event-driven incremental mirror (PR-11), forwarded per provider
         self.incremental = incremental
+        #: zero-object wire->column decode (ISSUE 14), forwarded per
+        #: provider; off = the pb2 bulk path byte-for-byte
+        self.use_coldec = use_coldec
         self.providers: dict[str, VirtualNodeProvider] = {}
         self._tickers: dict[str, Ticker] = {}
         self._watch = Ticker(watch_interval, self.reconcile, name="configurator")
@@ -145,6 +149,7 @@ class Configurator:
             events=self.events,
             sync_workers=self.pod_sync_workers,
             incremental=self.incremental,
+            use_coldec=self.use_coldec,
             **kwargs,
         )
         provider.register()

@@ -64,6 +64,11 @@ def main(argv: list[str] | None = None) -> int:
                              "dirty-set pending scan and warm-start "
                              "solve reuse — on by default, this flag "
                              "restores the full O(cluster) tick")
+    parser.add_argument("--no-coldec", action="store_true",
+                        help="disable the zero-object wire->column "
+                             "decode of the bulk RPCs (ISSUE 14): on by "
+                             "default, this flag keeps every response "
+                             "on the pb2 object path")
     parser.add_argument("--threads", type=int, default=2,
                         help="operator reconciler workers (--slurm-bridge-operator-threads)")
     parser.add_argument("--configurator-interval", type=float, default=30.0)
@@ -146,6 +151,7 @@ def main(argv: list[str] | None = None) -> int:
         policy=policy,
         shard=shard,
         incremental=not args.no_incremental,
+        use_coldec=not args.no_coldec,
         state_file=args.state_file,
         configurator_interval=args.configurator_interval,
         operator_workers=args.threads,

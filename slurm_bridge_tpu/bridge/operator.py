@@ -15,6 +15,7 @@ fields override them, default 1 node / 1 cpu / 1024 MB-per-cpu
 from __future__ import annotations
 
 import logging
+from functools import lru_cache
 import os
 import queue
 import time
@@ -95,6 +96,9 @@ _STATE_REASONS = {
 #: shared empty job_infos for worker pods — immutable, so aliasing across
 #: 45k creates per sweep is safe and skips a FrozenList build each
 _EMPTY_FROZEN_LIST = FrozenList()
+#: shared empty annotation map for born-frozen creates (immutable, so
+#: sharing across pods is safe; writers always build replacement dicts)
+_EMPTY_FROZEN_DICT = FrozenDict()
 
 #: CR-state int8 codes the columnar sweep uses
 _ST_RUNNING = STATE_CODE[JobState.RUNNING]
@@ -126,12 +130,24 @@ def fetch_job_name(job_name: str) -> str:
     return f"{job_name}-fetch"
 
 
+@lru_cache(maxsize=512)
+def _parsed_header(script: str):
+    """Memoized #SBATCH header parse: a 500k-arrival storm submits the
+    same handful of script bodies over and over, and re-parsing the
+    headers per job was ~0.4 s per 100k sweeps (ISSUE 14)."""
+    return extract_batch_resources(script).demand
+
+
 def demand_for_job(job: BridgeJob) -> JobDemand:
     """Script #SBATCH headers, overridden by explicit spec fields, with the
-    reference defaults (pod.go:18-95)."""
-    hdr = extract_batch_resources(job.spec.sbatch_script).demand
+    reference defaults (pod.go:18-95). Born FROZEN via ``frozen_new`` —
+    every field scalar, so commit-time freeze stops at one probe instead
+    of a 19-field walk per pod (ISSUE 14; the storm creates one demand
+    per arrival)."""
+    hdr = _parsed_header(job.spec.sbatch_script)
     spec = job.spec
-    return JobDemand(
+    return frozen_new(
+        JobDemand,
         partition=spec.partition or hdr.partition,
         script=spec.sbatch_script,
         job_name=job.meta.name,
@@ -148,6 +164,7 @@ def demand_for_job(job: BridgeJob) -> JobDemand:
         licenses=spec.licenses,
         time_limit_s=hdr.time_limit_s,
         priority=spec.priority,
+        nodelist=(),
     )
 
 
@@ -848,20 +865,25 @@ class BridgeOperator:
                 if val:
                     labels[key] = val
         # fast_new (every field explicit): one sizecar per arrival, 50k
-        # deep on a cold-start tick, against freeze-guarded classes
+        # deep on a cold-start tick, against freeze-guarded classes.
+        # spec/status (and the demand, born frozen in demand_for_job) are
+        # pre-frozen and the label/annotation dicts pre-wrapped (ISSUE
+        # 14): commit-time freeze used to re-walk ~45 fields per pod —
+        # demand's 19 included — which was a third of the 500k arrive
+        # storm; now it probes meta's fields and stops.
         return fast_new(
             Pod,
             meta=fast_new(
                 Meta,
                 name=sizecar_name(job.meta.name),
                 uid=new_uid(),
-                labels=labels,
-                annotations={},
+                labels=FrozenDict(labels),
+                annotations=_EMPTY_FROZEN_DICT,
                 owner=job.meta.name,
                 resource_version=0,
                 deleted=False,
             ),
-            spec=fast_new(
+            spec=frozen_new(
                 PodSpec,
                 role=PodRole.SIZECAR,
                 partition=demand.partition,
@@ -869,13 +891,13 @@ class BridgeOperator:
                 node_name="",
                 placement_hint=(),
             ),
-            status=fast_new(
+            status=frozen_new(
                 PodStatus,
                 phase=PodPhase.PENDING,
                 reason="",
                 job_ids=(),
-                job_infos=[],
-                containers=[],
+                job_infos=_EMPTY_FROZEN_LIST,
+                containers=_EMPTY_FROZEN_LIST,
             ),
         )
 

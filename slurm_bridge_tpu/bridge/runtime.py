@@ -67,6 +67,7 @@ class Bridge:
         policy=None,
         shard=None,
         incremental: bool = True,
+        use_coldec: bool = True,
     ):
         self.agent_endpoint = agent_endpoint
         self.store = ObjectStore()
@@ -96,6 +97,9 @@ class Bridge:
                 codes=TRANSIENT_CODES,
                 method_budgets=DEFAULT_METHOD_BUDGETS,
             ),
+            # raw-bytes twins for the bulk RPCs (ISSUE 14): the mirror
+            # decodes responses straight into columns when enabled
+            coldec=use_coldec,
         )
         self.operator = BridgeOperator(
             self.store,
@@ -112,6 +116,7 @@ class Bridge:
             node_sync_interval=node_sync_interval,
             pod_sync_workers=pod_sync_workers,
             incremental=incremental,
+            use_coldec=use_coldec,
         )
         self.scheduler = PlacementScheduler(
             self.store,

@@ -32,6 +32,7 @@ from slurm_bridge_tpu.bridge.columns import (
     PHASE_CODE,
     PHASE_OF_SINGLE_STATE,
     SIGNAL_COLS,
+    ColdecScratch,
     InfoScratch,
 )
 from slurm_bridge_tpu.bridge.objects import (
@@ -58,6 +59,7 @@ from slurm_bridge_tpu.obs.events import EventRecorder, Reason
 from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.obs.tracing import TRACER, with_current_span
 from slurm_bridge_tpu.wire import ServiceClient, pb
+from slurm_bridge_tpu.wire import coldec
 from slurm_bridge_tpu.wire.convert import (
     NodesDecodeCache,
     PartitionDecodeCache,
@@ -101,6 +103,14 @@ _diff_fallback_rows = REGISTRY.counter(
     "pod status rows that fell back to the per-object diff "
     "(multi-job pods, conflicts, odd segment shapes)",
 )
+
+#: bulk method → the raw-bytes client attribute the coldec path dials
+#: (same RPC on the wire; identity response-deserializer client-side)
+_BYTES_RPCS = {
+    "JobsInfo": "JobsInfoBytes",
+    "Nodes": "NodesBytes",
+    "SubmitJobs": "SubmitJobsBytes",
+}
 
 #: pod-phase int8 codes the columnar classification uses
 _PH_PENDING = PHASE_CODE["Pending"]
@@ -273,6 +283,7 @@ class VirtualNodeProvider:
         sync_workers: int = 10,
         status_interval: float = 10.0,
         incremental: bool = False,
+        use_coldec: bool = True,
     ):
         self.store = store
         self.client = client
@@ -327,6 +338,15 @@ class VirtualNodeProvider:
         #: columnar store + bulk RPCs; anything on a fallback path runs
         #: the full mirror unchanged.
         self.incremental = incremental
+        #: the zero-object wire→column decode (ISSUE 14). On, the bulk
+        #: RPCs are dialed through their raw-bytes twins (when the client
+        #: exposes them — the real ServiceClient and the sim fake do; any
+        #: duck-typed test client silently keeps the pb2 path) and
+        #: responses decode straight into columns. Off — or after a
+        #: remembered per-method fallback (schema drift, malformed
+        #: bytes) — the PR-12 pb2 tick runs byte-for-byte.
+        self.use_coldec = use_coldec and coldec.available()
+        self._coldec_fallback: set[str] = set()
         self._part_decode = PartitionDecodeCache()
         #: store-side cursor: Pod rv watermark of the last classification
         self._scan_rv = 0
@@ -377,12 +397,27 @@ class VirtualNodeProvider:
                 return part, []
         else:
             part = partition_from_proto(part_resp)
-            nodes = self._nodes_decode.decode(
-                self.client.Nodes(pb.NodesRequest(names=list(part.nodes)))
-            )
+            nodes = self._nodes_full(part)
         with self._inv_lock:
             self._inv = (time.monotonic(), part, nodes)
         return part, nodes
+
+    def _nodes_full(self, part: PartitionInfo) -> list[NodeInfo]:
+        """The full (non-cursor) Nodes fetch: one RPC, decoded through
+        the coldec bytes path when available — the content-keyed memo now
+        keys on the raw buffer itself, so the steady-state skip costs one
+        bytes compare instead of a deterministic re-serialization."""
+        req = pb.NodesRequest(names=list(part.nodes))
+        bytes_fn = self._bytes_rpc("Nodes")
+        if bytes_fn is None:
+            return self._nodes_decode.decode(self.client.Nodes(req))
+        raw = bytes_fn(req)
+        try:
+            dec = self._nodes_decode.decode_bytes(raw)
+        except coldec.DecodeError as e:
+            self._coldec_fall_back("Nodes", str(e))
+            return self._nodes_decode.decode(pb.NodesResponse.FromString(raw))
+        return dec.nodes
 
     def _nodes_incremental(self, part: PartitionInfo) -> list[NodeInfo] | None:
         """The cursor-bearing Nodes fetch (PR-11): one RPC either way —
@@ -409,7 +444,26 @@ class VirtualNodeProvider:
             req.since_version = (
                 self._nodes_cursor if self._nodes_cache is not None else 0
             )
-            resp = self.client.Nodes(req)
+            bytes_fn = self._bytes_rpc("Nodes")
+            if bytes_fn is not None:
+                raw = bytes_fn(req)
+                try:
+                    dec = self._nodes_decode.decode_bytes(raw)
+                except coldec.DecodeError as e:
+                    self._coldec_fall_back("Nodes", str(e))
+                    dec = None
+                if dec is not None:
+                    if dec.unchanged:
+                        if self._nodes_cache is not None:
+                            return self._nodes_cache
+                        # same degenerate posture as the pb2 branch below
+                        return None
+                    self._nodes_cache = dec.nodes
+                    self._nodes_cursor = dec.version
+                    return dec.nodes
+                resp = pb.NodesResponse.FromString(raw)
+            else:
+                resp = self.client.Nodes(req)
             if resp.unchanged:
                 if self._nodes_cache is not None:
                     return self._nodes_cache
@@ -427,6 +481,124 @@ class VirtualNodeProvider:
             self._nodes_cache = nodes
             self._nodes_cursor = int(resp.version)
             return nodes
+
+    # ---- the zero-object decode seams (ISSUE 14) ----
+
+    def _bytes_rpc(self, method: str):
+        """The raw-bytes callable for a bulk method, or None when the
+        coldec path is off, remembered-fallen-back, or the client does
+        not expose the bytes twin (duck-typed fakes, FaultyClient —
+        which masks it so fault draws stay on the pb2 sequence)."""
+        if not self.use_coldec or method in self._coldec_fallback:
+            return None
+        return getattr(self.client, _BYTES_RPCS[method], None)
+
+    def _coldec_fall_back(self, method: str, why: str) -> None:
+        """Remember a per-method pb2 fallback (same pattern as the
+        bulk-submit UNIMPLEMENTED memory)."""
+        self._coldec_fallback.add(method)
+        coldec.fallback_counter().inc(method=method)
+        log.warning(
+            "coldec %s decode fell back to the pb2 path: %s", method, why
+        )
+
+    def _bulk_status_bytes(self, bytes_fn, reqs: list) -> tuple[str, object, list]:
+        """Issue the chunked JobsInfo round-trips through the bytes path,
+        decoding each response into columns INSIDE the pool worker that
+        fetched it (the NumPy kernels run while other chunks are still
+        on the wire). Returns ``(state, scratch, versions)``:
+
+        - ``("ok", scratch, versions)`` — every chunk fetched+decoded;
+        - ``("unimplemented", None, [])`` — agent lacks JobsInfo (caller
+          flips the provider, exactly the pb2 path's handling);
+        - ``("abort", None, [])`` — transient RPC failure: apply nothing,
+          keep cursors (the level-triggered retry heals next sync);
+        - ``("fallback", None, [])`` — malformed bytes: the method is
+          remembered onto the pb2 path and the caller re-queries there.
+
+        Chunk results merge in REQUEST order regardless of completion
+        order, so the scratch's row layout — and everything downstream —
+        is deterministic."""
+        results: list = [None] * len(reqs)
+
+        def fetch(i: int) -> None:
+            try:
+                raw = bytes_fn(reqs[i])
+            except grpc.RpcError as e:
+                results[i] = ("rpc", e)
+                return
+            try:
+                results[i] = ("ok", coldec.decode_jobs_info(raw))
+            except coldec.DecodeError as e:
+                results[i] = ("dec", e)
+
+        if len(reqs) > 1:
+            self._pool_map(fetch, list(range(len(reqs))))
+        elif reqs:
+            fetch(0)
+        for kind, payload in results:
+            if kind == "rpc":
+                if payload.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    self._bulk_supported = False
+                    _bulk_fallbacks.inc()
+                    log.warning(
+                        "agent does not implement JobsInfo; "
+                        "falling back to per-pod status queries"
+                    )
+                    return "unimplemented", None, []
+                log.warning("bulk status query failed: %s", payload.details())
+                return "abort", None, []
+            if kind == "dec":
+                self._coldec_fall_back("JobsInfo", str(payload))
+                return "fallback", None, []
+        scratch = ColdecScratch()
+        versions: list[int] = []
+        rows = 0
+        for _, chunk in results:
+            _bulk_queries.inc()
+            scratch.add_chunk(chunk)
+            versions.append(chunk.version)
+            rows += chunk.rows
+        coldec.rows_counter().inc(rows)
+        return "ok", scratch, versions
+
+    def _bulk_status_pb2(self, reqs: list, names: list):
+        """The pb2 chunk loop shared by the full and cursor status paths
+        — and the re-query target when a coldec decode falls back.
+        Returns ``(scratch, versions)``; ``(None, None)`` means the
+        error was handled (UNIMPLEMENTED flipped the provider and
+        converged per pod; a transient failure applied nothing) and the
+        caller just returns."""
+        scratch = InfoScratch()
+        versions: list[int] = []
+        for req in reqs:
+            try:
+                resp = self.client.JobsInfo(req)
+            except grpc.RpcError as e:
+                if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    self._bulk_supported = False
+                    _bulk_fallbacks.inc()
+                    log.warning(
+                        "agent does not implement JobsInfo; "
+                        "falling back to per-pod status queries"
+                    )
+                    self._converge_names(names)
+                    return None, None
+                # transient: apply NOTHING and keep cursors — the next
+                # successful pass re-delivers everything missed (the
+                # level-triggered keep-current-statuses posture)
+                log.warning("bulk status query failed: %s", e.details())
+                return None, None
+            _bulk_queries.inc()
+            versions.append(int(resp.version))
+            for entry in resp.jobs:
+                jid = int(entry.job_id)
+                if not entry.found or not len(entry.info):
+                    scratch.add_unknown(jid)
+                    continue
+                for m in entry.info:
+                    scratch.add_proto(jid, m)
+        return scratch, versions
 
     def capacity(self) -> tuple[dict[str, float], dict[str, float]]:
         """(capacity, allocatable) summed over member nodes
@@ -860,8 +1032,19 @@ class VirtualNodeProvider:
                 sent.append(it)
             if not sent:
                 return
+            bytes_fn = self._bytes_rpc("SubmitJobs")
+            results_cols = None
+            resp = None
             try:
-                resp = self.client.SubmitJobs(breq)
+                if bytes_fn is not None:
+                    raw = bytes_fn(breq)
+                    try:
+                        results_cols = coldec.decode_submit_jobs(raw)
+                    except coldec.DecodeError as e:
+                        self._coldec_fall_back("SubmitJobs", str(e))
+                        resp = pb.SubmitJobsResponse.FromString(raw)
+                else:
+                    resp = self.client.SubmitJobs(breq)
             except grpc.RpcError as e:
                 if e.code() == grpc.StatusCode.UNIMPLEMENTED:
                     self._batch_submit_supported = False
@@ -892,26 +1075,51 @@ class VirtualNodeProvider:
                         pass
                 return
             _submit_bulk.inc()
-            if len(resp.results) != len(sent):
+            n_results = (
+                results_cols.n if results_cols is not None else len(resp.results)
+            )
+            if n_results != len(sent):
                 log.warning(
                     "SubmitJobs returned %d results for %d requests; ignoring",
-                    len(resp.results), len(sent),
+                    n_results, len(sent),
                 )
                 return
             accepted: list[tuple[_SubmitItem, int]] = []
             pending: list[tuple[_SubmitItem, str]] = []
             rejected: list[tuple[_SubmitItem, str]] = []
-            for it, entry in zip(sent, resp.results):
-                if entry.ok:
-                    accepted.append((it, int(entry.job_id)))
-                    continue
-                code = getattr(
-                    grpc.StatusCode, entry.error_code, grpc.StatusCode.UNKNOWN
-                )
-                if code in _TRANSIENT_RPC:
-                    pending.append((it, entry.error_code))
+            if results_cols is not None:
+                coldec.rows_counter().inc(results_cols.n)
+                if results_cols.all_ok:
+                    # the dominant storm shape: one vectorized column
+                    # read, no per-entry proto objects at all
+                    accepted = list(zip(sent, results_cols.job_id.tolist()))
                 else:
-                    rejected.append((it, entry.error or entry.error_code))
+                    oks = results_cols.ok
+                    jids = results_cols.job_id.tolist()
+                    for i, it in enumerate(sent):
+                        if oks[i]:
+                            accepted.append((it, jids[i]))
+                            continue
+                        ecode = results_cols.error_code[i]
+                        code = getattr(
+                            grpc.StatusCode, ecode, grpc.StatusCode.UNKNOWN
+                        )
+                        if code in _TRANSIENT_RPC:
+                            pending.append((it, ecode))
+                        else:
+                            rejected.append((it, results_cols.error[i] or ecode))
+            else:
+                for it, entry in zip(sent, resp.results):
+                    if entry.ok:
+                        accepted.append((it, int(entry.job_id)))
+                        continue
+                    code = getattr(
+                        grpc.StatusCode, entry.error_code, grpc.StatusCode.UNKNOWN
+                    )
+                    if code in _TRANSIENT_RPC:
+                        pending.append((it, entry.error_code))
+                    else:
+                        rejected.append((it, entry.error or entry.error_code))
             if accepted:
                 self._commit_submits(accepted, span)
             for it, code_name in pending:
@@ -995,31 +1203,25 @@ class VirtualNodeProvider:
                 if jid not in seen:
                     seen.add(jid)
                     ids.append(jid)
-        scratch = InfoScratch()
-        for lo in range(0, len(ids), _BULK_CHUNK):
-            chunk = ids[lo : lo + _BULK_CHUNK]
-            try:
-                resp = self.client.JobsInfo(pb.JobsInfoRequest(job_ids=chunk))
-            except grpc.RpcError as e:
-                if e.code() == grpc.StatusCode.UNIMPLEMENTED:
-                    self._bulk_supported = False
-                    _bulk_fallbacks.inc()
-                    log.warning(
-                        "agent does not implement JobsInfo; "
-                        "falling back to per-pod status queries"
-                    )
-                    self._converge_names(rb.names)
-                    return
-                log.warning("bulk status query failed: %s", e.details())
+        scratch = None
+        reqs = [
+            pb.JobsInfoRequest(job_ids=ids[lo : lo + _BULK_CHUNK])
+            for lo in range(0, len(ids), _BULK_CHUNK)
+        ]
+        bytes_fn = self._bytes_rpc("JobsInfo")
+        if bytes_fn is not None:
+            state, scratch, _ = self._bulk_status_bytes(bytes_fn, reqs)
+            if state == "unimplemented":
+                self._converge_names(rb.names)
                 return
-            _bulk_queries.inc()
-            for entry in resp.jobs:
-                jid = int(entry.job_id)
-                if not entry.found or not len(entry.info):
-                    scratch.add_unknown(jid)
-                    continue
-                for m in entry.info:
-                    scratch.add_proto(jid, m)
+            if state == "abort":
+                return
+            # "fallback": malformed bytes — re-query below on the
+            # remembered pb2 path (rare; digest-identical by the fuzz)
+        if scratch is None:
+            scratch, _ = self._bulk_status_pb2(reqs, rb.names)
+            if scratch is None:
+                return
         for jid in ids:
             if jid not in scratch.row_of_jid:
                 scratch.add_unknown(jid)
@@ -1138,40 +1340,30 @@ class VirtualNodeProvider:
     def _refresh_statuses_incr_traced(self, table, mc: _MirrorCache, span) -> None:
         rb = mc.rb
         cursor = self._jobs_cursor
-        scratch = InfoScratch()
-        versions: list[int] = []
+        # cursors restamped BEFORE the fan-out: the bytes path serializes
+        # the shared request protos from pool workers concurrently
         for req, full in zip(mc.reqs, mc.full_chunk):
             req.since_version = 0 if full else cursor
-            try:
-                resp = self.client.JobsInfo(req)
-            except grpc.RpcError as e:
-                if e.code() == grpc.StatusCode.UNIMPLEMENTED:
-                    self._bulk_supported = False
-                    _bulk_fallbacks.inc()
-                    log.warning(
-                        "agent does not implement JobsInfo; "
-                        "falling back to per-pod status queries"
-                    )
-                    self._converge_names(rb.names)
-                    return
-                # transient: apply NOTHING and keep the cursor — the next
-                # successful pass re-delivers everything missed (exactly
-                # the full path's keep-current-statuses posture)
-                log.warning("bulk status query failed: %s", e.details())
+        scratch = None
+        versions: list[int] = []
+        bytes_fn = self._bytes_rpc("JobsInfo")
+        if bytes_fn is not None:
+            state, scratch, versions = self._bulk_status_bytes(
+                bytes_fn, mc.reqs
+            )
+            if state == "unimplemented":
+                self._converge_names(rb.names)
                 return
-            _bulk_queries.inc()
-            versions.append(int(resp.version))
-            for entry in resp.jobs:
-                jid = int(entry.job_id)
-                if not entry.found or not len(entry.info):
-                    scratch.add_unknown(jid)
-                    continue
-                for m in entry.info:
-                    scratch.add_proto(jid, m)
+            if state == "abort":
+                return
+        if scratch is None:
+            scratch, versions = self._bulk_status_pb2(mc.reqs, rb.names)
+            if scratch is None:
+                return
         span.count("jobs_queried", len(mc.ids))
         span.count("rows_decoded", len(scratch.jid))
         new_cursor = min(versions) if versions else 0
-        if scratch.jid:
+        if len(scratch.jid):
             self._apply_status_changes(table, mc, scratch, span)
         else:
             span.count("writes", 0)

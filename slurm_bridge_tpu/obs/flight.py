@@ -28,6 +28,7 @@ behind.
 from __future__ import annotations
 
 import contextlib
+import threading
 from collections import deque
 
 import numpy as np
@@ -39,11 +40,17 @@ from slurm_bridge_tpu.obs.tracing import TRACER, Span, Tracer
 #: a ``phases_ms`` view out of the span tree (must stay in lockstep with
 #: the wiring in bridge/scheduler.py and sim/harness.py)
 PHASE_SPANS = {
+    "arrive": ("sim.arrive",),
     "store": ("scheduler.store",),
     "encode": ("scheduler.encode",),
     "solve": ("scheduler.solve",),
     "bind": ("scheduler.bind",),
     "mirror": ("sim.mirror",),
+    #: the harness's own bookkeeping — ground-truth step, invariant
+    #: checks, quality sampling, digest notes. Named (ISSUE 14) so the
+    #: phase-sum reconciliation holds at the 500k shape, where this used
+    #: to be seconds of unattributed root-span self time.
+    "verify": ("sim.verify",),
 }
 
 
@@ -130,20 +137,76 @@ class FlightRecorder:
         self.capacity = capacity
         self.top_n = top_n
         self.records: list[dict] = []
-        #: keep-NEWEST ring: spans finish children-first, so when a
-        #: front-loaded cold tick's 50k per-arrival reconcile spans
-        #: overflow the window, the early flood is what gets evicted —
-        #: the phase spans (scheduler store/encode/solve/bind, mirror,
-        #: sweep) all close near tick end and survive, keeping the phase
-        #: tree intact. Evictions are counted in ``spans_dropped``.
+        #: raw-span ring (debugging / tracez): keep-NEWEST, evictions
+        #: counted in ``spans_dropped``. Since ISSUE 14 the RECORD no
+        #: longer depends on it — every finishing span folds into the
+        #: per-path/per-name rollups below at export time, so a 500k-span
+        #: storm tick overflowing the ring still produces exact path
+        #: totals and the phase-sum reconciliation holds at any scale.
         self._spans: deque[Span] = deque(maxlen=capacity)
         self._dropped = 0
+        self._truncated = 0
+        self._seen = 0
+        self._lock = threading.Lock()
+        #: name-path tuple → [count, total_ms, counters|None]
+        self._paths: dict[tuple, list] = {}
+        #: name → [count, total_ms, self_ms]
+        self._names: dict[str, list] = {}
+        #: open-span id → summed child duration (popped at finish)
+        self._child_sum: dict[str, float] = {}
 
     # -- exporter interface (the capture sink) -----------------------------
     def export(self, span: Span) -> None:
-        if len(self._spans) == self._spans.maxlen:
-            self._dropped += 1
-        self._spans.append(span)
+        dur = span.duration
+        # resolve the full name path NOW: ancestors are still open (a
+        # span always finishes before its parent), and the Span.parent
+        # chain reaches them without any lookup table
+        parts = [span.name]
+        p = span.parent
+        depth = 0
+        while p is not None and depth < 64:
+            parts.append(p.name)
+            p = p.parent
+            depth += 1
+        truncated = p is not None  # >64 ancestors: pathological nesting
+        parts.reverse()
+        path = tuple(parts)
+        ms = dur * 1e3
+        with self._lock:
+            self._seen += 1
+            if truncated:
+                # a truncated path cannot anchor under the root and
+                # would silently vanish from the tree — count it so the
+                # reconciliation gate's failure is explicable
+                self._truncated += 1
+            if len(self._spans) == self._spans.maxlen:
+                self._dropped += 1
+            self._spans.append(span)
+            child = self._child_sum.pop(span.span_id, 0.0)
+            if span.parent_id:
+                self._child_sum[span.parent_id] = (
+                    self._child_sum.get(span.parent_id, 0.0) + dur
+                )
+            self_ms = max(0.0, dur - child) * 1e3
+            ent = self._names.get(span.name)
+            if ent is None:
+                self._names[span.name] = [1, ms, self_ms]
+            else:
+                ent[0] += 1
+                ent[1] += ms
+                ent[2] += self_ms
+            pent = self._paths.get(path)
+            if pent is None:
+                pent = self._paths[path] = [0, 0.0, None]
+            pent[0] += 1
+            pent[1] += ms
+            if span.counters:
+                if pent[2] is None:
+                    pent[2] = dict(span.counters)
+                else:
+                    acc = pent[2]
+                    for k, v in span.counters.items():
+                        acc[k] = acc.get(k, 0.0) + v
 
     # -- the capture window ------------------------------------------------
     @contextlib.contextmanager
@@ -153,6 +216,11 @@ class FlightRecorder:
             return
         self._spans.clear()
         self._dropped = 0
+        self._truncated = 0
+        self._seen = 0
+        self._paths = {}
+        self._names = {}
+        self._child_sum = {}
         commits0 = (
             self.store.commit_counts_snapshot() if self.store is not None else {}
         )
@@ -169,8 +237,29 @@ class FlightRecorder:
                     self._build(tick_no, root, commits0, counters0)
                 )
 
+    def _tree_from_paths(self, root: Span) -> dict:
+        """The name-keyed span tree rebuilt from the per-path rollup —
+        same shape ``_tree`` produced from raw spans, but exact under
+        ring eviction (dropped spans already contributed at export)."""
+        root_node: dict = {"ms": 0.0, "count": 0}
+        for path in sorted(self._paths):
+            if not path or path[0] != root.name:
+                continue  # ambient spans outside the tick trace
+            count, ms, counters = self._paths[path]
+            node = root_node
+            for name in path[1:]:
+                node = node.setdefault("children", {}).setdefault(
+                    name, {"ms": 0.0, "count": 0}
+                )
+            node["ms"] = round(node["ms"] + ms, 3)
+            node["count"] += count
+            if counters:
+                node["counters"] = {
+                    k: counters[k] for k in sorted(counters)
+                }
+        return {root.name: root_node}
+
     def _build(self, tick_no, root, commits0, counters0) -> dict:
-        spans = [s for s in self._spans if s is not root]
         commits: dict[str, int] = {}
         if self.store is not None:
             for key, n in self.store.commit_counts_snapshot().items():
@@ -182,14 +271,15 @@ class FlightRecorder:
             for name, total in REGISTRY.counter_totals().items()
             if total != counters0.get(name, 0.0)
         }
-        agg = _self_times(spans, root)
+        agg = self._names
         top = sorted(agg.items(), key=lambda kv: -kv[1][2])[: self.top_n]
         return {
             "tick": tick_no,
             "total_ms": round(root.duration * 1e3, 3),
-            "spans": len(spans) + 1,
+            "spans": self._seen,
             "spans_dropped": self._dropped,
-            "tree": _tree(spans, root),
+            "paths_truncated": self._truncated,
+            "tree": self._tree_from_paths(root),
             "top_self_ms": [
                 {
                     "name": name,

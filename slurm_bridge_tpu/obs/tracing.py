@@ -70,6 +70,12 @@ class Span:
     #: recorder's phase arithmetic matches the perf-timed tick headline
     _mono0: float = 0.0
     _mono1: float = 0.0
+    #: the parent Span OBJECT (not just its id) — children finish before
+    #: their ancestors, so an exporter can resolve the full name path of
+    #: a finishing span by walking this chain while the ancestors are
+    #: still open. The flight recorder's per-path rollup (ISSUE 14)
+    #: depends on it; never exported, never compared.
+    parent: "Span | None" = None
 
     @property
     def duration(self) -> float:
@@ -407,6 +413,7 @@ class Tracer:
             parent_id=parent_id,
             tags=merged,
             sampled=sampled,
+            parent=parent,
         )
         return _SpanContext(self, span)
 

@@ -652,6 +652,27 @@ def main(argv: list[str] | None = None) -> int:
                 f"{name}: tick_p50_ms {result.timing['tick_p50_ms']} over "
                 f"the {sc.p50_gate_ms} ms gate"
             )
+        if sc.phase_reconcile_pct is not None and sc.tracing:
+            # the PR-5 ±5% flight-record contract, re-enforced at the
+            # headline shape (ISSUE 14): the span-derived per-phase sum
+            # must explain the tick span — a hollowed tree (dropped
+            # spans, an unattributed phase) fails loudly instead of
+            # silently lying about where the tick went
+            fr = result.flight_record
+            tick_span = fr.get("tick_span_p50_ms") or 0.0
+            phase_sum = fr.get("phase_sum_p50_ms") or 0.0
+            if tick_span <= 0.0:
+                gate_failures.append(
+                    f"{name}: phase_reconcile_pct set but no flight record"
+                )
+            elif (
+                abs(tick_span - phase_sum) / tick_span * 100.0
+                > sc.phase_reconcile_pct
+            ):
+                gate_failures.append(
+                    f"{name}: phase_sum_p50_ms {phase_sum} vs tick span "
+                    f"{tick_span} drifts over ±{sc.phase_reconcile_pct}%"
+                )
         if sc.steady_gate_ms is not None and sc.incremental:
             steady = result.timing.get("steady_tick_p50_ms")
             if steady is None:

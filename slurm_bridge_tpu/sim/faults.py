@@ -364,7 +364,18 @@ class FaultyClient:
                 entries[i] = entry
         return pb.SubmitJobsResponse(results=entries)
 
+    #: the raw-bytes bulk twins (ISSUE 14) are deliberately MASKED: a
+    #: fault window must keep manipulating structured responses (per-job
+    #: lost_status freezes, per-item submit injection), and the fault
+    #: draw sequence must stay byte-identical to the pre-coldec baseline
+    #: — so a faulted provider simply falls back to the pb2 path.
+    _MASKED_BYTES_RPCS = ("JobsInfoBytes", "NodesBytes", "SubmitJobsBytes")
+
     def __getattr__(self, method: str):
+        if method in self._MASKED_BYTES_RPCS:
+            raise AttributeError(
+                f"{method} masked under fault injection (pb2 path only)"
+            )
         inner_fn = getattr(self._inner, method)
         if not callable(inner_fn) or method.startswith("_"):
             return inner_fn

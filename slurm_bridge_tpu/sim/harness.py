@@ -45,18 +45,39 @@ import numpy as np
 
 from slurm_bridge_tpu.bridge.configurator import Configurator
 from slurm_bridge_tpu.bridge.leader import LeaderElector
+from slurm_bridge_tpu.bridge.freeze import FrozenDict
 from slurm_bridge_tpu.bridge.objects import (
     BridgeJob,
+    BridgeJobStatus,
+    FetchState,
+    JobState,
     Meta,
     Pod,
     PodPhase,
     PodRole,
     VirtualNode,
+    new_uid,
 )
+from slurm_bridge_tpu.core.fastpath import fast_new, frozen_new
+
+#: shared empty frozen map for born-frozen arrival CRs
+_EMPTY_FROZEN_DICT = FrozenDict()
+
+
+def _freeze_scalar_spec(spec):
+    """Flag a scalar-only BridgeJobSpec frozen without the per-field
+    walk ``freeze`` pays (trace specs are one-per-arrival, 500k deep on
+    a storm front; every field is a str/int, so the walk finds nothing
+    to do anyway). ``freeze`` itself short-circuits on the flag."""
+    from slurm_bridge_tpu.core.fastpath import FROZEN_FLAG, enable_guard
+
+    enable_guard(spec.__class__)
+    spec.__dict__[FROZEN_FLAG] = True
+    return spec
 from slurm_bridge_tpu.bridge.operator import BridgeOperator
 from slurm_bridge_tpu.bridge.persist import StorePersistence, load_into
 from slurm_bridge_tpu.bridge.scheduler import PlacementScheduler
-from slurm_bridge_tpu.bridge.store import AlreadyExists, ObjectStore
+from slurm_bridge_tpu.bridge.store import ObjectStore
 from slurm_bridge_tpu.core.types import JobStatus
 from slurm_bridge_tpu.obs.events import EventRecorder
 from slurm_bridge_tpu.obs.flight import FlightRecorder
@@ -92,8 +113,13 @@ _tick_seconds = REGISTRY.histogram(
 #: the phases the full-tick headline decomposes into. ``other`` is the
 #: scheduler-tick time OUTSIDE the four named phases (RPC-fault aborts,
 #: remote skips, any new cost a future change adds) — an explicit bucket
-#: so the numbers stop lying by silently folding it into "store"
-PHASES = ("store", "encode", "solve", "bind", "mirror", "other")
+#: so the numbers stop lying by silently folding it into "store".
+#: ``arrive`` (ISSUE 14) is the arrival-ingest phase — CR creation,
+#: operator sweep, admission fast path — which used to sit OUTSIDE the
+#: tick sum entirely: at 500k×100k that was ~25 s of real per-tick work
+#: the headline number silently excluded and the flight record could
+#: not reconcile (phase_sum 36.4 s vs tick span 63.0 s).
+PHASES = ("arrive", "store", "encode", "solve", "bind", "mirror", "other")
 
 
 @dataclass(frozen=True)
@@ -175,6 +201,17 @@ class Scenario:
     #: batch tick sees them. None = admission OFF, the PR-11 tick
     #: byte-for-byte (fixture-pinned, like policy/sharding/incremental)
     admission: object | None = None
+    #: zero-object wire→column decode on the bulk RPCs (ISSUE 14). On by
+    #: default — digests must be byte-identical either way; False is the
+    #: PR-12 pb2 bulk path byte-for-byte (fixture-pinned,
+    #: tests/fixtures/coldec_off_baseline.json)
+    coldec: bool = True
+    #: CLI-enforced flight-record reconciliation gate (percent): the
+    #: span-derived phase_sum_p50 must match the tick span p50 within
+    #: this tolerance — the PR-5 ±5% contract, re-enforced at the
+    #: headline shape now that the recorder's rollup survives span
+    #: drops. None = record only.
+    phase_reconcile_pct: float | None = None
 
 
 @dataclass
@@ -503,6 +540,7 @@ class SimHarness:
             # Capacity changes still rewrite the node.
             provider_status_interval=float("inf"),
             incremental=scenario.incremental,
+            use_coldec=scenario.coldec,
         )
         # fresh policy engine per stack incarnation: a crash loses the
         # in-memory fair-share accumulator exactly as production would
@@ -776,33 +814,127 @@ class SimHarness:
             # queues its submissions and retries once a leader is back
             self._arrival_backlog = arrivals
             return 0
+        if not arrivals:
+            return 0
         admitter = self.scheduler.admission
         warmup = (
             admitter.config.latency_warmup_ticks
             if admitter is not None
             else 0
         )
-        for a in arrivals:
-            job = BridgeJob(
-                meta=Meta(
-                    name=a.name,
-                    labels=dict(a.labels) if a.labels else {},
-                ),
-                spec=a.spec,
-            )
-            # the trace's virtual duration rides the demand's time limit —
-            # the sim agent runs each job for exactly that long
-            try:
-                self.store.create(job, site="sim.arrive")
-            except AlreadyExists:
-                continue
+        # ---- batched arrival ingest (ISSUE 14) ----
+        # The per-arrival trickle (create → reconcile → stamp, ~5 store
+        # round-trips + one single-key reconcile per job) was ~25 s of
+        # UNATTRIBUTED tick time at the 500k front. Batched: one
+        # create_batch for the tick's CRs, one operator sweep for their
+        # sizecars (sweep ≡ N reconciles, fuzz-pinned in
+        # tests/test_operator_sweep.py; arrival names are zero-padded
+        # ascending, so the sweep's sorted order IS arrival order), one
+        # row-batch duration stamp. Admission still runs per arrival, in
+        # arrival order — identical fast-path decisions and latency
+        # capture. Outcome-identical to the trickle: digests are pinned
+        # by tests/fixtures/coldec_off_baseline.json and every smoke.
+        with TRACER.span("sim.arrive.create") as cspan:
+            # born-frozen children (ISSUE 14): commit-time freeze probes
+            # meta and stops instead of re-walking every spec field per
+            # CR — the same idiom as the operator's sizecar build
+            jobs = [
+                fast_new(
+                    BridgeJob,
+                    meta=fast_new(
+                        Meta,
+                        name=a.name,
+                        uid=new_uid(),
+                        labels=(
+                            FrozenDict(a.labels)
+                            if a.labels
+                            else _EMPTY_FROZEN_DICT
+                        ),
+                        annotations=_EMPTY_FROZEN_DICT,
+                        owner="",
+                        resource_version=0,
+                        deleted=False,
+                    ),
+                    spec=_freeze_scalar_spec(a.spec),
+                    status=frozen_new(
+                        BridgeJobStatus,
+                        state=JobState.PENDING,
+                        reason="",
+                        subjobs=_EMPTY_FROZEN_DICT,
+                        fetch_result=FetchState.NONE,
+                        cluster_endpoint="",
+                    ),
+                )
+                for a in arrivals
+            ]
+            results = self.store.create_batch(jobs, site="sim.arrive")
+            created = [
+                a
+                for a, r in zip(arrivals, results)
+                if not isinstance(r, Exception)
+            ]
+            cspan.count("jobs", len(created))
+        for a in created:
             self.quality.note_arrival(a.name, tick)
-            self.operator.reconcile(a.name)
-            pod = self.store.try_get(Pod.KIND, f"{a.name}-sizecar")
-            if pod is not None and pod.spec.demand is not None:
-                def stamp(p: Pod, dur=a.duration_s):
-                    from slurm_bridge_tpu.bridge.freeze import fast_replace
+        names = [a.name for a in created]
+        if names:
+            # keys the sweep won't settle (validation failures, finished
+            # jobs, conflicts) go through the single-key oracle, exactly
+            # like the mirror's event pump
+            for key in self.operator.sweep(names):
+                self.operator.reconcile(key)
+        # the trace's virtual duration rides the demand's time limit —
+        # the sim agent runs each job for exactly that long. One batched
+        # column write; object stores keep the per-pod replacement.
+        with TRACER.span("sim.arrive.stamp") as sspan:
+            has_sizecar = self._stamp_durations(created)
+            sspan.count("pods", len(has_sizecar))
+        if admitter is not None:
+            for a in created:
+                if a.name not in has_sizecar:
+                    continue
+                pod_name = f"{a.name}-sizecar"
+                # the streaming fast path runs AT arrival (event-driven):
+                # eligible interactive work binds here, in wall-clock
+                # milliseconds, without waiting for the batch tick
+                t0 = time.perf_counter()
+                res = self.scheduler.admit(pod_name)
+                admit_ms = (time.perf_counter() - t0) * 1e3
+                if res.eligible and tick >= warmup:
+                    # the latency axis starts after the cold-start
+                    # warmup: no window exists before the first solve
+                    # and no virtual node is ready before the first
+                    # mirror — steady-state latency is the SLO
+                    self.quality.note_interactive(a.name)
+                    if res.bound:
+                        self.quality.note_fastpath_bind(a.name, admit_ms)
+                if res.bound:
+                    self._fast_bound_tick.append(pod_name)
+                    self.quality.note_bound(a.name, tick)
+                    self._note(
+                        tick, "fastbind", pod_name, ",".join(res.hint)
+                    )
+        return len(arrivals)
 
+    def _stamp_durations(self, created: list) -> set[str]:
+        """Write each arrival's virtual duration into its sizecar's
+        demand (``time_limit_s``) — the batched form of the per-pod
+        ``replace_update`` stamp. Returns the arrival names whose
+        sizecar existed (what the admission loop may admit)."""
+        from slurm_bridge_tpu.bridge.freeze import fast_replace, frozen_replace
+
+        table = self.store.table(Pod.KIND)
+        has_sizecar: set[str] = set()
+        if table is None:
+            for a in created:
+                pod = self.store.try_get(Pod.KIND, f"{a.name}-sizecar")
+                if pod is None:
+                    continue
+                has_sizecar.add(a.name)
+                if pod.spec.demand is None:
+                    continue
+
+                def stamp(p: Pod, dur=a.duration_s):
                     return fast_replace(
                         p,
                         meta=fast_replace(p.meta),
@@ -818,28 +950,63 @@ class SimHarness:
                 self.store.replace_update(
                     Pod.KIND, pod.name, stamp, site="sim.arrive"
                 )
-            if admitter is not None and pod is not None:
-                # the streaming fast path runs AT arrival (event-driven):
-                # eligible interactive work binds here, in wall-clock
-                # milliseconds, without waiting for the batch tick
-                t0 = time.perf_counter()
-                res = self.scheduler.admit(pod.name)
-                admit_ms = (time.perf_counter() - t0) * 1e3
-                if res.eligible and tick >= warmup:
-                    # the latency axis starts after the cold-start
-                    # warmup: no window exists before the first solve
-                    # and no virtual node is ready before the first
-                    # mirror — steady-state latency is the SLO
-                    self.quality.note_interactive(a.name)
-                    if res.bound:
-                        self.quality.note_fastpath_bind(a.name, admit_ms)
-                if res.bound:
-                    self._fast_bound_tick.append(pod.name)
-                    self.quality.note_bound(a.name, tick)
-                    self._note(
-                        tick, "fastbind", pod.name, ",".join(res.hint)
+            return has_sizecar
+        c = table.cols
+        pod_names: list[str] = []
+        expected: list[int] = []
+        new_demands: list[object] = []
+        stamped_arrivals: list[str] = []
+        with self.store.locked():
+            rows = table.rows_for([f"{a.name}-sizecar" for a in created])
+            for a, row in zip(created, rows.tolist()):
+                if row < 0:
+                    continue
+                has_sizecar.add(a.name)
+                demand = c.demand[row]
+                if demand is None:
+                    continue
+                pod_names.append(f"{a.name}-sizecar")
+                expected.append(int(c.rv[row]))
+                new_demands.append(
+                    frozen_replace(
+                        demand, time_limit_s=max(1, int(round(a.duration_s)))
                     )
-        return len(arrivals)
+                )
+                stamped_arrivals.append(a.name)
+        if not pod_names:
+            return has_sizecar
+        demand_col = np.empty(len(new_demands), object)
+        demand_col[:] = new_demands
+
+        def writer(rws, sel):
+            c.demand[rws] = demand_col[sel]
+
+        results = self.store.update_rows(
+            Pod.KIND,
+            pod_names,
+            np.asarray(expected, np.int64),
+            writer,
+            site="sim.arrive",
+        )
+        for name, dem, rc in zip(stamped_arrivals, new_demands, results.tolist()):
+            if rc > 0:
+                continue
+            # conflict/vanished: the per-pod oracle (same thread, so this
+            # is belt-and-braces, not a hot path)
+            def stamp(p: Pod, d=dem):
+                return fast_replace(
+                    p,
+                    meta=fast_replace(p.meta),
+                    spec=fast_replace(p.spec, demand=d),
+                )
+
+            try:
+                self.store.replace_update(
+                    Pod.KIND, f"{name}-sizecar", stamp, site="sim.arrive"
+                )
+            except Exception:
+                pass
+        return has_sizecar
 
     def _mirror(self) -> None:
         """Partition diff + provider sync + event-driven operator sync —
@@ -911,17 +1078,23 @@ class SimHarness:
             n_arrived = self._arrive(tick) if arrivals else 0
             arrive_span.count("arrivals", n_arrived)
             arrive_span.count("fastpath_bound", len(self._fast_bound_tick))
-        self._arrive_ms.append((time.perf_counter() - t0) * 1e3)
+        arrive_ms = (time.perf_counter() - t0) * 1e3
+        self._arrive_ms.append(arrive_ms)
 
         stale = bool(self.scenario.faults.active("stale_snapshot", tick))
-        free_before = None if stale else self._free_now()
-        pods_before = self.store.list(Pod.KIND)
-        pre = {
-            p.name: (p.spec.placement_hint, p.spec.demand)
-            for p in pods_before
-            if p.spec.role == PodRole.SIZECAR and p.spec.node_name
-        }
-        pending_before = self._pending_names(pods_before)
+        # sim.verify spans: the harness's OWN bookkeeping (ground-truth
+        # snapshots, invariant checks, digest notes) — named so the
+        # flight record's phase sum reconciles with the tick span at the
+        # 500k shape instead of leaving seconds of root self-time blank
+        with TRACER.span("sim.verify"):
+            free_before = None if stale else self._free_now()
+            pods_before = self.store.list(Pod.KIND)
+            pre = {
+                p.name: (p.spec.placement_hint, p.spec.demand)
+                for p in pods_before
+                if p.spec.role == PodRole.SIZECAR and p.spec.node_name
+            }
+            pending_before = self._pending_names(pods_before)
 
         t1 = time.perf_counter()
         if self._stack_up:
@@ -931,6 +1104,9 @@ class SimHarness:
                 self._rpc_fail("scheduler.tick")
         sched_ms = (time.perf_counter() - t1) * 1e3
         phases = dict(self.scheduler.last_phase_ms) if self._stack_up else {}
+        # arrival ingest is a first-class tick phase since ISSUE 14 — it
+        # was real per-tick work the headline silently excluded
+        phases["arrive"] = arrive_ms
 
         t2 = time.perf_counter()
         if self._stack_up:
@@ -942,81 +1118,82 @@ class SimHarness:
         accounted = sum(phases.get(k, 0.0) for k in ("store", "encode", "solve", "bind"))
         phases["other"] = max(0.0, sched_ms - accounted)
 
-        self.cluster.step()
-        self.quality.sample(self.cluster)
+        with TRACER.span("sim.verify"):
+            self.cluster.step()
+            self.quality.sample(self.cluster)
 
-        pods = self.store.list(Pod.KIND)
-        by_name = {p.name: p for p in pods}
-        newly_bound = [
-            p for p in pods if p.name in pending_before and p.spec.node_name
-        ]
-        preempted = [
-            name
-            for name in pre
-            if (cur := by_name.get(name)) is not None
-            and not cur.spec.node_name
-            and cur.status.reason.startswith("Preempted")
-        ]
-        released: dict[str, list[float]] = {}
-        for name in preempted:
-            hints, demand = pre[name]
-            if demand is None:
-                continue
-            cpu, mem, gpu = per_node_demand(demand)
-            for node in hints:
-                u = released.setdefault(node, [0.0, 0.0, 0.0])
-                u[0] += cpu
-                u[1] += mem
-                u[2] += gpu
-        # fast-path binds: bound during the arrive phase, so invisible to
-        # the pending_before diff — still bound work this tick (counted,
-        # and capacity-checked below alongside the batch binds; their
-        # quality/digest notes were taken at admit time)
-        fast_pods = [
-            p
-            for n in self._fast_bound_tick
-            if (p := by_name.get(n)) is not None and p.spec.node_name
-        ]
-        self._bound_total += len(newly_bound) + len(fast_pods)
-        self._preempted_total += len(preempted)
-        for p in newly_bound:
-            self.quality.note_bound(p.meta.owner or p.name, tick)
-        self.quality.note_preempts(len(preempted))
-        for p in sorted(newly_bound, key=lambda p: p.name):
-            self._note(tick, "bind", p.name, p.spec.node_name,
-                       ",".join(p.spec.placement_hint))
-        for name in sorted(preempted):
-            self._note(tick, "preempt", name)
+            pods = self.store.list(Pod.KIND)
+            by_name = {p.name: p for p in pods}
+            newly_bound = [
+                p for p in pods if p.name in pending_before and p.spec.node_name
+            ]
+            preempted = [
+                name
+                for name in pre
+                if (cur := by_name.get(name)) is not None
+                and not cur.spec.node_name
+                and cur.status.reason.startswith("Preempted")
+            ]
+            released: dict[str, list[float]] = {}
+            for name in preempted:
+                hints, demand = pre[name]
+                if demand is None:
+                    continue
+                cpu, mem, gpu = per_node_demand(demand)
+                for node in hints:
+                    u = released.setdefault(node, [0.0, 0.0, 0.0])
+                    u[0] += cpu
+                    u[1] += mem
+                    u[2] += gpu
+            # fast-path binds: bound during the arrive phase, so invisible to
+            # the pending_before diff — still bound work this tick (counted,
+            # and capacity-checked below alongside the batch binds; their
+            # quality/digest notes were taken at admit time)
+            fast_pods = [
+                p
+                for n in self._fast_bound_tick
+                if (p := by_name.get(n)) is not None and p.spec.node_name
+            ]
+            self._bound_total += len(newly_bound) + len(fast_pods)
+            self._preempted_total += len(preempted)
+            for p in newly_bound:
+                self.quality.note_bound(p.meta.owner or p.name, tick)
+            self.quality.note_preempts(len(preempted))
+            for p in sorted(newly_bound, key=lambda p: p.name):
+                self._note(tick, "bind", p.name, p.spec.node_name,
+                           ",".join(p.spec.placement_hint))
+            for name in sorted(preempted):
+                self._note(tick, "preempt", name)
 
-        self.violations.extend(
-            check_tick(
-                tick,
-                pods,
-                self.cluster,
-                newly_bound=newly_bound + fast_pods,
-                free_before=free_before,
-                released={k: tuple(v) for k, v in released.items()},
+            self.violations.extend(
+                check_tick(
+                    tick,
+                    pods,
+                    self.cluster,
+                    newly_bound=newly_bound + fast_pods,
+                    free_before=free_before,
+                    released={k: tuple(v) for k, v in released.items()},
+                )
             )
-        )
-        pending_after = len(self._pending_names(pods))
-        self._pending_by_tick.append(pending_after)
-        self._note(tick, "pending", pending_after, "arrived", n_arrived)
-        fault_end = self.scenario.faults.last_end_tick
-        if (
-            self._recovered_at is None
-            and fault_end
-            and tick >= fault_end
-            and pending_after == 0
-            and not self.cluster.pending_jobs()
-        ):
-            self._recovered_at = tick
-        if (
-            self._drained_at is None
-            and pending_after == 0
-            and not self.cluster.pending_jobs()
-            and tick >= self.scenario.ticks - 1
-        ):
-            self._drained_at = tick
+            pending_after = len(self._pending_names(pods))
+            self._pending_by_tick.append(pending_after)
+            self._note(tick, "pending", pending_after, "arrived", n_arrived)
+            fault_end = self.scenario.faults.last_end_tick
+            if (
+                self._recovered_at is None
+                and fault_end
+                and tick >= fault_end
+                and pending_after == 0
+                and not self.cluster.pending_jobs()
+            ):
+                self._recovered_at = tick
+            if (
+                self._drained_at is None
+                and pending_after == 0
+                and not self.cluster.pending_jobs()
+                and tick >= self.scenario.ticks - 1
+            ):
+                self._drained_at = tick
 
         self._drain_node_watch()
         if self.persistence is not None and self._stack_up:

@@ -248,13 +248,40 @@ class NodesDecodeCache:
     identity, so a replayed list also skips the inventory re-encode.
     """
 
-    __slots__ = ("_slot",)
+    __slots__ = ("_slot", "_bslot")
 
     def __init__(self):
         # one (resp ref, key, nodes) tuple, swapped atomically — concurrent
         # pool threads may decode the same response twice but never observe
         # a key paired with another response's rows
         self._slot: tuple[object, bytes, list[NodeInfo]] | None = None
+        # the bytes-path twin (ISSUE 14): (bytes ref, decoded) — the raw
+        # wire buffer IS the content key, so the hit check is one compare
+        # (and one identity probe when the sim re-serves cached bytes)
+        self._bslot: tuple[bytes, object] | None = None
+
+    def decode_bytes(self, raw: bytes):
+        """Decode a raw ``NodesResponse`` wire buffer via the vectorized
+        coldec path, content-memoized on the buffer itself. Returns the
+        full :class:`~slurm_bridge_tpu.wire.coldec.NodesDecoded` (the
+        incremental mirror needs ``version``/``unchanged`` too); on a
+        hit the SAME decoded object — and therefore the same identity-
+        stable NodeInfo list — is replayed across ticks."""
+        from slurm_bridge_tpu.wire import coldec
+
+        slot = self._bslot
+        if slot is not None and (slot[0] is raw or slot[0] == raw):
+            if slot[0] is not raw:
+                self._bslot = (raw, slot[1])
+            return slot[1]
+        decoded = coldec.decode_nodes(raw)
+        if not decoded.unchanged:
+            # tiny unchanged=true answers must not evict the full decode
+            self._bslot = (raw, decoded)
+            # counted HERE, not at the call sites: a memo replay is not
+            # a decode, and the counter exists to read decode volume
+            coldec.rows_counter().inc(len(decoded.nodes))
+        return decoded
 
     def decode(self, resp) -> list[NodeInfo]:
         slot = self._slot

@@ -357,6 +357,18 @@ def _retrying_call(method_name: str, traced, policy: RetryPolicy):
     return call
 
 
+#: bulk RPCs with a raw-bytes client twin (``<Method>Bytes``): identity
+#: response-deserializer, so the caller hands the buffer to
+#: wire/coldec.py and decodes straight into columns — no pb2 response
+#: object is ever built. Same wire method, same retry budget, same
+#: ``rpc.client.<Method>`` span name.
+BYTES_METHODS = ("JobsInfo", "Nodes", "SubmitJobs")
+
+
+def _identity_bytes(raw: bytes) -> bytes:
+    return raw
+
+
 class ServiceClient:
     """Dynamic client stub: one callable attribute per RPC.
 
@@ -364,6 +376,11 @@ class ServiceClient:
     (UNAVAILABLE/DEADLINE_EXCEEDED — see :class:`RetryPolicy`); pass
     ``retry=None`` to fail fast instead. Streams are never retried (they
     outlive the call frame; the caller owns resumption).
+
+    The bulk methods additionally expose raw-bytes twins
+    (:data:`BYTES_METHODS`, e.g. ``client.JobsInfoBytes``) for the
+    zero-object wire→column decode; ``coldec=False`` suppresses them and
+    every consumer stays on the pb2 path.
 
     >>> client = ServiceClient(dial("localhost:9999"), "WorkloadManager")
     >>> client.SubmitJob(pb.SubmitJobRequest(script="...", partition="debug"))
@@ -375,6 +392,7 @@ class ServiceClient:
         service_name: str,
         *,
         retry: RetryPolicy | None = DEFAULT_RETRY,
+        coldec: bool = True,
     ):
         self._channel = channel
         full_name, specs = service_methods(service_name)
@@ -390,6 +408,16 @@ class ServiceClient:
             if unary and retry is not None:
                 call = _retrying_call(spec.name, call, retry)
             setattr(self, spec.name, call)
+            if coldec and unary and spec.name in BYTES_METHODS:
+                raw_mc = factory(
+                    f"/{full_name}/{spec.name}",
+                    request_serializer=spec.req_cls.SerializeToString,
+                    response_deserializer=_identity_bytes,
+                )
+                raw_call = _traced_call(spec.name, raw_mc, unary=True)
+                if retry is not None:
+                    raw_call = _retrying_call(spec.name, raw_call, retry)
+                setattr(self, spec.name + "Bytes", raw_call)
 
     def close(self) -> None:
         self._channel.close()
@@ -418,9 +446,23 @@ def generic_handler(servicer, service_name: str) -> grpc.GenericRpcHandler:
         handlers[spec.name] = maker(
             fn,
             request_deserializer=spec.req_cls.FromString,
-            response_serializer=spec.resp_cls.SerializeToString,
+            response_serializer=_bytes_passthrough(
+                spec.resp_cls.SerializeToString
+            ),
         )
     return grpc.method_handlers_generic_handler(full_name, handlers)
+
+
+def _bytes_passthrough(serialize):
+    """Response serializer accepting EITHER a message or pre-serialized
+    wire bytes — the server half of the ISSUE 14 bytes fast path (a
+    servicer may hand back an already-assembled buffer; the wire is
+    identical either way)."""
+
+    def ser(resp):
+        return resp if isinstance(resp, bytes) else serialize(resp)
+
+    return ser
 
 
 def serve(

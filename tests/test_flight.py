@@ -450,13 +450,28 @@ class TestHarnessFlightRecord:
         assert off.flight_record == {}
 
     def test_phase_tree_reconciles_with_tick_p50(self, runs):
-        """Acceptance: span-tree phase durations reconcile with the
-        timing headline within ±5% (both decompose the same ticks)."""
+        """Acceptance: span-derived phase durations reconcile with the
+        tick SPAN within ±5% — since ISSUE 14 the phase set includes the
+        arrive and verify buckets, so the sum explains the whole root
+        span (not just the scheduler+mirror slice the old timing
+        headline covered)."""
         on, _ = runs
         fr = on.flight_record
         assert fr["ticks"] == on.shape["ticks"]
+        # abs floor: at toy tick sizes (~5 ms) scheduler-internal spans
+        # vs the harness's perf_counter stamps can differ by fractions
+        # of a millisecond of pure measurement noise on a loaded CI box;
+        # the ±5% contract binds at real scale (the 500k CLI gate)
+        assert fr["phase_sum_p50_ms"] == pytest.approx(
+            fr["tick_span_p50_ms"], rel=0.05, abs=2.0
+        )
+        # ... and the timing headline's phases are the span phases minus
+        # the harness's own verify bookkeeping
         tick_p50 = on.timing["tick_p50_ms"]
-        assert fr["phase_sum_p50_ms"] == pytest.approx(tick_p50, rel=0.05)
+        verify = fr["phases_p50_ms"].get("verify", 0.0)
+        assert fr["phase_sum_p50_ms"] - verify == pytest.approx(
+            tick_p50, rel=0.10, abs=1.0
+        )
         for phase in PHASES:
             assert phase in fr["phases_p50_ms"]
 
@@ -500,3 +515,63 @@ class TestHarnessFlightRecord:
         on, _ = runs
         counters = on.flight_record["counters"]
         assert counters.get("sbt_operator_reconciles_total", 0) > 0
+
+
+class TestRollupUnderDrops:
+    """ISSUE 14 satellite: the keep-newest ring used to hollow the cold
+    tick's tree (470k spans dropped at 500k, phase_sum 36.4 s vs tick
+    63.0 s). The per-path rollup aggregates every span at EXPORT time,
+    so a ring orders of magnitude smaller than the span count still
+    yields exact path totals and the ±5% reconciliation."""
+
+    def test_reconciliation_holds_with_tiny_ring(self):
+        from slurm_bridge_tpu.obs.tracing import TRACER
+
+        h = SimHarness(_tiny())
+        h.flight = FlightRecorder(tracer=TRACER, store=h.store, capacity=8)
+        result = h.run()
+        fr = result.flight_record
+        assert fr["spans_dropped"] > 0  # the ring genuinely overflowed
+        assert fr["spans_total"] > 8 * fr["ticks"]
+        # ... and the record is NOT hollow: phases reconcile with the
+        # tick span exactly as with an unbounded ring (abs floor: toy
+        # ticks are ~5 ms, measurement noise dominates percentages)
+        assert fr["phase_sum_p50_ms"] == pytest.approx(
+            fr["tick_span_p50_ms"], rel=0.05, abs=2.0
+        )
+        # the dropped spans' paths still contributed to the tree
+        tree = result.flight_ticks[0]["tree"]
+        root = next(iter(tree.values()))
+        assert "sim.mirror" in root.get("children", {})
+        assert "sim.verify" in root.get("children", {})
+
+    def test_rollup_matches_unbounded_ring(self):
+        """Same seed, tiny ring vs huge ring: identical aggregates (the
+        ring is display-only; the rollup is the record)."""
+        from slurm_bridge_tpu.obs.tracing import TRACER
+
+        h1 = SimHarness(_tiny())
+        h1.flight = FlightRecorder(tracer=TRACER, store=h1.store, capacity=8)
+        r1 = h1.run()
+        h2 = SimHarness(_tiny())
+        h2.flight = FlightRecorder(
+            tracer=TRACER, store=h2.store, capacity=1_000_000
+        )
+        r2 = h2.run()
+        f1, f2 = r1.flight_record, r2.flight_record
+        assert f1["spans_total"] == f2["spans_total"]
+        assert f1["spans_dropped"] > 0 and f2["spans_dropped"] == 0
+        # span COUNTS per path are deterministic; durations are wall
+        # time, so compare structure not milliseconds
+        t1 = [r["tree"] for r in r1.flight_ticks]
+        t2 = [r["tree"] for r in r2.flight_ticks]
+
+        def shape(node):
+            return {
+                name: (child["count"], shape(child))
+                for name, child in node.get("children", {}).items()
+            }
+
+        for a, b in zip(t1, t2):
+            ra, rb = next(iter(a.values())), next(iter(b.values()))
+            assert shape(ra) == shape(rb)

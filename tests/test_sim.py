@@ -408,7 +408,7 @@ def test_cli_runs_scenario_json(tmp_path, capsys):
     assert obj["scenario"] == "steady_poisson"
     assert "digest" in obj["determinism"]
     assert set(obj["timing"]["phases_p50_ms"]) == {
-        "store", "encode", "solve", "bind", "mirror", "other"
+        "arrive", "store", "encode", "solve", "bind", "mirror", "other"
     }
     saved = json.loads(out_file.read_text())
     assert saved[0]["determinism"]["digest"] == obj["determinism"]["digest"]

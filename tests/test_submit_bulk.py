@@ -98,10 +98,18 @@ def _setup(n_pods: int, client_wrap=CountingClient, faults: FaultPlan | None = N
     return clock, cluster, client, store, provider
 
 
+def _submit_calls(client):
+    # the batched submit may ride the raw-bytes twin (ISSUE 14) — the
+    # same wire RPC either way
+    return client.calls.get("SubmitJobs", 0) + client.calls.get(
+        "SubmitJobsBytes", 0
+    )
+
+
 def test_cold_start_uses_one_batched_submit():
     clock, cluster, client, store, provider = _setup(5)
     provider.sync()
-    assert client.calls.get("SubmitJobs", 0) == 1
+    assert _submit_calls(client) == 1
     assert client.calls.get("SubmitJob", 0) == 0
     pods = store.list(Pod.KIND)
     assert all(p.status.job_ids for p in pods)
@@ -116,7 +124,7 @@ def test_submits_are_chunked(monkeypatch):
     monkeypatch.setattr(vnode_mod, "_SUBMIT_CHUNK", 2)
     clock, cluster, client, store, provider = _setup(5)
     provider.sync()
-    assert client.calls.get("SubmitJobs", 0) == 3  # ceil(5/2)
+    assert _submit_calls(client) == 3  # ceil(5/2)
     assert cluster.stats.submitted == 5
 
 
@@ -140,7 +148,9 @@ class NoBatchSubmitClient(CountingClient):
     handler table without the method."""
 
     def __getattr__(self, name):
-        if name == "SubmitJobs":
+        if name in ("SubmitJobs", "SubmitJobsBytes"):
+            # the wire METHOD is unimplemented — whichever client-side
+            # deserializer dialed it
             def unimplemented(*a, **kw):
                 self.calls["SubmitJobs"] = self.calls.get("SubmitJobs", 0) + 1
                 raise SimRpcError(grpc.StatusCode.UNIMPLEMENTED, "no such method")
