@@ -47,6 +47,15 @@ the same ≤3%-or-small-epsilon overhead budget as tracing.
     SBT_SMOKE_TRACE_EPS_MS         absolute overhead epsilon  (default 1.5)
     SBT_SMOKE_WAL_OVERHEAD_PCT     WAL-on p50 overhead ceiling (default 3)
     SBT_SMOKE_WAL_EPS_MS           absolute WAL epsilon       (default 1.5)
+    SBT_SMOKE_EXPLAIN_OVERHEAD_PCT explain-on p50 overhead ceiling (default 3)
+    SBT_SMOKE_EXPLAIN_EPS_MS       absolute explain epsilon   (default 1.5)
+
+The placement-explainability plane (ISSUE 15) rides the same paired
+estimator: a scenario run explain-off and explain-on must (a) produce
+byte-identical determinism digests — attribution only OBSERVES solve
+artifacts, it must never change a decision — and (b) keep the
+explain-on tick p50 within the same ≤3%-or-epsilon budget as tracing
+and the WAL.
 """
 
 from __future__ import annotations
@@ -134,6 +143,31 @@ def profile_trace_overhead(scale: float = 0.12, rounds: int = 3) -> dict:
     on = out.pop("_on_result")
     out["flight_phase_sum_p50_ms"] = on.flight_record.get("phase_sum_p50_ms")
     out["flight_commits_total"] = on.flight_record.get("commits_total")
+    return out
+
+
+def profile_explain_overhead(scale: float = 0.12, rounds: int = 3) -> dict:
+    """Explain-on vs explain-off tick cost, same seed (ISSUE 15 gate).
+
+    The on arm attributes a structured reason code to every unplaced
+    job (vectorized over the solve's residual artifacts) and builds the
+    per-tick pressure ledger; the off arm is the pre-ISSUE-15 generic
+    reason string byte-for-byte. Digest identity is the hard half of
+    the gate: attribution that CHANGES a placement decision is a bug at
+    any speed.
+    """
+    import dataclasses
+
+    from slurm_bridge_tpu.sim.scenarios import SCENARIOS
+
+    base = SCENARIOS["steady_poisson"](scale=scale)
+    out = _paired_overhead(
+        dataclasses.replace(base, explain=False),
+        dataclasses.replace(base, explain=True),
+        rounds,
+    )
+    on = out.pop("_on_result")
+    out["wait_reasons"] = on.quality.get("wait_reasons")
     return out
 
 
@@ -263,6 +297,10 @@ def main() -> int:
     trace_eps_ms = float(os.environ.get("SBT_SMOKE_TRACE_EPS_MS", "1.5"))
     wal_pct = float(os.environ.get("SBT_SMOKE_WAL_OVERHEAD_PCT", "3"))
     wal_eps_ms = float(os.environ.get("SBT_SMOKE_WAL_EPS_MS", "1.5"))
+    explain_pct = float(
+        os.environ.get("SBT_SMOKE_EXPLAIN_OVERHEAD_PCT", "3")
+    )
+    explain_eps_ms = float(os.environ.get("SBT_SMOKE_EXPLAIN_EPS_MS", "1.5"))
     steady_budget_ms = float(
         os.environ.get("SBT_SMOKE_STEADY_BUDGET_MS", "50")
     )
@@ -274,12 +312,14 @@ def main() -> int:
     dec = profile_decode(10_000)
     trace = profile_trace_overhead()
     wal = profile_wal_overhead()
+    explain = profile_explain_overhead()
     steady = profile_steady_tick()
     out["reconcile"] = rec
     out["decode"] = dec
     out["decode_min_speedup"] = decode_floor
     out["tracing"] = trace
     out["wal"] = wal
+    out["explain"] = explain
     out["steady"] = steady
     out["steady_budget_ms"] = steady_budget_ms
     out["encode_budget_ms"] = budget_ms
@@ -287,6 +327,7 @@ def main() -> int:
     out["reconcile_budget_ms"] = rec_budget_ms
     out["trace_overhead_budget_pct"] = trace_pct
     out["wal_overhead_budget_pct"] = wal_pct
+    out["explain_overhead_budget_pct"] = explain_pct
     trace_ok = trace["digest_identical"] and (
         trace["overhead_ms"] <= trace_eps_ms
         or trace["overhead_pct"] <= trace_pct
@@ -294,6 +335,10 @@ def main() -> int:
     wal_ok = wal["digest_identical"] and (
         wal["overhead_ms"] <= wal_eps_ms
         or wal["overhead_pct"] <= wal_pct
+    )
+    explain_ok = explain["digest_identical"] and (
+        explain["overhead_ms"] <= explain_eps_ms
+        or explain["overhead_pct"] <= explain_pct
     )
     # the PR-11 steady-state HARD gate: zero-work facts are structural —
     # any nonzero means an O(cluster) path snuck back onto the idle tick
@@ -319,6 +364,7 @@ def main() -> int:
         and rec["steady_wal_records"] == 0
         and trace_ok
         and wal_ok
+        and explain_ok
         and steady_ok
         and decode_ok
     )
@@ -335,8 +381,11 @@ def main() -> int:
             f"{rec['steady_wal_records']} (must be 0) / tracing overhead "
             f"{trace['overhead_pct']}% (budget {trace_pct}%, eps "
             f"{trace_eps_ms} ms) / WAL overhead {wal['overhead_pct']}% "
-            f"(budget {wal_pct}%, eps {wal_eps_ms} ms) / digests identical "
+            f"(budget {wal_pct}%, eps {wal_eps_ms} ms) / explain overhead "
+            f"{explain['overhead_pct']}% (budget {explain_pct}%, eps "
+            f"{explain_eps_ms} ms) / digests identical "
             f"trace={trace['digest_identical']} wal={wal['digest_identical']} "
+            f"explain={explain['digest_identical']} "
             "(must be true) / steady tick "
             f"p50 {steady['steady_tick_p50_ms']} ms (budget "
             f"{steady_budget_ms}), commits {steady['steady_commits']} "

@@ -142,10 +142,28 @@ class FastPathAdmitter:
         #: binds not yet visible in the agent inventory — the batch solve
         #: subtracts these so it cannot double-claim the capacity
         self.deductions: dict[str, tuple[tuple[str, ...], np.ndarray]] = {}
+        #: (window snapshot ref) → node name → position memo, built
+        #: lazily on the first inventory rebase of a window
+        self._pos_memo: tuple | None = None
+        #: whether a provider inventory report may currently maintain
+        #: the window. Flipped UNDER :attr:`lock`, in lockstep with the
+        #: window itself: ``begin_window`` (a solve re-base) forbids it
+        #: — a provider probes BEFORE converging its submits, so on
+        #: solve ticks its view predates the tick's binds — and the
+        #: scheduler re-allows it on ticks no solve re-based the window
+        #: (idle / steady-skip). Because gate and re-base are evaluated
+        #: under the same lock as the window install, a probe racing a
+        #: concurrent solve either lands before ``begin_window`` (and
+        #: is overwritten by the fresh residual) or after (and is
+        #: refused) — never on top of a fresher window.
+        self._inventory_ok = False
         # ---- run accounting (scheduler/harness observability) ----
         self.attempts_total = 0
         self.binds_total = 0
         self.misses: dict[str, int] = {}
+        #: inventory re-bases that actually moved the view (ROADMAP
+        #: streaming-admission follow-up c)
+        self.inventory_rebases = 0
 
     # ---- eligibility ----
 
@@ -172,6 +190,7 @@ class FastPathAdmitter:
             self._begin_window_locked(snapshot, free_after, backlog, plan)
 
     def _begin_window_locked(self, snapshot, free_after, backlog, plan) -> None:
+        self._inventory_ok = False
         self.view.begin_window(snapshot, free_after)
         self._plan = plan
         self.protected = []
@@ -197,6 +216,85 @@ class FastPathAdmitter:
                     "count": count,
                 }
             )
+
+    def allow_inventory_rebase(self) -> None:
+        """Re-open the window to inventory maintenance — called by the
+        scheduler on ticks NO solve re-based the window (the idle early
+        return and the steady-bind skip). Lock-serialized against
+        ``begin_window``, which forbids it again (see
+        :attr:`_inventory_ok` for the race analysis)."""
+        with self.lock:
+            self._inventory_ok = True
+
+    def rebase_from_inventory(self, nodes, *, skip_nodes=None) -> int:
+        """Maintain the window from a periodic inventory probe (ROADMAP
+        streaming-admission follow-up c): an IDLE cluster re-bases only
+        on solve ticks, so capacity freed by completions stayed invisible
+        to the fast path until the next solve — which, with nothing
+        pending, never comes. The provider's per-tick Nodes probe already
+        carries the truth; this folds it into the residual view:
+
+        - each reported node's free capacity replaces the view's row,
+          MINUS the outstanding in-flight fast-bind deductions on that
+          node (those binds are not agent-visible yet);
+        - nodes in ``skip_nodes`` keep the window's own (conservative)
+          value untouched — the scheduler passes the hint nodes of
+          store-BOUND pods whose submission has not reached the agent
+          yet (``job_ids`` still empty): the agent reports their
+          capacity free, but the window's solve residual already
+          committed it, and raising those rows would let the fast path
+          double-claim a batch bind in flight;
+        - protected-gang masks/counts recompute against the refreshed
+          free, so the no-delay guard keeps judging current feasibility.
+
+        Gated by :attr:`_inventory_ok` UNDER the lock (set by
+        :meth:`allow_inventory_rebase`, cleared by ``begin_window``), so
+        a probe racing a concurrent solve can never clobber a fresher
+        window. Returns the number of view rows that moved.
+        """
+        with self.lock:
+            view = self.view
+            if not view.ready or not self._inventory_ok:
+                return 0
+            snap = view.snapshot
+            memo = self._pos_memo
+            if memo is None or memo[0] is not snap:
+                memo = self._pos_memo = (
+                    snap, {n: i for i, n in enumerate(snap.node_names)}
+                )
+            idx = memo[1]
+            ded: dict[str, np.ndarray] = {}
+            for _nm, (hint, d) in self.deductions.items():
+                for h in hint:
+                    prev = ded.get(h)
+                    ded[h] = d.copy() if prev is None else prev + d
+            touched = 0
+            for nd in nodes:
+                if skip_nodes and nd.name in skip_nodes:
+                    continue
+                pos = idx.get(nd.name)
+                if pos is None:
+                    continue
+                if nd.schedulable:
+                    f = np.asarray(
+                        [nd.free_cpus, nd.free_memory_mb, nd.free_gpus],
+                        np.float32,
+                    )
+                else:
+                    f = np.zeros(3, np.float32)
+                sub = ded.get(nd.name)
+                if sub is not None:
+                    f = np.maximum(f - sub, 0.0)
+                if not np.array_equal(view.free[pos], f):
+                    view.free[pos] = f
+                    touched += 1
+            if touched:
+                self.inventory_rebases += 1
+                for g in self.protected:
+                    mask = view.feasible(g["d"], g["part"], g["req"])
+                    g["mask"] = mask
+                    g["count"] = int(mask.sum())
+        return touched
 
     # ---- in-flight deduction bookkeeping ----
 
@@ -380,4 +478,5 @@ class FastPathAdmitter:
             "attempts": self.attempts_total,
             "binds": self.binds_total,
             "misses": dict(sorted(self.misses.items())),
+            "inventory_rebases": self.inventory_rebases,
         }

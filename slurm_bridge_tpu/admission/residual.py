@@ -12,11 +12,17 @@ ticks subtracts its demand in place (``apply_bind``). It is never
 rebuilt per admission — an admission is a masked vector compare over
 the partition's nodes, O(partition), typically microseconds.
 
-Staleness discipline: the window only ever *understates* free capacity
-between rebases (completions and preemptions that free capacity are
-picked up at the next solve), so a fit in the view is a fit in the
-model the guarded backfill would have used — the conservative direction.
-A miss falls through to the normal pending scan untouched.
+Staleness discipline: between SOLVE rebases the window only ever
+*understates* free capacity — a fit in the view is a fit in the model
+the guarded backfill would have used, the conservative direction — and
+a miss falls through to the normal pending scan untouched. Since ISSUE
+15 (ROADMAP streaming-admission follow-up c) an idle cluster's window
+is additionally maintained from the provider's periodic inventory probe
+(:meth:`~slurm_bridge_tpu.admission.fastpath.FastPathAdmitter.rebase_from_inventory`),
+so capacity freed by completions re-opens to the fast path without
+waiting for a solve that — with nothing pending — would never come; the
+scheduler gates that path to ticks where no solve re-based the window,
+keeping the solve's own residual authoritative.
 """
 
 from __future__ import annotations

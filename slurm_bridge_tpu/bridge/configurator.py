@@ -39,6 +39,7 @@ class Configurator:
         provider_status_interval: float | None = None,
         incremental: bool = False,
         use_coldec: bool = True,
+        inventory_listener=None,
     ):
         self.store = store
         self.client = client
@@ -61,6 +62,11 @@ class Configurator:
         #: zero-object wire->column decode (ISSUE 14), forwarded per
         #: provider; off = the pb2 bulk path byte-for-byte
         self.use_coldec = use_coldec
+        #: per-provider inventory-change callback (ISSUE 15 /
+        #: ROADMAP streaming-admission follow-up c): the scheduler's
+        #: admission-window maintenance seam, forwarded to every
+        #: provider this configurator spawns
+        self.inventory_listener = inventory_listener
         self.providers: dict[str, VirtualNodeProvider] = {}
         self._tickers: dict[str, Ticker] = {}
         self._watch = Ticker(watch_interval, self.reconcile, name="configurator")
@@ -150,6 +156,7 @@ class Configurator:
             sync_workers=self.pod_sync_workers,
             incremental=self.incremental,
             use_coldec=self.use_coldec,
+            inventory_listener=self.inventory_listener,
             **kwargs,
         )
         provider.register()

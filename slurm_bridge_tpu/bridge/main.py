@@ -69,6 +69,12 @@ def main(argv: list[str] | None = None) -> int:
                              "decode of the bulk RPCs (ISSUE 14): on by "
                              "default, this flag keeps every response "
                              "on the pb2 object path")
+    parser.add_argument("--no-explain", action="store_true",
+                        help="disable placement explainability (ISSUE "
+                             "15): structured per-job reason codes, the "
+                             "pressure ledger and /debug/schedz — on by "
+                             "default, this flag restores the generic "
+                             "'insufficient capacity' verdicts")
     parser.add_argument("--threads", type=int, default=2,
                         help="operator reconciler workers (--slurm-bridge-operator-threads)")
     parser.add_argument("--configurator-interval", type=float, default=30.0)
@@ -152,6 +158,7 @@ def main(argv: list[str] | None = None) -> int:
         shard=shard,
         incremental=not args.no_incremental,
         use_coldec=not args.no_coldec,
+        explain=not args.no_explain,
         state_file=args.state_file,
         configurator_interval=args.configurator_interval,
         operator_workers=args.threads,

@@ -68,6 +68,7 @@ class Bridge:
         shard=None,
         incremental: bool = True,
         use_coldec: bool = True,
+        explain: bool = True,
     ):
         self.agent_endpoint = agent_endpoint
         self.store = ObjectStore()
@@ -117,6 +118,12 @@ class Bridge:
             pod_sync_workers=pod_sync_workers,
             incremental=incremental,
             use_coldec=use_coldec,
+            # admission-window maintenance from the periodic inventory
+            # probe (ROADMAP follow-up c); late-bound — providers only
+            # sync after start(), by which time the scheduler exists
+            inventory_listener=lambda part, nodes: (
+                self.scheduler.note_inventory(part, nodes)
+            ),
         )
         self.scheduler = PlacementScheduler(
             self.store,
@@ -130,6 +137,7 @@ class Bridge:
             policy=policy,
             shard=shard,
             incremental=incremental,
+            explain=explain,
         )
         self._sched_ticker = Ticker(
             scheduler_interval, self.scheduler.tick, name="scheduler"

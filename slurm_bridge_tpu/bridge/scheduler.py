@@ -45,6 +45,7 @@ from slurm_bridge_tpu.bridge.objects import (
 from slurm_bridge_tpu.bridge.freeze import fast_replace, frozen_replace
 from slurm_bridge_tpu.bridge.store import NotFound, ObjectStore
 from slurm_bridge_tpu.core.types import JobDemand, NodeInfo, PartitionInfo
+from slurm_bridge_tpu.obs import explain as explain_mod
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
 from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.obs.metrics import Histogram
@@ -147,6 +148,8 @@ class PlacementScheduler:
         shard=None,
         incremental: bool = False,
         admission=None,
+        explain: bool = True,
+        explain_target: str = "",
     ):
         if backend not in ("auto", "auction", "greedy"):
             raise ValueError(f"unknown scheduler backend {backend!r}")
@@ -349,6 +352,36 @@ class PlacementScheduler:
         #: + cached row set, mirroring the pending-scan pair above
         self._incumbent_rv = 0
         self._incumbent_cache: list[_RowPod] | None = None
+        #: placement explainability (ISSUE 15): per-job reason-code
+        #: attribution from the solve's own artifacts. Off = the
+        #: pre-ISSUE-15 generic reason strings byte-for-byte; on (the
+        #: default) is digest-byte-identical by construction — explain
+        #: only OBSERVES the tick (the bench-smoke overhead gate pins
+        #: both facts, mirroring the trace/WAL gates).
+        self.explain = explain
+        #: one job's decision trail (``--explain <job>`` on the sim CLI)
+        self.explain_trail = (
+            explain_mod.ExplainTrail(explain_target) if explain_target else None
+        )
+        #: the last fresh solve's attribution inputs (residual free,
+        #: capacity/feature columns, unplaced-job records) — retained
+        #: across warm-start memo ticks, whose backlog is provably the
+        #: generation's (same inputs ⇒ same reasons)
+        self._explain_ctx: explain_mod.ExplainInputs | None = None
+        #: (ctx identity, by_job_names identity) → codes memo: a memo
+        #: tick re-marks the identical backlog, so attribution is pure
+        #: replay and is not recomputed
+        self._explain_memo: tuple | None = None
+        #: per-partition member-position memo, keyed on the snapshot
+        self._pm_memo: tuple | None = None
+        #: the last solve tick's pressure ledger (reason × partition ×
+        #: class × tenant + per-shard bottleneck) — the harness folds it
+        #: into the flight record and quality scorecard; None on idle
+        #: ticks and with explain off
+        self.last_explain_ledger: dict | None = None
+        #: the last BUILT ledger — replayed verbatim on steady-skip
+        #: ticks, whose backlog is provably the generation's
+        self._ledger_replay: dict | None = None
 
     # ---- inventory ----
 
@@ -666,6 +699,7 @@ class PlacementScheduler:
 
     def _tick(self, tick_span) -> int:
         self.last_phase_ms = {"store": 0.0, "encode": 0.0, "solve": 0.0, "bind": 0.0}
+        self.last_explain_ledger = None
         with TRACER.span("scheduler.store") as store_span:
             self._retry_pending_cancels()
             if self.admission is not None:
@@ -688,7 +722,12 @@ class PlacementScheduler:
         self.last_phase_ms["store"] = store_s * 1e3
         if not pods:
             # nothing pending ⇒ nothing can displace anyone; keep the idle
-            # tick free (no inventory RPCs, no solve)
+            # tick free (no inventory RPCs, no solve). The admission
+            # window was NOT re-based this tick, so the next provider
+            # inventory report may maintain it (note_inventory) — the
+            # idle-cluster completion pickup of ROADMAP follow-up (c).
+            if self.admission is not None:
+                self.admission.allow_inventory_rebase()
             _pods_unplaced.set(0)
             return 0
         _store_seconds.observe(store_s)
@@ -701,6 +740,20 @@ class PlacementScheduler:
             pods, incumbents, priorities = self.policy.prepare(
                 pods, incumbents
             )
+        trail = self.explain_trail
+        t_idx = -1
+        if trail is not None:
+            for _j, _p in enumerate(pods):
+                if trail.matches(_p.name):
+                    t_idx = _j
+                    msg = f"pending in partition {_p.partition!r}"
+                    if priorities is not None:
+                        msg += (
+                            f", fair-share slot {_j} of {len(pods)}, "
+                            f"effective priority {priorities[_j]:g}"
+                        )
+                    trail.add("queue", msg)
+                    break
         all_pods = pods + incumbents
         demands: list[JobDemand] = []
         for pod in all_pods:
@@ -763,7 +816,7 @@ class PlacementScheduler:
             elif self.shard is not None:
                 by_job_names, lost_jobs = self._solve_sharded(
                     partitions, nodes, demands, all_pods, n_pending,
-                    priorities=priorities,
+                    priorities=priorities, trail=trail, trail_job=t_idx,
                 )
             else:
                 by_job_names, lost_jobs = self._solve_local(
@@ -773,6 +826,16 @@ class PlacementScheduler:
             if memo_key is not None and reused is None:
                 self._solve_memo = (
                     nodes, partitions, memo_key, (by_job_names, lost_jobs)
+                )
+        if trail is not None and t_idx >= 0:
+            names_t = by_job_names.get(t_idx)
+            if names_t:
+                trail.add("solve", f"assigned nodes {','.join(names_t)}")
+            else:
+                trail.add(
+                    "solve",
+                    "left unplaced by the solve (and any backfill/"
+                    "reconcile second pass)",
                 )
         with TRACER.span("scheduler.bind") as bind_span:
             ready_nodes = {
@@ -798,6 +861,17 @@ class PlacementScheduler:
                 bind_span.count("steady_skip", 1)
                 bind_span.count("binds", 0)
                 bind_span.count("unschedulable", 0)
+                # no window re-base this tick either: let the provider
+                # inventory probe maintain it (note_inventory)
+                if self.admission is not None:
+                    self.admission.allow_inventory_rebase()
+                # the skipped mark walk's ledger is a pure replay of the
+                # generation's (same backlog ⇒ same reasons), so the
+                # pressure accounting stays tick-for-tick identical to
+                # the full tick's — quality.wait_reasons is part of the
+                # incremental≡full contract the quality gate enforces
+                if self.explain:
+                    self.last_explain_ledger = self._ledger_replay
                 bind_s = bind_span.duration
                 self.last_phase_ms["bind"] = bind_s * 1e3
                 _bind_seconds.observe(bind_s)
@@ -805,6 +879,15 @@ class PlacementScheduler:
                 _pods_unplaced.set(len(pods))
                 return 0
             self._last_ready = ready_nodes
+            #: per-job primary reason codes, attributed VECTORIZED from
+            #: the solve's own artifacts (ISSUE 15) — {} with explain
+            #: off or on attribution-less ticks (remote solver)
+            codes: dict[int, str] = {}
+            if self.explain:
+                codes = self._explain_codes(
+                    pods, demands, by_job_names, n_pending
+                )
+            ledger_rows: list | None = [] if self.explain else None
             binds: list[tuple[Pod, str, tuple[str, ...]]] = []
             unschedulable: list[tuple[Pod, str]] = []
             admitted_idx: list[int] = []
@@ -815,18 +898,46 @@ class PlacementScheduler:
                 if names and partition in ready_nodes:
                     binds.append((pod, partition_node_name(partition), tuple(names)))
                     admitted_idx.append(j)
-                elif partition in ready_nodes:
-                    unschedulable.append(
-                        (pod, "Unschedulable: insufficient capacity")
-                    )
-                else:
-                    reason = no_vnode_reason.get(partition)
-                    if reason is None:
-                        reason = no_vnode_reason[partition] = (
-                            "Unschedulable: no ready virtual node for "
-                            f"partition {partition!r}"
+                    if trail is not None and j == t_idx:
+                        trail.add(
+                            "bind",
+                            f"bound to {partition_node_name(partition)} "
+                            f"(nodes {','.join(names)})",
                         )
-                    unschedulable.append((pod, reason))
+                    continue
+                if partition in ready_nodes:
+                    if self.explain:
+                        code = codes.get(j, explain_mod.UNKNOWN)
+                        reason = explain_mod.reason_string(code)
+                    else:
+                        code = ""
+                        reason = "Unschedulable: insufficient capacity"
+                else:
+                    if self.explain:
+                        code = explain_mod.NO_READY_VNODE
+                        reason = explain_mod.reason_string(code, partition)
+                    else:
+                        code = ""
+                        reason = no_vnode_reason.get(partition)
+                        if reason is None:
+                            reason = no_vnode_reason[partition] = (
+                                "Unschedulable: no ready virtual node for "
+                                f"partition {partition!r}"
+                            )
+                unschedulable.append((pod, reason))
+                if ledger_rows is not None:
+                    ledger_rows.append((j, code, partition, pod.labels))
+                if trail is not None and j == t_idx:
+                    trail.add("verdict", reason)
+            if ledger_rows is not None:
+                # the per-tick pressure ledger (sink 2): reason ×
+                # partition × class × tenant counts + per-shard
+                # bottleneck — its per-reason counts sum to the
+                # unplaced count by construction (one row per mark)
+                self.last_explain_ledger = self._build_pressure_ledger(
+                    ledger_rows
+                )
+                self._ledger_replay = self.last_explain_ledger
             if self.policy is not None:
                 # fair-share charge for what actually reached the bind
                 # list — a solver assignment whose partition has no
@@ -853,6 +964,10 @@ class PlacementScheduler:
             for j in lost_jobs:
                 if self._preempt(all_pods[j]):
                     preempted += 1
+                    if trail is not None and trail.matches(all_pods[j].name):
+                        trail.add(
+                            "preempt", "displaced by higher-priority work"
+                        )
             if self.admission is not None:
                 self._rebase_admission_window(
                     demands, by_job_names, n_pending
@@ -1033,6 +1148,11 @@ class PlacementScheduler:
             for row, node in backfill_takes:
                 residual[node] -= np.ceil(batch.demand[row])
             self._adm_capture = (snapshot, residual, None)
+        if self.explain:
+            self._capture_explain_local(
+                snapshot, batch, placement, backfill_takes, by_job,
+                shard_rows, demands, n_pending,
+            )
         by_job_names = {
             j: [snapshot.node_names[i] for i in idxs] for j, idxs in by_job.items()
         }
@@ -1048,7 +1168,7 @@ class PlacementScheduler:
 
     def _solve_sharded(
         self, partitions, nodes, demands, all_pods, n_pending,
-        priorities=None,
+        priorities=None, trail=None, trail_job=-1,
     ) -> tuple[dict[int, list[str]], list[int]]:
         """The sharded tick: plan → route → per-shard encode+solve →
         merge → cross-shard gang reconciliation (slurm_bridge_tpu.shard).
@@ -1077,9 +1197,15 @@ class PlacementScheduler:
                     else None
                 ),
                 capture_residual=self.admission is not None,
+                explain=self.explain,
+                trail=trail,
+                trail_job=trail_job,
             )
             if self.admission is not None and self.shard.last_window is not None:
                 self._adm_capture = self.shard.last_window
+            if self.explain:
+                self._explain_ctx = self.shard.last_explain_inputs
+                self._explain_memo = None
             solve_span.count("shards_used", self.shard.last_shards_used)
             solve_span.count(
                 "reconciled", self.shard.last_reconcile_placed
@@ -1120,6 +1246,10 @@ class PlacementScheduler:
             partition_to_proto,
         )
 
+        # a remote solve ships no residual artifacts back — attribution
+        # degrades to the generic UNKNOWN verdict for these ticks
+        self._explain_ctx = None
+        self._explain_memo = None
         jobs = []
         for j, d in enumerate(demands):
             job = demand_to_place(d, job_id=str(j))
@@ -1329,6 +1459,182 @@ class PlacementScheduler:
             backlog.append((d, rank))
         self.admission.begin_window(snapshot, residual, backlog, plan=plan)
 
+    # ---- placement explainability (ISSUE 15) ----
+
+    def _part_members_of(self, snapshot) -> dict:
+        """Partition name → member node positions for one snapshot —
+        memoized on snapshot identity (the encoder replays the same
+        snapshot object while the inventory is unchanged, so steady
+        generations rebuild nothing)."""
+        memo = self._pm_memo
+        if memo is not None and memo[0] is snapshot:
+            return memo[1]
+        pof = snapshot.partition_of
+        members = {
+            name: np.nonzero(pof == code)[0]
+            for name, code in snapshot.partition_codes.items()
+        }
+        self._pm_memo = (snapshot, members)
+        return members
+
+    def _capture_explain_local(
+        self, snapshot, batch, placement, backfill_takes, by_job,
+        shard_rows, demands, n_pending,
+    ) -> None:
+        """Package the monolithic solve's artifacts for attribution:
+        the FLOAT-model residual after backfill (backfill's own model —
+        the admission window's ceil-adjusted sibling is deliberately
+        not reused) plus one record per unplaced pending job, read
+        straight from the encoded batch rows."""
+        jobs: list[explain_mod.UnplacedJob] = []
+        for j in range(n_pending):
+            if j in by_job:
+                continue
+            rows = shard_rows.get(j)
+            if not rows:
+                continue
+            r0 = rows[0]
+            jobs.append(
+                explain_mod.UnplacedJob(
+                    j=j,
+                    partition=demands[j].partition,
+                    d=batch.demand[r0].copy(),
+                    need=len(rows),
+                    req=int(batch.req_features[r0]),
+                )
+            )
+        if not jobs:
+            # everything placed: no residual copy, no member-index
+            # build — a fully-placed tick pays the scan above and
+            # nothing else
+            self._explain_ctx = None
+            self._explain_memo = None
+            return
+        residual = placement.free_after.copy()
+        for row, node in backfill_takes:
+            residual[node] -= batch.demand[row]
+        self._explain_ctx = explain_mod.ExplainInputs(
+            free=residual,
+            capacity=snapshot.capacity,
+            features=snapshot.features,
+            part_members=self._part_members_of(snapshot),
+            jobs=jobs,
+        )
+        self._explain_memo = None
+
+    def _explain_codes(
+        self, pods, demands, by_job_names, n_pending
+    ) -> dict[int, str]:
+        """Attribute a primary reason code to every unplaced pending
+        job, from the last fresh solve's captured artifacts. Memoized on
+        (inputs, assignment) identity — a warm-start memo tick re-marks
+        the identical backlog, so attribution is pure replay."""
+        ctx = self._explain_ctx
+        if ctx is None:
+            return {}
+        memo = self._explain_memo
+        if memo is not None and memo[0] is ctx and memo[1] is by_job_names:
+            return memo[2]
+        pol = None
+        if self.policy is not None:
+            pol = explain_mod.PolicyContext(
+                ranks=[
+                    self.policy.class_rank_of_job(j)
+                    for j in range(n_pending)
+                ],
+                prios=[
+                    float(demands[j].priority) if demands[j] is not None
+                    else 0.0
+                    for j in range(n_pending)
+                ],
+                parts=[demands[j].partition for j in range(n_pending)],
+                placed={j for j in by_job_names if j < n_pending},
+                fair_share=self.policy.config.fair_share,
+                preempt_excluded=dict(
+                    self.policy.pool_excluded_rank_by_part
+                ),
+            )
+        codes = explain_mod.attribute(ctx, pol)
+        self._explain_memo = (ctx, by_job_names, codes)
+        return codes
+
+    def _build_pressure_ledger(self, ledger_rows: list) -> dict:
+        """The per-tick pressure ledger from the bind loop's attribution
+        rows ``(job index, code, partition, labels)``; class/tenant
+        resolve through the policy's own table (policy-off ticks carry
+        empty class/tenant cells). Published to /debug/schedz when
+        anything is actually unplaced."""
+        from slurm_bridge_tpu.policy.classes import TENANT_LABEL
+
+        shard_of = (
+            {job.j: job.shard for job in self._explain_ctx.jobs}
+            if self._explain_ctx is not None
+            else {}
+        )
+        table = self.policy.table if self.policy is not None else None
+        rows = []
+        for j, code, partition, labels in ledger_rows:
+            cls = table.resolve(labels).name if table is not None else ""
+            tenant = (labels.get(TENANT_LABEL, "") if labels else "") or ""
+            rows.append((code, partition, cls, tenant, shard_of.get(j, -1)))
+        led = explain_mod.build_ledger(rows)
+        if led["unplaced"]:
+            explain_mod.SCHEDZ.publish(led)
+        return led
+
+    def _unsubmitted_bind_nodes(self) -> set[str]:
+        """Hint nodes of store-BOUND sizecar pods whose submission has
+        not reached the agent yet (``job_ids`` empty): the agent still
+        reports their capacity free, but a solve residual already
+        committed it — the inventory re-base must not raise those rows
+        (the double-claim direction). One vectorized column mask on the
+        columnar store; the object fallback scans the bound buckets."""
+        out: set[str] = set()
+        table = self.store.table(Pod.KIND)
+        if table is not None:
+            c = table.cols
+            with self.store.locked():
+                if not table.row_of:
+                    return out
+                rows = np.fromiter(
+                    table.row_of.values(), np.int64, len(table.row_of)
+                )
+                keep = (
+                    (c.role[rows] == PodRole.SIZECAR)
+                    & ~c.deleted[rows]
+                    & (c.node[rows] != "")
+                    & (c.njobs[rows] == 0)
+                )
+                for hints in c.hint[rows[keep]]:
+                    out.update(hints)
+            return out
+        for p in self.store.list(Pod.KIND):
+            if (
+                p.spec.role == PodRole.SIZECAR
+                and p.spec.node_name
+                and not p.status.job_ids
+                and not p.meta.deleted
+            ):
+                out.update(p.spec.placement_hint)
+        return out
+
+    def note_inventory(self, partition: str, nodes) -> None:
+        """Maintain the streaming-admission window from a provider's
+        periodic inventory probe (ROADMAP follow-up c): on ticks where
+        no solve re-based the window — an idle cluster, the steady-bind
+        skip — completions the agent already reports re-open fast-path
+        capacity WITHOUT waiting for the next solve. The admitter gates
+        the re-base under its own lock (solve ticks forbid it: a
+        provider probes BEFORE converging its submits, so its view
+        predates the tick's binds), and nodes holding bound-but-not-yet
+        -submitted pods keep the window's conservative rows."""
+        adm = self.admission
+        if adm is None:
+            return
+        adm.rebase_from_inventory(
+            nodes, skip_nodes=self._unsubmitted_bind_nodes()
+        )
+
     def admit(self, name: str):
         """One streaming-admission attempt for a pending pod — the fast
         path's public entry, called at ARRIVAL time (event-driven), not
@@ -1353,7 +1659,16 @@ class PlacementScheduler:
             return AdmitResult(eligible=False)
         demand = pod.spec.demand
         rank = adm.eligibility_rank(pod.meta.labels, demand)
+        trail = self.explain_trail
+        if trail is not None and not trail.matches(name):
+            trail = None
         if rank is None:
+            if trail is not None:
+                trail.add(
+                    "admission",
+                    "not fast-path eligible (class/gang size); waits for "
+                    "the batch tick",
+                )
             return AdmitResult(eligible=False)
         with TRACER.span("admission.fastpath") as span:
             # one critical section from reservation to commit: arrivals
@@ -1391,6 +1706,17 @@ class PlacementScheduler:
                         span.set_tag("outcome", reason)
                         out = AdmitResult(eligible=True, reason=reason)
         adm.observe_latency(time.perf_counter() - t0)
+        if trail is not None:
+            if out.bound:
+                trail.add(
+                    "admission", f"fast-bound to nodes {','.join(out.hint)}"
+                )
+            else:
+                trail.add(
+                    "admission",
+                    f"fast-path miss ({out.reason}); falls through to the "
+                    "batch tick",
+                )
         return out
 
     def _preempt(self, pod: Pod) -> bool:

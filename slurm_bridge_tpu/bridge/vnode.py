@@ -284,6 +284,7 @@ class VirtualNodeProvider:
         status_interval: float = 10.0,
         incremental: bool = False,
         use_coldec: bool = True,
+        inventory_listener=None,
     ):
         self.store = store
         self.client = client
@@ -372,6 +373,14 @@ class VirtualNodeProvider:
         self._nodes_fetch_lock = threading.Lock()
         #: (nodes list ref) → summed capacity memo for register()
         self._cap_memo: tuple | None = None
+        #: ``(partition, nodes) ->`` callback fired when the decoded
+        #: inventory CONTENT changes (identity-keyed — the decode caches
+        #: replay the same list object while bytes are unchanged, so an
+        #: idle shard reports nothing). The scheduler hangs the
+        #: streaming-admission window maintenance here (ROADMAP
+        #: follow-up c); None costs one attribute check per fetch.
+        self._inventory_listener = inventory_listener
+        self._inv_reported: object = None
 
     # ---- inventory / capacity ----
 
@@ -398,6 +407,20 @@ class VirtualNodeProvider:
         else:
             part = partition_from_proto(part_resp)
             nodes = self._nodes_full(part)
+        if (
+            self._inventory_listener is not None
+            and nodes is not self._inv_reported
+        ):
+            # report CONTENT changes only (the decode caches are
+            # identity-stable on unchanged bytes) — the admission
+            # window's idle-cluster maintenance seam
+            self._inv_reported = nodes
+            try:
+                self._inventory_listener(self.partition, nodes)
+            except Exception:
+                log.exception(
+                    "inventory listener failed for %s", self.partition
+                )
         with self._inv_lock:
             self._inv = (time.monotonic(), part, nodes)
         return part, nodes

@@ -55,6 +55,7 @@ def start_observability(
     )
     if not getattr(args, "metrics_port", 0):
         return None
+    from slurm_bridge_tpu.obs.explain import SCHEDZ
     from slurm_bridge_tpu.obs.profiling import sample_profile
 
     httpd = REGISTRY.serve(
@@ -64,10 +65,13 @@ def start_observability(
             # py-spy-style stack sampling (obs/profiling.py) — the
             # reference's net/http/pprof side-effect import, rebuilt
             "/debug/profilez": lambda: ("text/plain", sample_profile()),
+            # placement pressure (ISSUE 15): the live reason-code
+            # ledger every PlacementScheduler publishes per solve tick
+            "/debug/schedz": lambda: ("text/plain", SCHEDZ.render()),
         },
         health_checks=health_checks or {"ping": lambda: None},
         ready_checks=ready_checks or {},
     )
-    log.info("%s: metrics/healthz/tracez/profilez on :%d",
+    log.info("%s: metrics/healthz/tracez/profilez/schedz on :%d",
              service, args.metrics_port)
     return httpd

@@ -142,6 +142,12 @@ class PlacementPolicy:
         self.pool_excluded_last = 0
         self.backfill_candidates_last = 0
         self.backfill_binds_last = 0
+        #: partition → MIN class rank among preemptible incumbents the
+        #: bounded pool EXCLUDED this tick (explainability, ISSUE 15):
+        #: an unplaced job of a strictly higher rank in that partition
+        #: could have been helped by a bigger ``max_preemptions_per_tick``
+        #: — the PREEMPTION_CAP attribution reads this
+        self.pool_excluded_rank_by_part: dict[str, int] = {}
         #: fair-share usage changed since the last store save (PR-10:
         #: the ledger rides the WAL through a PolicyState singleton)
         self._usage_dirty = False
@@ -223,6 +229,15 @@ class PlacementPolicy:
         pool_idx = [i for _, i in eligible[:cap]]
         self.pool_size_last = len(pool_idx)
         self.pool_excluded_last = len(incumbents) - len(pool_idx)
+        # cap-excluded ELIGIBLE incumbents, by partition (min rank) —
+        # the PREEMPTION_CAP explainability signal: these could have
+        # been displaced if the churn bound were higher
+        self.pool_excluded_rank_by_part = {}
+        for (rank, _prio, _name), i in eligible[cap:]:
+            part = incumbents[i].partition
+            cur = self.pool_excluded_rank_by_part.get(part)
+            if cur is None or rank < cur:
+                self.pool_excluded_rank_by_part[part] = rank
         pool = [incumbents[i] for i in pool_idx]
 
         # effective priorities: dense per-band integers, exact in float32

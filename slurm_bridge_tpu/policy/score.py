@@ -76,6 +76,12 @@ class QualityTracker:
         # arrive-then-solve tick otherwise hides.
         self._interactive: set[str] = set()
         self._fastpath_ms: dict[str, float] = {}
+        # ---- placement explainability (ISSUE 15) ----
+        # Per-tick pressure ledgers from the scheduler's attribution
+        # pass; the scorecard rolls them into ``wait_reasons`` —
+        # job-ticks spent pending, by structured reason code — and the
+        # top reason × partition × class × tenant cells.
+        self._pressure: list[dict] = []
 
     # ---- per-event hooks ----
 
@@ -103,6 +109,10 @@ class QualityTracker:
 
     def note_preempts(self, count: int) -> None:
         self._preempts.append(count)
+
+    def note_pressure(self, ledger: dict) -> None:
+        """One solve tick's pressure ledger (obs/explain.py schema)."""
+        self._pressure.append(ledger)
 
     def note_resize(self) -> None:
         self.resizes += 1
@@ -196,6 +206,14 @@ class QualityTracker:
         out["fastpath_binds"] = len(self._fastpath_ms)
         out["interactive_latency_p50_ms"] = _pct(lat, 50)
         out["interactive_latency_p99_ms"] = _pct(lat, 99)
+        # ---- wait-reason attribution (ISSUE 15 scorecard axis) ----
+        # Job-ticks spent pending, by structured reason code — the
+        # "WHY is work waiting" companion to the wait percentiles
+        # above. Empty with explain off (or a run that never left
+        # anything unplaced).
+        from slurm_bridge_tpu.obs.explain import merge_ledgers
+
+        out.update(merge_ledgers(self._pressure))
         if extra:
             out.update(extra)
         return out

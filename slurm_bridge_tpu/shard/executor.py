@@ -40,6 +40,7 @@ import time
 
 import numpy as np
 
+from slurm_bridge_tpu.obs import explain as explain_mod
 from slurm_bridge_tpu.obs.metrics import REGISTRY, Histogram
 from slurm_bridge_tpu.obs.tracing import TRACER, with_current_span
 from slurm_bridge_tpu.shard.planner import (
@@ -142,6 +143,17 @@ class ShardExecutor:
         #: admission-off ticks pay nothing for the seam.
         self.last_window: tuple | None = None
         self._capture_residual = False
+        #: explainability seam (ISSUE 15): the merged residual + one
+        #: record per unplaced pending job (shard id, spill flag), what
+        #: the scheduler's attribution pass reads. None when explain is
+        #: off — explain-off ticks pay nothing for the seam.
+        self.last_explain_inputs = None
+        self._explain = False
+        self._trail = None
+        self._trail_job = -1
+        #: (nodes list ref) → [N, 3] capacity columns memo (identity-
+        #: stable node lists make steady generations rebuild nothing)
+        self._explain_cap_memo: tuple | None = None
         #: (partitions ref, plan ref) → (partition_codes, partition_of)
         #: memo for the window snapshot build
         self._window_parts: tuple | None = None
@@ -210,6 +222,9 @@ class ShardExecutor:
         policy=None,
         deductions=None,
         capture_residual: bool = False,
+        explain: bool = False,
+        trail=None,
+        trail_job: int = -1,
     ) -> tuple[dict[int, list[str]], list[int]]:
         """The sharded equivalent of ``PlacementScheduler._solve_local``:
         returns (global job index → assigned node names, global
@@ -221,6 +236,10 @@ class ShardExecutor:
         per-shard snapshot, so the fan-out can never double-claim
         fast-claimed capacity."""
         self._capture_residual = capture_residual
+        self._explain = explain
+        self._trail = trail
+        self._trail_job = trail_job
+        self.last_explain_inputs = None
         plan = self._ensure_plan(partitions, nodes)
         _shard_ticks.inc()
         self.ticks_total += 1
@@ -249,6 +268,17 @@ class ShardExecutor:
             route_span.count("jobs", len(all_pods))
             route_span.count("shards", len(routed))
             route_span.count("nodes", len(nodes))
+            if trail is not None and trail_job >= 0:
+                for sid in sorted(routed):
+                    if trail_job in routed[sid]:
+                        shard = plan.shards[sid]
+                        trail.add(
+                            "route",
+                            f"routed whole to shard {sid} (partitions "
+                            f"{','.join(shard.partitions)}, "
+                            f"{len(shard.node_idx)} nodes)",
+                        )
+                        break
         _shard_jobs.inc(len(all_pods))
         self.last_shards_used = len(routed)
         if demand_key is None:
@@ -599,6 +629,16 @@ class ShardExecutor:
 
         self.last_reconcile_attempts = len(failed_gangs)
         self.last_reconcile_placed = 0
+        #: global feature masks, shared by reconcile and the explain
+        #: capture below — built at most once per tick, and ONLY when
+        #: something actually needs them (spilled gangs here; unplaced
+        #: jobs in the capture's own fallback)
+        gfeats = (
+            self._global_features(plan, work, nodes) if failed_gangs else None
+        )
+        #: gangs that reached the reconcile pass and STILL failed — the
+        #: SHARD_SPILL population the attribution pass marks
+        spilled: set[int] = set()
         if failed_gangs:
             # the cross-shard pass runs ONLY when some shard reported
             # spill — zero failed gangs means zero reconcile cost (and no
@@ -607,13 +647,26 @@ class ShardExecutor:
                 placed = reconcile_gangs(
                     failed_gangs,
                     residual,
-                    self._global_features(plan, work, nodes),
+                    gfeats,
                     plan.part_nodes,
                     limit=self.config.reconcile_limit,
                 )
                 rec_span.count("attempts", len(failed_gangs))
                 rec_span.count("placed", len(placed))
             self.last_reconcile_placed = len(placed)
+            spilled = {c["j"] for c in failed_gangs} - {j for j, _ in placed}
+            if self._trail is not None and self._trail_job >= 0:
+                tj = self._trail_job
+                if any(c["j"] == tj for c in failed_gangs):
+                    took = next((ns for j, ns in placed if j == tj), None)
+                    self._trail.add(
+                        "reconcile",
+                        "cross-shard pass placed the gang on the merged "
+                        "residual"
+                        if took is not None
+                        else "cross-shard pass attempted the gang against "
+                        "the merged residual and could not place it",
+                    )
             if win_adj is not None and placed:
                 # reconcile debits `residual` at the float model (that
                 # residual is reconcile's own byte-pinned contract);
@@ -642,7 +695,64 @@ class ShardExecutor:
                 residual - win_adj,
                 plan,
             )
+        if self._explain:
+            # explainability capture (ISSUE 15): one record per unplaced
+            # pending job, read straight from the per-shard batch rows —
+            # the residual is the float-model merged free AFTER backfill
+            # and reconcile (the window above keeps its own ceil-adjusted
+            # copy, so sharing `residual` here is safe)
+            jobs_x: list[explain_mod.UnplacedJob] = []
+            for item in work:
+                (sid, _st, _snap, batch, _inc, shard_rows, jobs_s,
+                 n_pend_local) = item
+                for lj in range(n_pend_local):
+                    j = jobs_s[lj]
+                    if j in by_job_names:
+                        continue
+                    rows = shard_rows.get(lj)
+                    if not rows:
+                        continue
+                    r0 = rows[0]
+                    jobs_x.append(
+                        explain_mod.UnplacedJob(
+                            j=j,
+                            partition=demands[j].partition,
+                            d=batch.demand[r0].copy(),
+                            need=len(rows),
+                            req=int(batch.req_features[r0]),
+                            shard=sid,
+                            spilled=j in spilled,
+                        )
+                    )
+            if jobs_x:
+                self.last_explain_inputs = explain_mod.ExplainInputs(
+                    free=residual,
+                    capacity=self._capacity_cols(nodes),
+                    features=(
+                        gfeats
+                        if gfeats is not None
+                        else self._global_features(plan, work, nodes)
+                    ),
+                    part_members=plan.part_nodes,
+                    jobs=jobs_x,
+                )
+            # a fully-placed tick keeps last_explain_inputs None: no
+            # capacity columns, no global feature scatter — zero
+            # explain cost beyond the unplaced scan above
         return by_job_names, lost_jobs
+
+    def _capacity_cols(self, nodes) -> np.ndarray:
+        """[N, 3] total-capacity columns on the global node axis,
+        memoized on the (identity-stable) node list the decode caches
+        replay while the inventory is unchanged."""
+        memo = self._explain_cap_memo
+        if memo is not None and memo[0] is nodes:
+            return memo[1]
+        cap = np.asarray(
+            [(nd.cpus, nd.memory_mb, nd.gpus) for nd in nodes], np.float32
+        )
+        self._explain_cap_memo = (nodes, cap)
+        return cap
 
     def _window_snapshot(self, plan, work, nodes, demands):
         """A global-axis ClusterSnapshot for the admission window: the

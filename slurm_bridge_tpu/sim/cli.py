@@ -5,6 +5,8 @@
     python -m slurm_bridge_tpu.sim --all --scale 0.25
     python -m slurm_bridge_tpu.sim --smoke          # the `make sim-smoke` gate
     python -m slurm_bridge_tpu.sim full_50kx10k     # slow headline (minutes)
+    python -m slurm_bridge_tpu.sim sharded_gang_split --explain job-000007
+                                    # one job's placement decision trail
 
 One JSON object per scenario on stdout; ``--out`` additionally writes the
 array to a file. The headline scenario also emits a one-line
@@ -24,7 +26,7 @@ import dataclasses
 import json
 import sys
 
-from slurm_bridge_tpu.sim.harness import run_scenario
+from slurm_bridge_tpu.sim.harness import SimHarness, run_scenario
 from slurm_bridge_tpu.sim.scenarios import (
     ADMISSION_SCENARIOS,
     CHAOS_SCENARIOS,
@@ -138,9 +140,11 @@ def _smoke(names: tuple[str, ...] = SMOKE_SCENARIOS, label: str = "sim-smoke") -
         plan_kinds = {f.kind for f in a.scenario.faults.faults}
         bridge_faulted = bool(plan_kinds & set(BRIDGE_KINDS))
         agent_faulted = bool(plan_kinds & set(AGENT_KINDS))
+        wait_reasons = a.quality.get("wait_reasons", {})
         line = {
             "scenario": name,
             "deterministic": det_a == det_b,
+            "wait_reasons": wait_reasons,
             "violations": len(a.determinism["invariant_violations"]),
             "bound_total": a.determinism["bound_total"],
             "pending_final": a.determinism["pending_final"],
@@ -163,6 +167,19 @@ def _smoke(names: tuple[str, ...] = SMOKE_SCENARIOS, label: str = "sim-smoke") -
         if a.determinism["invariant_violations"]:
             first = a.determinism["invariant_violations"][0]
             failures.append(f"{name}: invariant violated: {first}")
+        if a.scenario.explain and wait_reasons.get("UNKNOWN"):
+            # ISSUE 15 acceptance: with explain on, every unplaced job
+            # carries a STRUCTURED reason — an UNKNOWN leaking through
+            # means an attribution-less mark path regressed
+            failures.append(
+                f"{name}: {wait_reasons['UNKNOWN']} unplaced job-ticks "
+                "fell back to the generic UNKNOWN reason with explain on"
+            )
+        if name == "sharded_gang_split" and a.scenario.explain and not wait_reasons:
+            failures.append(
+                f"{name}: no wait_reasons recorded — the explain plane "
+                "is dead on the sharded tick"
+            )
         if a.scenario.faults and a.scenario.expect_drain:
             rec = a.determinism["recovery_ticks"]
             bound = a.scenario.max_recovery_ticks
@@ -338,7 +355,21 @@ def _quality(label: str = "quality-smoke") -> int:
             "preempted_total": q["preempted_total"],
             "backfill_binds": q.get("backfill_binds"),
             "resizes": q["resizes"],
+            "wait_reasons": q.get("wait_reasons", {}),
         }
+        if a.scenario.explain and q.get("wait_reasons", {}).get("UNKNOWN"):
+            failures.append(
+                f"{name}: {q['wait_reasons']['UNKNOWN']} unplaced "
+                "job-ticks carry the generic UNKNOWN reason with "
+                "explain on"
+            )
+        if name == "multi_tenant_storm" and a.scenario.explain and not q.get(
+            "wait_reasons"
+        ):
+            failures.append(
+                f"{name}: no wait_reasons recorded — the explain plane "
+                "is dead on the oversubscribed storm"
+            )
 
         if name == "multi_tenant_storm":
             off = run(name, policy=None)
@@ -493,6 +524,20 @@ def _admission(label: str = "admission-smoke") -> int:
                 f"(floor {g['min_fastpath_binds']}) — the fast path is "
                 "dormant"
             )
+        if not adm.get("misses"):
+            # ISSUE 15 satellite: the by-reason miss ledger must be
+            # live in the scenario JSON — cold-start arrivals alone
+            # guarantee no_window/not_ready entries, so an empty dict
+            # means the accounting broke, not that nothing missed
+            failures.append(
+                f"{name}: FastPathAdmitter.misses is empty — the "
+                "by-reason miss accounting is dead"
+            )
+        if q.get("admission_misses") != adm.get("misses"):
+            failures.append(
+                f"{name}: quality.admission_misses diverged from the "
+                "admitter's own ledger"
+            )
         off = run_scenario(
             dataclasses.replace(
                 _build(name, seed=None, scale=SMOKE_SCALE, ticks=None),
@@ -582,6 +627,11 @@ def main(argv: list[str] | None = None) -> int:
                         "(double-run determinism + interactive latency "
                         "p99 + admission-off utilization twin)")
     parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--explain", default="", metavar="JOB",
+                        help="render one job's placement decision trail "
+                        "(route -> solve -> backfill/reconcile -> "
+                        "reason) for the named job or sizecar pod; "
+                        "requires exactly one scenario")
     parser.add_argument("--scale", type=float, default=1.0,
                         help="multiply pod/node counts (default 1.0)")
     parser.add_argument("--ticks", type=int, default=None)
@@ -626,14 +676,30 @@ def main(argv: list[str] | None = None) -> int:
     if unknown:
         parser.error(f"unknown scenarios {unknown}; see --list")
 
+    if args.explain and len(names) != 1:
+        parser.error("--explain traces one job through ONE scenario")
+
     results = []
     gate_failures: list[str] = []
     for name in names:
         sc = _build(name, seed=args.seed, scale=args.scale, ticks=args.ticks)
+        if args.explain:
+            # --explain <job>: trace one job's decision trail (ISSUE 15
+            # sink 3). Accept the job name or the sizecar pod name —
+            # the trail is recorded against the POD the scheduler sees.
+            target = args.explain
+            if not target.endswith("-sizecar"):
+                target = f"{target}-sizecar"
+            sc = dataclasses.replace(sc, explain_target=target)
         print(f"# running {name} "
               f"(~{sc.workload.jobs} jobs x {sc.cluster.num_nodes} nodes, "
               f"{sc.ticks} ticks)", file=sys.stderr, flush=True)
-        result = run_scenario(sc)
+        if args.explain:
+            harness = SimHarness(sc)
+            result = harness.run()
+            print(harness.scheduler.explain_trail.render(), flush=True)
+        else:
+            result = run_scenario(sc)
         results.append(result)
         print(json.dumps(result.as_dict()), flush=True)
         if name.startswith("full_") and "crash" not in name:

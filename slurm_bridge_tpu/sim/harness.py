@@ -212,6 +212,17 @@ class Scenario:
     #: headline shape now that the recorder's rollup survives span
     #: drops. None = record only.
     phase_reconcile_pct: float | None = None
+    #: placement explainability (ISSUE 15): structured per-job reason
+    #: codes + the per-tick pressure ledger (flight record,
+    #: ``quality.wait_reasons``, /debug/schedz). On by default —
+    #: digest-byte-identical to off BY CONSTRUCTION (attribution only
+    #: observes solve artifacts; ``profile_explain_overhead`` gates the
+    #: claim); False restores the generic reason strings byte-for-byte.
+    explain: bool = True
+    #: trace ONE job's decision trail (``--explain <job>`` on the CLI):
+    #: the sizecar pod name (or job name — the CLI normalizes) whose
+    #: route/solve/backfill/reason decisions are recorded per tick
+    explain_target: str = ""
 
 
 @dataclass
@@ -423,6 +434,11 @@ class SimHarness:
         #: in pending_before, so the batch diff cannot see them)
         self._fast_bound_tick: list[str] = []
         self._tick_phases: list[dict[str, float]] = []
+        #: per-tick pressure ledgers (ISSUE 15): (tick, ledger) for every
+        #: solve tick that attributed reasons — what the flight record
+        #: carries per tick and the explain tests pin (per-reason counts
+        #: sum to the unplaced count by construction)
+        self._explain_ledgers: list[tuple[int, dict]] = []
         #: per-tick steady-state accounting (PR-11): arrivals, binds,
         #: commits, agent RPCs, solver invocations and the derived
         #: ``steady`` verdict — what ``steady_tick_p50_ms`` and the
@@ -541,6 +557,12 @@ class SimHarness:
             provider_status_interval=float("inf"),
             incremental=scenario.incremental,
             use_coldec=scenario.coldec,
+            # admission-window maintenance from the periodic inventory
+            # probe (ROADMAP follow-up c) — late-bound: the scheduler is
+            # constructed a few lines below, before any provider syncs
+            inventory_listener=lambda part, nodes: (
+                self.scheduler.note_inventory(part, nodes)
+            ),
         )
         # fresh policy engine per stack incarnation: a crash loses the
         # in-memory fair-share accumulator exactly as production would
@@ -569,7 +591,16 @@ class SimHarness:
             # re-bases its window (arrivals fall through to the batch
             # tick meanwhile, the safe direction)
             admission=scenario.admission,
+            explain=scenario.explain,
+            explain_target=scenario.explain_target,
         )
+        if self.scheduler.explain_trail is not None:
+            # one trail per RUN: a crash/failover rebuild keeps the
+            # lines recorded by the previous incarnation
+            prev_lines = getattr(self, "_trail_lines", None)
+            if prev_lines is not None:
+                self.scheduler.explain_trail.lines = prev_lines
+            self._trail_lines = self.scheduler.explain_trail.lines
         self._pod_watch = self.store.watch((Pod.KIND,))
         self._node_watch = self.store.watch((VirtualNode.KIND,))
 
@@ -1051,7 +1082,17 @@ class SimHarness:
 
     def run_tick(self, tick: int, *, arrivals: bool = True) -> dict[str, float]:
         with self.flight.tick(tick):
-            return self._run_tick(tick, arrivals=arrivals)
+            phases = self._run_tick(tick, arrivals=arrivals)
+        # pressure ledger (ISSUE 15 sink 2): the solve tick's reason ×
+        # partition × class × tenant counts ride the per-tick flight
+        # record and the quality scorecard's wait_reasons axis
+        ledger = getattr(self.scheduler, "last_explain_ledger", None)
+        if ledger is not None:
+            self._explain_ledgers.append((tick, ledger))
+            self.quality.note_pressure(ledger)
+            if self.flight.records:
+                self.flight.records[-1]["pressure"] = ledger
+        return phases
 
     def _run_tick(self, tick: int, *, arrivals: bool = True) -> dict[str, float]:
         cpu0 = time.process_time()
@@ -1067,6 +1108,8 @@ class SimHarness:
         self._agent_faults(tick)
         self._bridge_faults(tick)
         self._apply_fault_boundaries(tick)
+        if self.scheduler.explain_trail is not None:
+            self.scheduler.explain_trail.tick = tick
         # store/scheduler may have been replaced by a bridge fault above —
         # snapshot the write/solve baselines on the objects this tick runs
         commits0 = sum(self.store.commit_counts_snapshot().values())
@@ -1602,6 +1645,13 @@ class SimHarness:
             # quality scorecard: they are placement-quality facts of the
             # sharded tick (ISSUE 10 acceptance)
             policy_extra["shard"] = self.scheduler.shard.stats()
+        if self.scheduler.admission is not None:
+            # fast-path miss attribution (ISSUE 15 satellite): why
+            # eligible arrivals fell through to the batch tick — the
+            # admission-side half of the wait_reasons story
+            policy_extra["admission_misses"] = dict(
+                sorted(self.scheduler.admission.misses.items())
+            )
         result = ScenarioResult(
             scenario=sc,
             determinism=determinism,

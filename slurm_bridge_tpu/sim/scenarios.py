@@ -593,6 +593,26 @@ def interactive_storm(scale: float = 1.0, seed: int = 61) -> Scenario:
             duration_range=(30.0, 60.0),
             priority_classes=(("batch", 0.7), ("production", 0.3)),
         ),
+        # the cold-start probe (ISSUE 15 satellite): two production
+        # arrivals at tick 0, BEFORE any virtual node or admission
+        # window exists — deterministic ``not_ready`` entries in
+        # ``FastPathAdmitter.misses``, which admission-smoke asserts
+        # non-empty (the by-reason ledger must be live in the scenario
+        # JSON, not silently zeroed). They land inside the latency
+        # warmup, so the p99 gate still measures steady state only.
+        faults=FaultPlan(
+            (
+                Fault(
+                    kind="preemption_storm",
+                    start_tick=0,
+                    end_tick=1,
+                    jobs=2,
+                    priority=10,
+                    storm_class="production",
+                    storm_cpus=(2, 4),
+                ),
+            )
+        ),
         ticks=24,
         expect_drain=False,
         drain_grace_ticks=0,

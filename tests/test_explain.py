@@ -1,0 +1,586 @@
+"""Placement explainability plane (ISSUE 15).
+
+Five contract families:
+
+1. **Taxonomy closure + interning** — every reason string parses back to
+   exactly one code of the closed set; equal (code, detail) pairs share
+   one string object (the 43k-mark batch must not allocate per pod).
+2. **Vectorized ≡ oracle** (the fuzzed property test): on randomized
+   snapshots/backlogs — feasible-by-construction demand shapes drawn
+   from the node population plus deliberate misfits — the vectorized
+   attribution agrees with a brute-force per-job re-check of the ladder
+   (can any node host it? any ``need`` nodes for the gang? was it
+   fairshare-banded? preemption-capped?), and every unplaced job gets
+   exactly one primary code.
+3. **Explain observes, never decides** — explain on ≡ off in digest,
+   final state and event counts (the bench-smoke overhead gate re-pins
+   this; here it rides the tier-1 suite at toy scale).
+4. **Sinks** — pods carry ``Unschedulable: CODE: text``; per-tick
+   pressure-ledger counts sum exactly to the unplaced count; the
+   scorecard's ``quality.wait_reasons`` rolls them up; /debug/schedz
+   renders; ``--explain <job>`` records a decision trail for a spilled
+   gang (route → reconcile → verdict → bind).
+5. **Satellites** — log↔trace correlation in both formatters, and the
+   idle-window inventory re-base (ROADMAP streaming-admission
+   follow-up c): a completion re-opens fast-path capacity without an
+   intervening solve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+
+import numpy as np
+
+from slurm_bridge_tpu.bridge.objects import BridgeJobSpec
+from slurm_bridge_tpu.obs import explain
+from slurm_bridge_tpu.policy.classes import CLASS_LABEL
+from slurm_bridge_tpu.sim.harness import Scenario, SimHarness, run_scenario
+from slurm_bridge_tpu.sim.scenarios import SCENARIOS
+from slurm_bridge_tpu.sim.trace import ClusterSpec, JobArrival, WorkloadSpec
+
+
+# ------------------------------------------------------------ taxonomy
+
+
+def test_reason_strings_intern_and_parse():
+    for code in explain.CODES:
+        s = explain.reason_string(code)
+        assert s is explain.reason_string(code)  # interned
+        assert s.startswith("Unschedulable: ")
+        assert explain.code_of_reason(s) == code
+    detailed = explain.reason_string(explain.NO_READY_VNODE, "part3")
+    assert "part3" in detailed
+    assert detailed is explain.reason_string(explain.NO_READY_VNODE, "part3")
+    assert explain.code_of_reason("Unschedulable: insufficient capacity") is None
+    assert explain.code_of_reason("Running fine") is None
+
+
+def test_ledger_counts_sum_to_unplaced_by_construction():
+    rows = [
+        (explain.PARTITION_FULL, "p0", "batch", "t1", 0),
+        (explain.PARTITION_FULL, "p0", "batch", "t1", 0),
+        (explain.FRAGMENTED, "p1", "", "", 1),
+        (explain.NO_READY_VNODE, "p2", "", "", -1),
+    ]
+    led = explain.build_ledger(rows)
+    assert led["unplaced"] == 4
+    assert sum(led["reasons"].values()) == led["unplaced"]
+    assert led["cells"]["PARTITION_FULL|p0|batch|t1"] == 2
+    assert led["shards"]["0"] == {
+        "top": explain.PARTITION_FULL, "n": 2, "unplaced": 2,
+    }
+    agg = explain.merge_ledgers([led, led])
+    assert agg["wait_reasons"][explain.FRAGMENTED] == 2
+    assert sum(agg["wait_reasons"].values()) == 8
+
+
+def test_schedz_renders_recent_ledgers():
+    page = explain.SchedzPage(capacity=4)
+    page.publish(
+        explain.build_ledger([(explain.GANG_ATOMIC, "p0", "", "", 2)])
+    )
+    text = page.render()
+    assert "GANG_ATOMIC" in text
+    assert "shard 2" in text
+    page.clear()
+    assert "no solve ticks" in page.render()
+
+
+# ------------------------------------------- fuzzed vectorized ≡ oracle
+
+
+def _oracle_code(inputs, pol, job):
+    """Brute-force per-job re-derivation of the attribution ladder."""
+    m = inputs.part_members.get(job.partition)
+    if m is None or len(m) == 0:
+        return explain.NO_FEASIBLE_NODE
+    d, req = job.d, np.uint32(job.req)
+
+    def feat_ok(i):
+        return (req & ~inputs.features[i]) == 0
+
+    cap_count = sum(
+        1 for i in m if bool((inputs.capacity[i] >= d).all()) and feat_ok(i)
+    )
+    free_count = sum(
+        1 for i in m if bool((inputs.free[i] >= d).all()) and feat_ok(i)
+    )
+    if cap_count == 0:
+        return explain.NO_FEASIBLE_NODE
+    if job.need > 1 and cap_count < job.need:
+        return explain.GANG_ATOMIC
+    if free_count >= job.need:
+        return explain.SHARD_SPILL if job.spilled else explain.NO_DELAY_GUARD
+    if pol is not None:
+        rank = pol.ranks[job.j]
+        excl = pol.preempt_excluded.get(job.partition)
+        if excl is not None and rank > excl:
+            return explain.PREEMPTION_CAP
+        if pol.fair_share:
+            bars = [
+                float(pol.prios[j])
+                for j in pol.placed
+                if pol.parts[j] == job.partition and pol.ranks[j] == rank
+            ]
+            if bars and float(pol.prios[job.j]) > min(bars):
+                return explain.FAIRSHARE_DEFERRED
+    total_free = np.clip(inputs.free[m], 0.0, None).sum(axis=0)
+    if bool((total_free >= d * job.need).all()):
+        return explain.FRAGMENTED
+    return explain.PARTITION_FULL
+
+
+def test_fuzzed_attribution_matches_oracle():
+    rng = np.random.default_rng(20260804)
+    for trial in range(60):
+        n = int(rng.integers(6, 30))
+        nparts = int(rng.integers(1, 4))
+        parts = [f"p{k}" for k in range(nparts)]
+        part_of = rng.integers(0, nparts, size=n)
+        capacity = np.stack(
+            [
+                rng.choice([8.0, 16.0, 32.0], size=n),
+                rng.choice([8192.0, 16384.0], size=n),
+                rng.choice([0.0, 0.0, 4.0], size=n),
+            ],
+            axis=1,
+        ).astype(np.float32)
+        free = (capacity * rng.uniform(0.0, 1.0, size=(n, 1))).astype(
+            np.float32
+        )
+        features = rng.integers(0, 4, size=n).astype(np.uint32)
+        part_members = {
+            p: np.nonzero(part_of == k)[0] for k, p in enumerate(parts)
+        }
+        n_pending = int(rng.integers(4, 16))
+        ranks = rng.integers(0, 3, size=n_pending).tolist()
+        prios = rng.integers(0, 100, size=n_pending).tolist()
+        job_parts = [
+            parts[int(rng.integers(0, nparts))] for _ in range(n_pending)
+        ]
+        placed = {
+            int(j)
+            for j in rng.choice(
+                n_pending, size=int(rng.integers(0, n_pending)), replace=False
+            )
+        }
+        unplaced = sorted(set(range(n_pending)) - placed)
+        jobs = []
+        for j in unplaced:
+            # feasible-by-construction half the time (a shape drawn from
+            # the node population), deliberate misfit otherwise
+            if rng.random() < 0.5:
+                i = int(rng.integers(0, n))
+                d = capacity[i] * rng.choice([0.25, 0.5, 1.0])
+            else:
+                d = np.asarray(
+                    [rng.choice([4.0, 64.0, 512.0]),
+                     rng.choice([1024.0, 65536.0]),
+                     rng.choice([0.0, 8.0])],
+                    np.float32,
+                )
+            jobs.append(
+                explain.UnplacedJob(
+                    j=j,
+                    partition=(
+                        job_parts[j] if rng.random() < 0.9 else "ghost"
+                    ),
+                    d=d.astype(np.float32),
+                    need=int(rng.integers(1, 5)),
+                    req=int(rng.integers(0, 4)),
+                    shard=int(rng.integers(-1, 3)),
+                    spilled=bool(rng.random() < 0.3),
+                )
+            )
+        inputs = explain.ExplainInputs(
+            free=free,
+            capacity=capacity,
+            features=features,
+            part_members=part_members,
+            jobs=jobs,
+        )
+        pol = None
+        if rng.random() < 0.7:
+            pol = explain.PolicyContext(
+                ranks=ranks,
+                prios=prios,
+                parts=job_parts,
+                placed=placed,
+                fair_share=bool(rng.random() < 0.7),
+                preempt_excluded={
+                    p: int(rng.integers(0, 2))
+                    for p in parts
+                    if rng.random() < 0.4
+                },
+            )
+        codes = explain.attribute(inputs, pol)
+        assert sorted(codes) == [job.j for job in jobs], (
+            f"trial {trial}: every unplaced job must get exactly one code"
+        )
+        for job in jobs:
+            want = _oracle_code(inputs, pol, job)
+            assert codes[job.j] == want, (
+                f"trial {trial} job {job.j}: vectorized {codes[job.j]} "
+                f"!= oracle {want} (need={job.need}, part={job.partition})"
+            )
+            assert codes[job.j] in explain.CODES
+
+
+# ------------------------------------------- explain observes, never decides
+
+
+def test_explain_on_off_digest_and_events_identical():
+    sc = SCENARIOS["burst_backlog"](scale=0.06)
+    on = run_scenario(sc)
+    off = run_scenario(dataclasses.replace(sc, explain=False))
+    assert on.determinism["digest"] == off.determinism["digest"]
+    assert (
+        on.determinism["final_state_digest"]
+        == off.determinism["final_state_digest"]
+    )
+    assert on.determinism["events"] == off.determinism["events"]
+    # off restores the legacy strings byte-for-byte: no wait_reasons
+    assert off.quality.get("wait_reasons") == {}
+    assert on.quality.get("wait_reasons")
+
+
+# --------------------------------------------------------------- sinks
+
+
+def test_storm_pods_carry_structured_reasons_and_ledger_sums():
+    sc = SCENARIOS["multi_tenant_storm"](scale=0.1)
+    h = SimHarness(sc)
+    r = h.run()
+    assert not r.determinism["invariant_violations"]
+    assert h._explain_ledgers, "an oversubscribed storm must attribute"
+    for tick, led in h._explain_ledgers:
+        # the acceptance invariant: per-reason counts sum exactly to
+        # the unplaced count per tick...
+        assert sum(led["reasons"].values()) == led["unplaced"]
+        assert sum(led["cells"].values()) == led["unplaced"]
+        # ...and the unplaced count IS the tick's pending-after count
+        # (no preemption in this scenario)
+        assert led["unplaced"] == h._pending_by_tick[tick]
+        assert explain.UNKNOWN not in led["reasons"]
+    # every still-pending pod's reason parses to exactly one code
+    from slurm_bridge_tpu.bridge.objects import Pod, PodPhase, PodRole
+
+    checked = 0
+    for p in h.store.list(Pod.KIND):
+        if (
+            p.spec.role == PodRole.SIZECAR
+            and not p.spec.node_name
+            and p.status.phase == PodPhase.PENDING
+            and p.status.reason
+        ):
+            code = explain.code_of_reason(p.status.reason)
+            assert code is not None and code != explain.UNKNOWN, (
+                f"{p.name}: generic reason {p.status.reason!r}"
+            )
+            checked += 1
+    assert checked > 0
+    wr = r.quality["wait_reasons"]
+    assert wr and explain.UNKNOWN not in wr
+    # the storm's signature: fair share defers loud-tenant work
+    assert explain.FAIRSHARE_DEFERRED in wr
+    # the flight record carries the per-tick ledger
+    assert any("pressure" in rec for rec in h.flight.records)
+
+
+def _cap_scenario(max_preemptions: int) -> SimHarness:
+    """Four 32-cpu nodes fully held by long-running batch work, then a
+    production single that can only start by displacing someone — with
+    ``max_preemptions_per_tick=0`` every displaceable incumbent is
+    excluded by the cap, which is exactly what the verdict must say."""
+    from slurm_bridge_tpu.policy.engine import PolicyConfig
+
+    sc = Scenario(
+        name="cap_test",
+        description="preemption-cap attribution",
+        cluster=ClusterSpec(
+            num_nodes=4,
+            num_partitions=1,
+            cpu_choices=(32,),
+            gpu_fraction=0.0,
+            base_load=0.0,
+        ),
+        workload=WorkloadSpec(jobs=1),
+        ticks=5,
+        preemption=True,
+        policy=PolicyConfig(max_preemptions_per_tick=max_preemptions),
+        expect_drain=False,
+        drain_grace_ticks=0,
+        seed=3,
+    )
+    h = SimHarness(sc)
+    trace: list[list[JobArrival]] = [[] for _ in range(sc.ticks)]
+    for k in range(4):
+        trace[0].append(
+            JobArrival(
+                tick=0,
+                name=f"filler-{k:06d}",
+                spec=BridgeJobSpec(
+                    partition="part0",
+                    sbatch_script="#!/bin/sh\n: fill\n",
+                    cpus_per_task=32,
+                    ntasks=1,
+                    nodes=1,
+                    mem_per_cpu_mb=64,
+                    priority=60,
+                ),
+                duration_s=1000.0,
+            )
+        )
+    trace[2].append(
+        JobArrival(
+            tick=2,
+            name="prod-000000",
+            spec=BridgeJobSpec(
+                partition="part0",
+                sbatch_script="#!/bin/sh\n: prod\n",
+                cpus_per_task=32,
+                ntasks=1,
+                nodes=1,
+                mem_per_cpu_mb=64,
+                priority=10,
+            ),
+            duration_s=50.0,
+            labels={CLASS_LABEL: "production"},
+        )
+    )
+    h.trace = trace
+    return h
+
+
+def test_preemption_cap_attribution():
+    h = _cap_scenario(max_preemptions=0)
+    r = h.run()
+    assert not r.determinism["invariant_violations"]
+    wr = r.quality["wait_reasons"]
+    assert wr.get(explain.PREEMPTION_CAP), (
+        f"expected PREEMPTION_CAP attribution, got {wr}"
+    )
+    # the contrast arm: with a real budget the production job displaces
+    # an incumbent instead of waiting — no cap attribution
+    h2 = _cap_scenario(max_preemptions=4)
+    r2 = h2.run()
+    assert r2.quality["preempted_total"] >= 1
+    assert not r2.quality["wait_reasons"].get(explain.PREEMPTION_CAP)
+
+
+def test_sharded_gang_split_trail_renders_spill():
+    """Acceptance: ``--explain <job>`` renders a decision trail for a
+    spilled gang — routed to a too-small shard, placed (or refused)
+    only by the cross-shard reconcile pass."""
+    sc = SCENARIOS["sharded_gang_split"](scale=0.12)
+    probe = SimHarness(sc)
+    gang = next(
+        a.name
+        for arrivals in probe.trace
+        for a in arrivals
+        if (a.spec.nodes or 1) > 1
+    )
+    h = SimHarness(
+        dataclasses.replace(sc, explain_target=f"{gang}-sizecar")
+    )
+    r = h.run()
+    assert not r.determinism["invariant_violations"]
+    trail = h.scheduler.explain_trail
+    text = trail.render()
+    assert f"{gang}-sizecar" in text
+    assert "[route] routed whole to shard" in text
+    assert "[reconcile] cross-shard pass" in text  # the spill, rendered
+    assert "[bind] bound to" in text or "[verdict]" in text
+    # wait_reasons live on the sharded tick too
+    assert r.quality["wait_reasons"]
+    assert explain.UNKNOWN not in r.quality["wait_reasons"]
+
+
+def test_cli_explain_flag_renders_trail(capsys):
+    from slurm_bridge_tpu.sim.cli import main
+
+    rc = main(["sharded_gang_split", "--scale", "0.1", "--explain", "sim-000000"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "decision trail for sim-000000-sizecar" in out
+
+
+# ------------------------------------------- satellite: log correlation
+
+
+def _record(msg="hello"):
+    return logging.LogRecord(
+        "sbt.test", logging.INFO, __file__, 1, msg, (), None
+    )
+
+
+def test_json_and_kv_formatters_carry_trace_ids():
+    from slurm_bridge_tpu.obs.logging import JSONFormatter, KVFormatter
+    from slurm_bridge_tpu.obs.tracing import Tracer
+
+    out = json.loads(JSONFormatter().format(_record()))
+    assert "trace_id" not in out  # outside any span: the legacy bytes
+    tracer = Tracer(sample="always")
+    with tracer.span("corr") as span:
+        out = json.loads(JSONFormatter().format(_record()))
+        assert out["trace_id"] == span.trace_id
+        assert out["span_id"] == span.span_id
+        kv = KVFormatter().format(_record())
+        assert f"trace={span.trace_id}" in kv
+        assert f"span={span.span_id}" in kv
+    never = Tracer(sample="never")
+    with never.span("quiet"):
+        out = json.loads(JSONFormatter().format(_record()))
+        assert "trace_id" not in out  # unsampled spans stay silent
+
+
+# ------------------------- satellite: idle-window inventory re-base
+
+
+def test_rebase_gate_and_skip_nodes():
+    """The admitter-side contracts: (a) an inventory report is REFUSED
+    until the scheduler re-allows maintenance (and forbidden again by
+    every solve re-base — the gate lives under the admitter lock, so a
+    probe can never clobber a fresher window); (b) ``skip_nodes`` rows
+    (bound-but-unsubmitted pods' hints) keep the window's conservative
+    value; (c) in-flight fast-bind deductions stay subtracted."""
+    from slurm_bridge_tpu.admission.fastpath import FastPathAdmitter
+    from slurm_bridge_tpu.core.types import NodeInfo
+    from slurm_bridge_tpu.solver.snapshot import ClusterSnapshot
+
+    snap = ClusterSnapshot(
+        node_names=["n0", "n1", "n2"],
+        capacity=np.full((3, 3), 32.0, np.float32),
+        free=np.zeros((0, 3), np.float32),
+        partition_of=np.zeros(3, np.int32),
+        features=np.zeros(3, np.uint32),
+        partition_codes={"p0": 0},
+        feature_codes={},
+    )
+    adm = FastPathAdmitter()
+    adm.begin_window(snap, np.zeros((3, 3), np.float32), [])
+    nodes = [
+        NodeInfo(name=f"n{i}", cpus=32, memory_mb=32, gpus=0)
+        for i in range(3)
+    ]
+    # (a) solve re-base just happened: the report must be refused
+    assert adm.rebase_from_inventory(nodes) == 0
+    assert (adm.view.free == 0).all()
+    adm.allow_inventory_rebase()
+    # (b)+(c): n0 skipped (unsubmitted bind), n1 carries a deduction
+    adm.deductions["podx"] = (("n1",), np.asarray([8.0, 8.0, 0.0], np.float32))
+    assert adm.rebase_from_inventory(nodes, skip_nodes={"n0"}) == 2
+    assert (adm.view.free[0] == 0).all()  # skipped: conservative row kept
+    assert adm.view.free[1][0] == 24.0  # 32 free minus the 8-cpu deduction
+    assert adm.view.free[2][0] == 32.0
+    # (a) again: a fresh solve re-base forbids maintenance once more
+    adm.begin_window(snap, np.zeros((3, 3), np.float32), [])
+    assert adm.rebase_from_inventory(nodes) == 0
+
+
+def _rebase_scenario() -> Scenario:
+    from slurm_bridge_tpu.admission import AdmissionConfig
+
+    return Scenario(
+        name="rebase_test",
+        description="completion re-opens fast-path capacity, no solve",
+        cluster=ClusterSpec(
+            num_nodes=8,
+            num_partitions=1,
+            cpu_choices=(32,),
+            gpu_fraction=0.0,
+            base_load=0.0,
+        ),
+        workload=WorkloadSpec(jobs=1),
+        ticks=6,
+        admission=AdmissionConfig(latency_warmup_ticks=0),
+        seed=7,
+    )
+
+
+def _rebase_trace(ticks: int) -> list[list[JobArrival]]:
+    """Tick 0: a gang FILLING the whole cluster (batch class — batch
+    tick binds it at tick 1 once the virtual node is up), completing at
+    the end of tick 2 (submitted at tick 1's mirror, vt 5 + 4.9 s).
+    Tick 3's inventory probe reports the freed capacity and re-bases
+    the window. Tick 4: one production single needing a FULL node — it
+    fits only in capacity the filler freed, which the admission window
+    can only know about through that re-base (no solve runs in
+    between: nothing else is pending)."""
+    filler = JobArrival(
+        tick=0,
+        name="filler-000000",
+        spec=BridgeJobSpec(
+            partition="part0",
+            sbatch_script="#!/bin/sh\n: fill\n",
+            cpus_per_task=32,
+            ntasks=8,
+            nodes=8,
+            mem_per_cpu_mb=64,
+            priority=50,
+        ),
+        duration_s=4.9,
+    )
+    probe = JobArrival(
+        tick=4,
+        name="probe-000000",
+        spec=BridgeJobSpec(
+            partition="part0",
+            sbatch_script="#!/bin/sh\n: probe\n",
+            cpus_per_task=32,
+            ntasks=1,
+            nodes=1,
+            mem_per_cpu_mb=64,
+            priority=50,
+        ),
+        duration_s=5.0,
+        labels={CLASS_LABEL: "production"},
+    )
+    trace: list[list[JobArrival]] = [[] for _ in range(ticks)]
+    trace[0].append(filler)
+    trace[4].append(probe)
+    return trace
+
+
+def test_completion_rebases_window_and_fast_binds_without_solve():
+    sc = _rebase_scenario()
+    h = SimHarness(sc)
+    h.trace = _rebase_trace(sc.ticks)
+    r = h.run()
+    assert not r.determinism["invariant_violations"]
+    adm = h.scheduler.admission
+    assert adm.inventory_rebases >= 1, "the idle window never re-based"
+    assert adm.binds_total == 1, (
+        f"the probe must FAST-bind into the freed capacity "
+        f"(misses={adm.misses})"
+    )
+    # ...and no solve ran between the filler's and the probe's arrival:
+    # the fast bind leaves nothing pending, so tick 4 stays idle
+    assert h.scheduler.solves_total == 1, (
+        "the probe should not have needed a batch solve"
+    )
+
+
+def test_without_rebase_the_probe_falls_back_to_the_batch_tick(monkeypatch):
+    """The negative control proving the test above tests the satellite:
+    with the re-base disabled, the stale window refuses the probe and
+    the batch tick (a second solve) places it."""
+    from slurm_bridge_tpu.admission.fastpath import FastPathAdmitter
+
+    monkeypatch.setattr(
+        FastPathAdmitter,
+        "rebase_from_inventory",
+        lambda self, nodes, **kw: 0,
+    )
+    sc = _rebase_scenario()
+    h = SimHarness(sc)
+    h.trace = _rebase_trace(sc.ticks)
+    r = h.run()
+    assert not r.determinism["invariant_violations"]
+    adm = h.scheduler.admission
+    assert adm.binds_total == 0
+    assert adm.misses.get("no_fit", 0) >= 1
+    assert h.scheduler.solves_total >= 2  # the probe needed the batch tick
