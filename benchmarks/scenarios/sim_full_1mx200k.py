@@ -1,0 +1,23 @@
+"""Sim scenario: the 20×-scale sharded headline (slow — the biggest
+shape in the suite).
+
+1M pods × 200k nodes through the FULL bridge pipeline with the shard
+fan-out, per-shard mirror grouping and the overlapped mirror pipeline
+on; records ``full_tick_p50_ms_1mx200k`` with the phase breakdown and
+enforces the scenario's p50 gate plus flight-record phase-sum
+reconciliation.
+
+    python -m benchmarks.scenarios.sim_full_1mx200k [--scale F] [--seed N]
+
+Canonical definition: ``slurm_bridge_tpu.sim.scenarios.full_1mx200k``.
+"""
+
+import sys
+
+from slurm_bridge_tpu.sim.cli import main
+from slurm_bridge_tpu.sim.scenarios import full_1mx200k as SCENARIO_FACTORY  # noqa: F401
+
+NAME = "full_1mx200k"
+
+if __name__ == "__main__":
+    sys.exit(main([NAME, *sys.argv[1:]]))

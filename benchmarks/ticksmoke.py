@@ -56,6 +56,20 @@ byte-identical determinism digests — attribution only OBSERVES solve
 artifacts, it must never change a decision — and (b) keep the
 explain-on tick p50 within the same ≤3%-or-epsilon budget as tracing
 and the WAL.
+
+The parallel cold path (ISSUE 16) adds the cold-tick gate: the
+``full_500kx100k`` shape scaled down to seconds, run with the per-shard
+mirror split and the overlapped fetch pipeline at their defaults (on),
+must (a) hold a generous cold-tick budget, (b) land on the SAME
+``final_state_digest`` as the serial global-pass oracle (both flags
+off) — parallelism that changes bytes is a bug at any speed — and
+(c) keep the flight record honest under the overlap: the span
+phase-sum must stay within the unattributed ceiling of the tick span
+(≤2% — overlapped fetches must not open a hole the phase clock cannot
+attribute).
+
+    SBT_SMOKE_COLD_BUDGET_MS       cold (first) tick ceiling  (default 8000)
+    SBT_SMOKE_COLD_UNATTRIBUTED_PCT flight phase-sum gap ceiling (default 2)
 """
 
 from __future__ import annotations
@@ -280,6 +294,53 @@ def profile_steady_tick(scale: float = 0.12) -> dict:
     }
 
 
+def profile_cold_tick(scale: float = 0.02) -> dict:
+    """The ISSUE 16 parallel-cold-path gate at scaled-down shape.
+
+    Runs the ``full_500kx100k`` scenario small enough for CI seconds,
+    once with the parallel cold path at its defaults (per-shard mirror
+    groups + overlapped fetch pipeline; the decode worker pool sizes
+    itself to the box) and once as the serial global-pass oracle, and
+    reports: the cold (first) tick cost, digest identity between the
+    arms, and the flight record's phase-sum reconciliation under the
+    overlap — the fraction of the tick span no phase claims. Pipelined
+    fetches run under the NEXT group's classification, so a broken
+    phase clock shows up here as unattributed wall time.
+    """
+    import dataclasses
+
+    from slurm_bridge_tpu.sim.harness import SimHarness
+    from slurm_bridge_tpu.sim.scenarios import SCENARIOS
+
+    scn = SCENARIOS["full_500kx100k"](scale=scale)
+    h = SimHarness(scn)
+    on = h.run()
+    cold_ms = h._tick_phases[0]["tick"]
+    fr = on.flight_record
+    span = fr.get("tick_span_p50_ms") or 0.0
+    psum = fr.get("phase_sum_p50_ms") or 0.0
+    unattributed_pct = abs(span - psum) / span * 100.0 if span else 0.0
+    oracle = SimHarness(
+        dataclasses.replace(scn, shard_mirror=False, mirror_pipeline=False)
+    ).run()
+    return {
+        "scenario": "full_500kx100k",
+        "scale": scale,
+        "cold_tick_ms": round(cold_ms, 3),
+        "tick_span_p50_ms": span,
+        "phase_sum_p50_ms": psum,
+        "unattributed_pct": round(unattributed_pct, 2),
+        "digest_parallel": on.determinism["final_state_digest"],
+        "digest_serial": oracle.determinism["final_state_digest"],
+        "digest_identical": (
+            on.determinism["final_state_digest"]
+            == oracle.determinism["final_state_digest"]
+        ),
+        "violations": len(on.determinism["invariant_violations"])
+        + len(oracle.determinism["invariant_violations"]),
+    }
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if "--wal-fsync" in sys.argv[1:]:
@@ -307,6 +368,12 @@ def main() -> int:
     decode_floor = float(
         os.environ.get("SBT_SMOKE_DECODE_MIN_SPEEDUP", "1.2")
     )
+    cold_budget_ms = float(
+        os.environ.get("SBT_SMOKE_COLD_BUDGET_MS", "8000")
+    )
+    cold_unattr_pct = float(
+        os.environ.get("SBT_SMOKE_COLD_UNATTRIBUTED_PCT", "2")
+    )
     out = profile_tick(1_000, 5_000, seed=2)
     rec = profile_reconcile(500)
     dec = profile_decode(10_000)
@@ -314,8 +381,12 @@ def main() -> int:
     wal = profile_wal_overhead()
     explain = profile_explain_overhead()
     steady = profile_steady_tick()
+    cold = profile_cold_tick()
     out["reconcile"] = rec
     out["decode"] = dec
+    out["cold"] = cold
+    out["cold_budget_ms"] = cold_budget_ms
+    out["cold_unattributed_budget_pct"] = cold_unattr_pct
     out["decode_min_speedup"] = decode_floor
     out["tracing"] = trace
     out["wal"] = wal
@@ -355,6 +426,15 @@ def main() -> int:
     # the ISSUE 14 wire-decode gate: coldec must decode column-identical
     # to the pb2 path AND beat it by the floor multiple
     decode_ok = dec["digest_identical"] and dec["coldec_speedup"] >= decode_floor
+    # the ISSUE 16 parallel-cold-path gate: digest identity with the
+    # serial oracle is structural (any speed); the budget and the
+    # phase-sum ceiling catch a cold path or phase clock regression
+    cold_ok = (
+        cold["digest_identical"]
+        and cold["violations"] == 0
+        and cold["cold_tick_ms"] <= cold_budget_ms
+        and cold["unattributed_pct"] <= cold_unattr_pct
+    )
     ok = (
         out["encode_ms"] <= budget_ms
         and out["encode_speedup_vs_loop"] >= min_speedup
@@ -367,6 +447,7 @@ def main() -> int:
         and explain_ok
         and steady_ok
         and decode_ok
+        and cold_ok
     )
     out["ok"] = ok
     print(json.dumps(out))
@@ -392,7 +473,12 @@ def main() -> int:
             f"(must be 0), solves {steady['steady_solves']} (must be 0), "
             f"JobsInfo/tick {steady['max_jobsinfo_per_tick']} (≤ "
             f"{steady['providers']} providers), rpc/tick "
-            f"{steady['max_rpc_per_tick']}",
+            f"{steady['max_rpc_per_tick']} / cold tick "
+            f"{cold['cold_tick_ms']} ms (budget {cold_budget_ms}), "
+            f"unattributed {cold['unattributed_pct']}% (budget "
+            f"{cold_unattr_pct}%), parallel≡serial "
+            f"{cold['digest_identical']} (must be true), violations "
+            f"{cold['violations']} (must be 0)",
             file=sys.stderr,
         )
     return 0 if ok else 1

@@ -164,6 +164,42 @@ def heap_iso(h, col: str, i: int) -> str:
     return "" if v is None else v.isoformat()
 
 
+def heap_iso_bulk(h, col: str, idx: np.ndarray) -> np.ndarray:
+    """:func:`heap_iso` over many heap rows at once (object array).
+
+    The hot case — every row a :data:`LAZY_DT` sentinel, i.e. the wire
+    decode wrote it — renders straight from the epoch column with
+    ``np.datetime_as_string``, which at second resolution produces
+    exactly ``datetime.isoformat()``'s ``YYYY-MM-DDTHH:MM:SS`` for the
+    naive-UTC datetimes ``dt_of_ts`` would build (pinned by the
+    colstore tests). Rows holding real datetime objects (the pb2 path)
+    fall back to the scalar oracle; ``ts <= 0`` rows are ``""``.
+    Replaces a per-row Python datetime build + isoformat that was ~2s
+    of a cold 100k sweep (ISSUE 16)."""
+    n = int(idx.size)
+    out = np.empty(n, object)
+    if not n:
+        return out
+    objs = getattr(h, col)[idx]
+    lazy = np.fromiter(
+        (v is LAZY_DT for v in objs), bool, n
+    )
+    if lazy.any():
+        ts = getattr(h, col + "_ts")[idx]
+        pos = ts > 0
+        render = lazy & pos
+        out[lazy & ~pos] = ""
+        if render.any():
+            out[render] = np.datetime_as_string(
+                ts[render].astype("datetime64[s]"), unit="s"
+            ).astype(object)
+    rest = np.nonzero(~lazy)[0]
+    for k in rest.tolist():
+        v = objs[k]
+        out[k] = "" if v is None else v.isoformat()
+    return out
+
+
 # make sure every materialized class carries the frozen guard before the
 # first view is minted (freeze() would do this lazily; views bypass it)
 for _cls in (

@@ -28,6 +28,7 @@ from slurm_bridge_tpu.bridge.columns import (
     STATE_CODE,
     STATE_STRS,
     heap_iso,
+    heap_iso_bulk,
 )
 from slurm_bridge_tpu.bridge.controller import Controller, Result
 from slurm_bridge_tpu.bridge.freeze import (
@@ -139,18 +140,26 @@ def _parsed_header(script: str):
 
 
 def demand_for_job(job: BridgeJob) -> JobDemand:
-    """Script #SBATCH headers, overridden by explicit spec fields, with the
-    reference defaults (pod.go:18-95). Born FROZEN via ``frozen_new`` —
-    every field scalar, so commit-time freeze stops at one probe instead
-    of a 19-field walk per pod (ISSUE 14; the storm creates one demand
-    per arrival)."""
-    hdr = _parsed_header(job.spec.sbatch_script)
-    spec = job.spec
+    """Script #SBATCH headers, overridden by explicit spec fields, with
+    the reference defaults (pod.go:18-95) — the object-path wrapper over
+    :func:`demand_for_spec`."""
+    return demand_for_spec(job.meta.name, job.spec)
+
+
+def demand_for_spec(name: str, spec) -> JobDemand:
+    """The demand build from (job name, spec) directly — what the
+    columnar sweep calls with values gathered from the job table, so a
+    100k-create storm never materializes the BridgeJob views the old
+    per-create ``jt.view()`` path paid 2.5M ``_frozen_shell`` calls for
+    (ISSUE 16). Born FROZEN via ``frozen_new`` — every field scalar, so
+    commit-time freeze stops at one probe instead of a 19-field walk per
+    pod (ISSUE 14; the storm creates one demand per arrival)."""
+    hdr = _parsed_header(spec.sbatch_script)
     return frozen_new(
         JobDemand,
         partition=spec.partition or hdr.partition,
         script=spec.sbatch_script,
-        job_name=job.meta.name,
+        job_name=name,
         run_as_user=spec.run_as_user,
         run_as_group=spec.run_as_group,
         array=spec.array or hdr.array,
@@ -473,7 +482,9 @@ class BridgeOperator:
         t0 = time.perf_counter()
         _sweeps.inc()
         slow: list[str] = []
-        sizecar_creates: list[tuple[Pod, str]] = []  # (pod, owner name)
+        #: (owner name, job spec, job labels) — demand parse + pod-row
+        #: build happen at commit time, outside the lock
+        sizecar_creates: list[tuple] = []
         ordered = sorted(set(names))
         n = len(ordered)
         validated = self._validated_specs
@@ -516,9 +527,15 @@ class BridgeOperator:
             m_slow = missing & (slen > 0)
             slow.extend(ordered[i] for i in np.nonzero(m_slow)[0].tolist())
             m_create = missing & (slen == 0)
+            # capture (owner, spec, job labels) only — the demand parse,
+            # label build and pod materialization all run OUTSIDE the
+            # lock, and the create lands as a row-write (no Pod objects,
+            # no create_batch freeze-walk: ~100k ``jt.view`` shells per
+            # cold sweep gone, ISSUE 16)
             for i in np.nonzero(m_create)[0].tolist():
+                row = int(jr[i])
                 sizecar_creates.append(
-                    (self._build_sizecar(jt.view(int(jr[i]))), ordered[i])
+                    (ordered[i], spec_col[row], jc.labels[row])
                 )
             act = (act0 & has_s) | m_create
             pod_phase = np.where(has_s, pc.phase[sr], _POD_PHASE_PENDING)
@@ -558,15 +575,15 @@ class BridgeOperator:
             )
             sub_changed = fresh | neq
             # timestamp residual: the sub stores ISO strings, the info
-            # heap datetime objects — compare per row only where every
-            # cheap field already matched
-            for i in np.nonzero(both & ~neq)[0].tolist():
-                sv, iv = int(si[i]), int(ii[i])
-                if (
-                    sh.submit[sv] != heap_iso(h, "submit", iv)
-                    or sh.start[sv] != heap_iso(h, "start", iv)
-                ):
-                    sub_changed[i] = True
+            # heap datetime objects — rendered in bulk (heap_iso_bulk)
+            # and compared only where every cheap field already matched
+            res = np.nonzero(both & ~neq)[0]
+            if res.size:
+                svr, ivr = si[res], ii[res]
+                ts_neq = (
+                    sh.submit[svr] != heap_iso_bulk(h, "submit", ivr)
+                ) | (sh.start[svr] != heap_iso_bulk(h, "start", ivr))
+                sub_changed[res[ts_neq]] = True
             state_changed = act & (new_state != state)
             cr_mask = act & (
                 sub_changed | state_changed | reason_changed | ep_changed
@@ -593,12 +610,8 @@ class BridgeOperator:
             sub_out = h.stdout[iiv]
             sub_err = h.stderr[iiv]
             sub_rsn = h.reason[iiv]
-            sub_submit = oarr([
-                heap_iso(h, "submit", int(i)) for i in iiv.tolist()
-            ])
-            sub_start = oarr([
-                heap_iso(h, "start", int(i)) for i in iiv.tolist()
-            ])
+            sub_submit = heap_iso_bulk(h, "submit", iiv)
+            sub_start = heap_iso_bulk(h, "start", iiv)
             sub_keys = oarr([
                 (a if a else str(int(b)),)
                 for a, b in zip(sub_aid.tolist(), sub_id.tolist())
@@ -692,25 +705,87 @@ class BridgeOperator:
 
         # ---- commits: creates first, then updates (oracle order) ----
         if sizecar_creates:
-            results = self.store.create_batch(
-                [pod for pod, _ in sizecar_creates], site="operator.sweep"
+            sc_owners = [o for o, _s, _l in sizecar_creates]
+            sc_names = [sizecar_name(o) for o in sc_owners]
+            sc_demand = [
+                demand_for_spec(o, s) for o, s, _l in sizecar_creates
+            ]
+            sc_labels: list[FrozenDict] = []
+            for (_o, _s, jl), dem in zip(sizecar_creates, sc_demand):
+                arr = array_len(dem.array)
+                labels = {
+                    "role": PodRole.SIZECAR,
+                    "partition": dem.partition,
+                    # resource-request labels (pod.go:164-187)
+                    "request-cpu": str(dem.total_cpus(arr)),
+                    "request-memory-mb": str(dem.total_mem_mb(arr)),
+                }
+                if jl:
+                    # policy-bearing labels ride from the CR onto the
+                    # sizecar (cf. _build_sizecar, the object oracle)
+                    for key in (_TENANT_LABEL, _CLASS_LABEL):
+                        val = jl.get(key)
+                        if val:
+                            labels[key] = val
+                sc_labels.append(FrozenDict(labels))
+            sc_owner_arr = oarr(sc_owners)
+            sc_name_arr = oarr(sc_names)
+            sc_label_arr = oarr(sc_labels)
+            sc_demand_arr = oarr(sc_demand)
+            sc_part_arr = oarr([d.partition for d in sc_demand])
+
+            def sc_builder(rows, sel):
+                m = len(sel)
+                pc.name[rows] = sc_name_arr[sel]
+                pc.uid[rows] = oarr([new_uid() for _ in range(m)])
+                pc.labels[rows] = sc_label_arr[sel]
+                pc.ann[rows] = object_full(m, _EMPTY_FROZEN_DICT)
+                pc.owner[rows] = sc_owner_arr[sel]
+                pc.deleted[rows] = False
+                pc.role[rows] = object_full(m, PodRole.SIZECAR)
+                pc.partition[rows] = sc_part_arr[sel]
+                pc.demand[rows] = sc_demand_arr[sel]
+                pc.node[rows] = object_full(m, "")
+                pc.hint[rows] = object_full(m, ())
+                pc.phase[rows] = _POD_PHASE_PENDING
+                pc.reason[rows] = object_full(m, "")
+                pc.job_ids[rows] = object_full(m, ())
+                pc.njobs[rows] = 0
+                pc.istart[rows] = 0
+                pc.ilen[rows] = 0
+                pc.cstart[rows] = 0
+                pc.clen[rows] = 0
+
+            results = self.store.create_rows(
+                Pod.KIND, sc_names, sc_builder, site="operator.sweep"
             )
-            for (pod, owner), res in zip(sizecar_creates, results):
-                if not isinstance(res, Exception):
-                    self.events.emit(
-                        BridgeJob.KIND, owner, Reason.POD_CREATED,
-                        f"sizecar pod {pod.meta.name} created",
+            self.events.emit_batch(
+                BridgeJob.KIND,
+                Reason.POD_CREATED,
+                [
+                    (owner, f"sizecar pod {nm} created")
+                    for nm, owner, rc in zip(
+                        sc_names, sc_owners, results.tolist()
                     )
+                    if rc > 0
+                ],
+            )
         if wc_names:
             empty_fd = FrozenDict()
+            wc_name_arr = oarr(wc_names)
+            # per-partition label interning, vectorized: one
+            # _worker_labels call per distinct partition, fanned out
+            # through the unique-inverse instead of 90k dict probes
+            wc_uparts, wc_inv = np.unique(wc_partition, return_inverse=True)
+            wc_label_arr = oarr(
+                [self._worker_labels(p) for p in wc_uparts.tolist()]
+            )[wc_inv]
 
             def builder(rows, sel):
                 m = len(sel)
-                pc.name[rows] = oarr([wc_names[p] for p in sel.tolist()])
+                pc.name[rows] = wc_name_arr[sel]
                 pc.uid[rows] = oarr([new_uid() for _ in range(m)])
-                pc.labels[rows] = oarr([
-                    self._worker_labels(p) for p in wc_partition[sel].tolist()
-                ])
+                pc.labels[rows] = wc_label_arr[sel]
                 pc.ann[rows] = object_full(m, empty_fd)
                 pc.owner[rows] = wc_owner[sel]
                 pc.deleted[rows] = False

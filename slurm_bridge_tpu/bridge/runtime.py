@@ -173,12 +173,46 @@ class Bridge:
         self.fetch_worker.start()
         if self.kubelet_server is not None:
             self.kubelet_server.start()
+        # streaming admission at ARRIVAL time (ISSUE 16): the sim harness
+        # has called scheduler.admit() on each arrival since ISSUE 15;
+        # the production bridge now does the same, event-driven off the
+        # store watch, so an eligible interactive sizecar binds in
+        # wall-clock milliseconds instead of waiting for the next
+        # scheduler tick. admit() itself gates on role/phase/bound and
+        # is a cheap no-op for everything else, so ADDED events for
+        # non-sizecar pods cost one try_get.
+        import threading
+
+        self._admit_q = self.store.watch((Pod.KIND,))
+        self._admit_thread = threading.Thread(
+            target=self._pump_admissions, name="bridge-admit", daemon=True
+        )
+        self._admit_thread.start()
         self._started = True
         return self
+
+    def _pump_admissions(self) -> None:
+        q = self._admit_q
+        while True:
+            ev = q.get()
+            if ev is None:  # stop() sentinel
+                return
+            if ev.type != "ADDED":
+                continue
+            try:
+                self.scheduler.admit(ev.name)
+            except Exception:
+                # the fast path must never kill the pump: a miss (or any
+                # race with a concurrent delete) falls through to the
+                # batch tick, which remains the correctness path
+                log.exception("arrival admit of %s failed", ev.name)
 
     def stop(self) -> None:
         if not self._started:
             return
+        self.store.unwatch(self._admit_q)
+        self._admit_q.put(None)  # wake the pump so the sentinel lands
+        self._admit_thread.join(timeout=2.0)
         if self.kubelet_server is not None:
             self.kubelet_server.stop()
         self._sched_ticker.stop()

@@ -1293,6 +1293,30 @@ class PlacementScheduler:
         # so the route metric covers sidecar deployments too
         self.last_route = f"remote-{resp.solver}"
         _route_total.inc(engine=self.last_route)
+        if self.admission is not None and resp.free_after:
+            # the sidecar's residual (ISSUE 16): seed the fast-path
+            # window from the remote solve's own free_after instead of
+            # leaving streaming admission dark on sidecar deployments.
+            # The sidecar computes against the same wire inventory in
+            # the same node order, so a local re-encode keys the window
+            # to a snapshot whose node_names match the vector's rows;
+            # an older sidecar sends nothing and the window stays on
+            # its previous base (pre-16 behavior).
+            from slurm_bridge_tpu.solver.snapshot import encode_cluster
+
+            snapshot = encode_cluster(list(nodes), list(partitions))
+            residual = np.asarray(resp.free_after, np.float32)
+            if residual.size == snapshot.free.size:
+                self._adm_capture = (
+                    snapshot,
+                    residual.reshape(snapshot.free.shape),
+                    None,
+                )
+            else:
+                log.warning(
+                    "remote Place free_after has %d entries, want %d; "
+                    "ignoring", residual.size, snapshot.free.size,
+                )
         by_job_names = {
             int(a.job_id): list(a.node_names)
             for a in resp.assignments

@@ -58,6 +58,7 @@ from slurm_bridge_tpu.core.types import JobInfo, JobStatus, NodeInfo, PartitionI
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
 from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.obs.tracing import TRACER, with_current_span
+from slurm_bridge_tpu.parallel import colpool
 from slurm_bridge_tpu.wire import ServiceClient, pb
 from slurm_bridge_tpu.wire import coldec
 from slurm_bridge_tpu.wire.convert import (
@@ -541,14 +542,23 @@ class VirtualNodeProvider:
 
         Chunk results merge in REQUEST order regardless of completion
         order, so the scratch's row layout — and everything downstream —
-        is deterministic."""
+        is deterministic.
+
+        When the process worker pool (``parallel/colpool``) is active
+        and there is more than one chunk, the fetch threads capture raw
+        buffers only and the decode fans out across worker processes —
+        same per-chunk results, off the parent's interpreter."""
         results: list = [None] * len(reqs)
+        pool = colpool.active_pool() if len(reqs) > 1 else None
 
         def fetch(i: int) -> None:
             try:
                 raw = bytes_fn(reqs[i])
             except grpc.RpcError as e:
                 results[i] = ("rpc", e)
+                return
+            if pool is not None:
+                results[i] = ("raw", raw)
                 return
             try:
                 results[i] = ("ok", coldec.decode_jobs_info(raw))
@@ -559,6 +569,20 @@ class VirtualNodeProvider:
             self._pool_map(fetch, list(range(len(reqs))))
         elif reqs:
             fetch(0)
+        if pool is not None:
+            raw_idx = [
+                i for i, r in enumerate(results) if r is not None
+                and r[0] == "raw"
+            ]
+            if raw_idx:
+                decoded = pool.decode_jobs_info_many(
+                    [results[i][1] for i in raw_idx]
+                )
+                for i, dec in zip(raw_idx, decoded):
+                    if isinstance(dec, coldec.DecodeError):
+                        results[i] = ("dec", dec)
+                    else:
+                        results[i] = ("ok", dec)
         for kind, payload in results:
             if kind == "rpc":
                 if payload.code() == grpc.StatusCode.UNIMPLEMENTED:
@@ -797,6 +821,89 @@ class VirtualNodeProvider:
             _status_seconds.observe(t2 - t1)
             _sync_seconds.observe(t2 - t0)
 
+    def sync_staged(self):
+        """One provider tick split at the status fetch: returns
+        ``(fetch, apply)`` callables, or None when this tick cannot be
+        staged (object-store path, a remembered batch/bulk fallback, or
+        no bytes twin — FaultyClient masks it, so fault-bearing runs
+        always take the plain path and their draw sequences hold).
+
+        The contract the pipelined mirror (sim/harness.py) builds on:
+
+        - calling ``sync_staged`` runs register + classification +
+          converge/submit INLINE (all store writes, caller's thread);
+        - ``fetch()`` issues only the chunked JobsInfo round-trips —
+          no store access — and is safe on a background thread while
+          the NEXT provider's prepare runs;
+        - ``apply(fetch_result)`` diffs and writes on the caller's
+          thread.
+
+        Prepare → fetch → apply in that order is exactly ``sync()``
+        decomposed, so serial callers of the staged form are
+        byte-identical to the plain form."""
+        table = self.store.table(Pod.KIND)
+        if (
+            table is None
+            or not self._batch_submit_supported
+            or not self._bulk_supported
+            or self._bytes_rpc("JobsInfo") is None
+        ):
+            return None
+        with TRACER.span("vnode.sync", partition=self.partition) as span:
+            t0 = time.perf_counter()
+            self.register()
+            mode, payload = self._sync_cols_prepare(table, span, t0)
+        if (
+            mode == "done"
+            or (mode == "incr" and not payload.rb.names)
+            or (mode == "full" and not payload.names)
+        ):
+            return (lambda: None), (lambda fetched: None)
+        bytes_fn = self._bytes_rpc("JobsInfo")
+        if bytes_fn is None:  # pragma: no cover - cannot flip mid-prepare
+            t1 = time.perf_counter()
+            if mode == "incr":
+                self._refresh_statuses_cols_incr(table, payload)
+            else:
+                self._refresh_statuses_cols(table, payload)
+            t2 = time.perf_counter()
+            _status_seconds.observe(t2 - t1)
+            _sync_seconds.observe(t2 - t0)
+            return (lambda: None), (lambda fetched: None)
+        if mode == "incr":
+            mc = payload
+            self._prep_status_incr(mc)
+
+            def fetch():
+                return self._bulk_status_bytes(bytes_fn, mc.reqs)
+
+            def apply(fetched) -> None:
+                t1 = time.perf_counter()
+                with TRACER.span("vnode.status") as span2:
+                    span2.count("pods", len(mc.rb.names))
+                    self._apply_status_incr(table, mc, span2, fetched)
+                t2 = time.perf_counter()
+                _status_seconds.observe(t2 - t1)
+                _sync_seconds.observe(t2 - t0)
+
+            return fetch, apply
+        rb = payload
+        ids, reqs = self._status_reqs_full(rb)
+
+        def fetch():
+            return self._bulk_status_bytes(bytes_fn, reqs)
+
+        def apply(fetched) -> None:
+            t1 = time.perf_counter()
+            with TRACER.span("vnode.status") as span2:
+                span2.count("pods", len(rb.names))
+                self._apply_status_full(table, rb, span2, ids, reqs, fetched)
+            t2 = time.perf_counter()
+            _status_seconds.observe(t2 - t1)
+            _sync_seconds.observe(t2 - t0)
+
+        return fetch, apply
+
     # ---- the columnar mirror (PR-6) ----
 
     def _sync_cols(self, table, span, t0: float) -> None:
@@ -811,6 +918,28 @@ class VirtualNodeProvider:
         classification is skipped and the cached working set drives a
         cursor-bearing status pass — an idle shard's mirror is a probe
         plus one cheap RPC per id-chunk and zero decode/diff work."""
+        mode, payload = self._sync_cols_prepare(table, span, t0)
+        if mode == "done":
+            return
+        t1 = time.perf_counter()
+        if mode == "incr":
+            self._refresh_statuses_cols_incr(table, payload)
+        else:
+            self._refresh_statuses_cols(table, payload)
+        t2 = time.perf_counter()
+        _status_seconds.observe(t2 - t1)
+        _sync_seconds.observe(t2 - t0)
+
+    def _sync_cols_prepare(self, table, span, t0: float):
+        """Everything in a columnar tick BEFORE the status fetch:
+        classification (full, scoped, or skipped via the dirty-set),
+        deletions, and the batched submits. Returns ``(mode, payload)``
+        where mode is ``"incr"`` (payload: the mirror cache to cursor-
+        sync), ``"full"`` (payload: the refresh batch for the full
+        status pass) or ``"done"`` (nothing to refresh). The staged
+        mirror (``sync_staged``) cuts here so the fetch half can overlap
+        the NEXT provider's prepare — the plain path calls this then
+        refreshes inline, byte-identically."""
         if self.incremental:
             rv, changed, deleted = self.store.changes_since(
                 Pod.KIND, self._scan_rv
@@ -819,12 +948,7 @@ class VirtualNodeProvider:
             if not changed and not deleted and mc is not None:
                 span.count("converge_pods", 0)
                 span.count("refresh_pods", len(mc.rb.names))
-                t1 = time.perf_counter()
-                self._refresh_statuses_cols_incr(table, mc)
-                t2 = time.perf_counter()
-                _status_seconds.observe(t2 - t1)
-                _sync_seconds.observe(t2 - t0)
-                return
+                return "incr", mc
             if mc is not None and self._rescope_mirror_cache(
                 table, mc, changed, deleted
             ):
@@ -836,12 +960,7 @@ class VirtualNodeProvider:
                 self._scan_rv = rv
                 span.count("converge_pods", 0)
                 span.count("refresh_pods", len(mc.rb.names))
-                t1 = time.perf_counter()
-                self._refresh_statuses_cols_incr(table, mc)
-                t2 = time.perf_counter()
-                _status_seconds.observe(t2 - t1)
-                _sync_seconds.observe(t2 - t0)
-                return
+                return "incr", mc
             self._scan_rv = rv
             self._mirror_cache = None
             self.mirror_scans_full += 1
@@ -857,7 +976,7 @@ class VirtualNodeProvider:
                 now = time.perf_counter()
                 _status_seconds.observe(0.0)
                 _sync_seconds.observe(now - t0)
-                return
+                return "done", None
             deleted = c.deleted[rows]
             sizecar = c.role[rows] == PodRole.SIZECAR
             njobs = c.njobs[rows]
@@ -907,7 +1026,6 @@ class VirtualNodeProvider:
                 for lo in range(0, len(items), _SUBMIT_CHUNK)
             ]
             self._pool_map(self._submit_chunk_cols_safe, chunks)
-        t1 = time.perf_counter()
         if self.incremental:
             mc = self._build_mirror_cache(refresh)
             # the cache survives to the next tick ONLY when this sync had
@@ -920,12 +1038,8 @@ class VirtualNodeProvider:
             self._mirror_cache = (
                 mc if not items and not work_names else None
             )
-            self._refresh_statuses_cols_incr(table, mc)
-        else:
-            self._refresh_statuses_cols(table, refresh)
-        t2 = time.perf_counter()
-        _status_seconds.observe(t2 - t1)
-        _sync_seconds.observe(t2 - t0)
+            return "incr", mc
+        return "full", refresh
 
     def _rescope_mirror_cache(
         self, table, mc: _MirrorCache, changed, deleted
@@ -1050,7 +1164,7 @@ class VirtualNodeProvider:
                     continue
                 submitter = it.uid if not it.gen else f"{it.uid}#g{it.gen}"
                 if it.hint and not demand.nodelist:
-                    demand = dataclasses.replace(demand, nodelist=it.hint)
+                    demand = fast_replace(demand, nodelist=it.hint)
                 fill_submit_request(breq.requests.add(), demand, submitter)
                 sent.append(it)
             if not sent:
@@ -1219,6 +1333,18 @@ class VirtualNodeProvider:
             self._refresh_statuses_cols_traced(table, rb, span)
 
     def _refresh_statuses_cols_traced(self, table, rb: _RefreshBatch, span) -> None:
+        ids, reqs = self._status_reqs_full(rb)
+        bytes_fn = self._bytes_rpc("JobsInfo")
+        fetched = (
+            self._bulk_status_bytes(bytes_fn, reqs)
+            if bytes_fn is not None
+            else None
+        )
+        self._apply_status_full(table, rb, span, ids, reqs, fetched)
+
+    def _status_reqs_full(self, rb: _RefreshBatch):
+        """The full status pass's fetch plan: unique job ids in first-
+        appearance order and their chunked requests."""
         ids: list[int] = []
         seen: set[int] = set()
         for jt in rb.job_ids:
@@ -1226,14 +1352,24 @@ class VirtualNodeProvider:
                 if jid not in seen:
                     seen.add(jid)
                     ids.append(jid)
-        scratch = None
         reqs = [
             pb.JobsInfoRequest(job_ids=ids[lo : lo + _BULK_CHUNK])
             for lo in range(0, len(ids), _BULK_CHUNK)
         ]
-        bytes_fn = self._bytes_rpc("JobsInfo")
-        if bytes_fn is not None:
-            state, scratch, _ = self._bulk_status_bytes(bytes_fn, reqs)
+        return ids, reqs
+
+    def _apply_status_full(
+        self, table, rb: _RefreshBatch, span, ids, reqs, fetched
+    ) -> None:
+        """Diff + write for a fetched full status pass. ``fetched`` is
+        ``_bulk_status_bytes``'s result (or None when the bytes twin is
+        unavailable — the pb2 loop re-queries here). Separated from the
+        request build so the staged mirror can run the fetch on its
+        overlap thread; this half owns every store write and runs on the
+        caller's thread in provider order either way."""
+        scratch = None
+        if fetched is not None:
+            state, scratch, _ = fetched
             if state == "unimplemented":
                 self._converge_names(rb.names)
                 return
@@ -1361,19 +1497,36 @@ class VirtualNodeProvider:
             self._refresh_statuses_incr_traced(table, mc, span)
 
     def _refresh_statuses_incr_traced(self, table, mc: _MirrorCache, span) -> None:
-        rb = mc.rb
+        self._prep_status_incr(mc)
+        bytes_fn = self._bytes_rpc("JobsInfo")
+        fetched = (
+            self._bulk_status_bytes(bytes_fn, mc.reqs)
+            if bytes_fn is not None
+            else None
+        )
+        self._apply_status_incr(table, mc, span, fetched)
+
+    def _prep_status_incr(self, mc: _MirrorCache) -> None:
+        """Restamp the cached chunk requests' cursors BEFORE the fan-out:
+        the bytes path serializes the shared request protos from pool
+        workers concurrently (and the staged mirror from its overlap
+        thread), so the stamp must land while the provider still owns
+        them exclusively."""
         cursor = self._jobs_cursor
-        # cursors restamped BEFORE the fan-out: the bytes path serializes
-        # the shared request protos from pool workers concurrently
         for req, full in zip(mc.reqs, mc.full_chunk):
             req.since_version = 0 if full else cursor
+
+    def _apply_status_incr(
+        self, table, mc: _MirrorCache, span, fetched
+    ) -> None:
+        """Diff + write + cursor advance for a fetched cursor pass —
+        the main-thread half of the staged mirror (cf.
+        :meth:`_apply_status_full`)."""
+        rb = mc.rb
         scratch = None
         versions: list[int] = []
-        bytes_fn = self._bytes_rpc("JobsInfo")
-        if bytes_fn is not None:
-            state, scratch, versions = self._bulk_status_bytes(
-                bytes_fn, mc.reqs
-            )
+        if fetched is not None:
+            state, scratch, versions = fetched
             if state == "unimplemented":
                 self._converge_names(rb.names)
                 return

@@ -1,0 +1,478 @@
+"""Process worker pool for the bulk-decode cold path (ISSUE 16).
+
+The mirror's dominant cold-tick cost is no longer protobuf parsing —
+:mod:`~slurm_bridge_tpu.wire.coldec` already vectorized that — it is
+that every chunk's NumPy decode still runs on the ONE interpreter the
+classification, diff and write machinery also needs. This module moves
+the per-chunk ``coldec`` work into forked worker processes and ships the
+resulting columns back **pickle-free**: each response is one raw-bytes
+frame of concatenated little-endian column buffers (``np.frombuffer``
+on the receive side — no object graph crosses the pipe in either
+direction; the request frame is the wire blob itself).
+
+Topology: N fork()ed workers, one duplex pipe each, fed round-robin by
+index so chunk → worker assignment is deterministic. Results merge in
+REQUEST order regardless of completion order — the decoded columns are
+byte-identical to the serial path's by construction, and the fuzz suite
+(``tests/test_colpool.py``) holds pool ≡ serial over randomized protos.
+
+Sizing: ``SBT_COLPOOL_WORKERS`` pins the width (0 disables); otherwise
+the pool takes ``cores - 1`` from the CPU affinity mask, so a 1-core
+box (or a constrained cgroup) degrades to the inline serial path with
+zero pool overhead — the serial oracle is not a fallback mode, it IS
+the pool at width 0. Fork is required (the workers inherit the coldec
+tables by address); platforms without it also degrade to width 0.
+
+Failure posture: a malformed blob raises :class:`coldec.DecodeError`
+in the worker and is re-raised per-chunk in the parent — exactly the
+serial path's per-chunk fallback contract. An infrastructure failure
+(worker death, torn pipe) permanently disables the pool for the
+process and decodes the remaining chunks inline; it can never corrupt
+a column, only cost the speedup.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import struct
+import threading
+
+import numpy as np
+
+from slurm_bridge_tpu.wire import coldec
+
+__all__ = [
+    "ColPool",
+    "active_pool",
+    "configured_width",
+    "decode_serial",
+    "diff_signals",
+    "reset",
+]
+
+log = logging.getLogger("sbt.colpool")
+
+_OP_DECODE = 0x01
+_OP_SET_PRIOR = 0x02
+_OP_DECODE_DIFF = 0x03
+_ST_OK = 0x00
+_ST_DECODE_ERR = 0x01
+_ST_ERROR = 0x02
+
+#: response-frame column order for the fixed int64 block (length = rows
+#: each); must match JobsInfoChunk's numeric slots
+_I64_COLS = (
+    "jid", "id", "state", "start_ts", "limit",
+    "submit_ts", "run_time", "num_nodes",
+)
+#: lazy string-span fields, in frame order (matches coldec's tier-2 set)
+_SPAN_COLS = tuple(name for name, _ in coldec._INFO_STR_FIELDS)
+
+#: header: version, rows, exit-payload bytes, reason-payload bytes
+_HDR = struct.Struct("<qqqq")
+
+#: signal columns the diff op compares (the mirror's tier-1 contract —
+#: keep in lockstep with bridge/vnode.py's _SIGNAL_DIFF_COLS)
+_DIFF_I64 = ("id", "state", "start_ts", "limit")
+_DIFF_STR = ("exit_code", "reason")
+
+
+# ---- frame pack/unpack (shared by worker and parent) -------------------
+
+
+def _pack_str_col(col: np.ndarray) -> tuple[bytes, bytes]:
+    """(lens int64 buffer, utf8 payload) for one object str column."""
+    bs = [s.encode("utf-8") for s in col.tolist()]
+    lens = np.fromiter(map(len, bs), np.int64, len(bs))
+    return lens.tobytes(), b"".join(bs)
+
+
+def _unpack_str_col(buf, off: int, rows: int, payload_len: int):
+    """Inverse of :func:`_pack_str_col`; returns (column, next offset)."""
+    lens = np.frombuffer(buf, np.int64, rows, off)
+    off += rows * 8
+    out = np.full(rows, "", object)
+    if payload_len:
+        payload = bytes(buf[off : off + payload_len])
+        ends = np.cumsum(lens)
+        starts = ends - lens
+        for i in np.nonzero(lens)[0].tolist():
+            out[i] = payload[starts[i] : ends[i]].decode("utf-8")
+    return out, off + payload_len
+
+
+def _pack_chunk(chunk) -> bytes:
+    """One JobsInfoChunk as a raw column frame (no ``data`` — the parent
+    re-attaches its own copy of the wire blob for the lazy spans)."""
+    rows = chunk.rows
+    exit_lens, exit_pay = _pack_str_col(chunk.exit_code)
+    rsn_lens, rsn_pay = _pack_str_col(chunk.reason)
+    parts = [_HDR.pack(chunk.version, rows, len(exit_pay), len(rsn_pay))]
+    for name in _I64_COLS:
+        parts.append(np.ascontiguousarray(
+            getattr(chunk, name), np.int64).tobytes())
+    parts += [exit_lens, exit_pay, rsn_lens, rsn_pay]
+    for name in _SPAN_COLS:
+        start, length = chunk.str_spans[name]
+        parts.append(np.ascontiguousarray(start, np.int64).tobytes())
+        parts.append(np.ascontiguousarray(length, np.int64).tobytes())
+    return b"".join(parts)
+
+
+def _unpack_chunk(buf, data: bytes):
+    """Rebuild a JobsInfoChunk from a column frame + the original wire
+    blob (span fields index into ``data`` exactly as a local decode's
+    would). Columns are writable copies — indistinguishable from the
+    serial decode's freshly-allocated arrays."""
+    version, rows, exit_n, rsn_n = _HDR.unpack_from(buf, 0)
+    off = _HDR.size
+    cols = {}
+    for name in _I64_COLS:
+        cols[name] = np.frombuffer(buf, np.int64, rows, off).copy()
+        off += rows * 8
+    exit_code, off = _unpack_str_col(buf, off, rows, exit_n)
+    reason, off = _unpack_str_col(buf, off, rows, rsn_n)
+    spans = {}
+    for name in _SPAN_COLS:
+        start = np.frombuffer(buf, np.int64, rows, off).copy()
+        off += rows * 8
+        length = np.frombuffer(buf, np.int64, rows, off).copy()
+        off += rows * 8
+        spans[name] = (start, length)
+    jid = cols.pop("jid")
+    return coldec.JobsInfoChunk(
+        data, version, rows, jid,
+        {k: cols[k] for k in (
+            "id", "state", "start_ts", "limit", "submit_ts",
+            "run_time", "num_nodes",
+        )},
+        exit_code, reason, spans,
+    ), off
+
+
+def _pack_prior(prior: dict) -> bytes:
+    """Prior signal columns (jid-ascending) as one frame."""
+    n = int(prior["jid"].size)
+    exit_lens, exit_pay = _pack_str_col(prior["exit_code"])
+    rsn_lens, rsn_pay = _pack_str_col(prior["reason"])
+    parts = [struct.pack("<qqq", n, len(exit_pay), len(rsn_pay))]
+    for name in ("jid",) + _DIFF_I64:
+        parts.append(np.ascontiguousarray(prior[name], np.int64).tobytes())
+    parts += [exit_lens, exit_pay, rsn_lens, rsn_pay]
+    return b"".join(parts)
+
+
+def _unpack_prior(buf) -> dict:
+    n, exit_n, rsn_n = struct.unpack_from("<qqq", buf, 0)
+    off = struct.calcsize("<qqq")
+    prior = {}
+    for name in ("jid",) + _DIFF_I64:
+        prior[name] = np.frombuffer(buf, np.int64, n, off)
+        off += n * 8
+    prior["exit_code"], off = _unpack_str_col(buf, off, n, exit_n)
+    prior["reason"], off = _unpack_str_col(buf, off, n, rsn_n)
+    return prior
+
+
+def diff_signals(chunk, prior: dict) -> np.ndarray:
+    """Changed-row mask for one decoded chunk against prior signal
+    columns: True where the row's job id is absent from ``prior`` or any
+    signal column differs from the prior value. ``prior`` maps column
+    name → array with ``jid`` ascending — the serial oracle the worker
+    op and the fuzz suite both run."""
+    pj = prior["jid"]
+    rows = chunk.rows
+    if pj.size == 0:
+        return np.ones(rows, bool)
+    pos = np.searchsorted(pj, chunk.jid)
+    pos_c = np.minimum(pos, pj.size - 1)
+    known = pj[pos_c] == chunk.jid
+    changed = ~known
+    for name in _DIFF_I64:
+        changed |= getattr(chunk, name) != prior[name][pos_c]
+    for name in _DIFF_STR:
+        changed |= getattr(chunk, name) != prior[name][pos_c]
+    changed[~known] = True
+    return changed
+
+
+def decode_serial(blobs: list[bytes]) -> list:
+    """The serial oracle: per-blob results in order, each a
+    ``JobsInfoChunk`` or the ``DecodeError`` it raised — exactly the
+    pool's per-chunk contract, minus the processes."""
+    out = []
+    for raw in blobs:
+        try:
+            out.append(coldec.decode_jobs_info(raw))
+        except coldec.DecodeError as e:
+            out.append(e)
+    return out
+
+
+# ---- the worker process ------------------------------------------------
+
+
+def _worker_main(conn) -> None:  # pragma: no cover - runs in the child
+    prior: dict | None = None
+    while True:
+        try:
+            frame = conn.recv_bytes()
+        except (EOFError, OSError):
+            break
+        if not frame:
+            break  # shutdown sentinel
+        op = frame[0]
+        try:
+            if op == _OP_SET_PRIOR:
+                prior = _unpack_prior(memoryview(frame)[1:])
+                out = bytes([_ST_OK])
+            elif op in (_OP_DECODE, _OP_DECODE_DIFF):
+                blob = frame[1:]
+                chunk = coldec.decode_jobs_info(blob)
+                body = _pack_chunk(chunk)
+                if op == _OP_DECODE_DIFF:
+                    mask = diff_signals(
+                        chunk, prior if prior is not None else
+                        {"jid": np.empty(0, np.int64)},
+                    )
+                    body += np.ascontiguousarray(mask, np.uint8).tobytes()
+                out = bytes([_ST_OK]) + body
+            else:
+                out = bytes([_ST_ERROR]) + f"unknown op {op}".encode()
+        except coldec.DecodeError as e:
+            out = bytes([_ST_DECODE_ERR]) + str(e).encode("utf-8")
+        except BaseException as e:
+            out = bytes([_ST_ERROR]) + repr(e).encode("utf-8")
+        try:
+            conn.send_bytes(out)
+        except (BrokenPipeError, OSError):
+            break
+
+
+class PoolBroken(RuntimeError):
+    """Infrastructure failure (worker death / torn pipe) — the caller
+    decodes inline; never surfaced as a DecodeError."""
+
+
+class ColPool:
+    """N forked decode workers over raw-bytes pipes (lazily started)."""
+
+    def __init__(self, width: int):
+        self.width = max(1, int(width))
+        self._procs: list = []
+        self._conns: list = []
+        self._locks: list[threading.Lock] = []
+        self._start_lock = threading.Lock()
+        self._broken = False
+
+    # -- lifecycle --
+
+    def _ensure(self) -> bool:
+        if self._conns:
+            return True
+        if self._broken:
+            return False
+        with self._start_lock:
+            if self._conns or self._broken:
+                return bool(self._conns)
+            try:
+                import multiprocessing
+
+                ctx = multiprocessing.get_context("fork")
+                for _ in range(self.width):
+                    parent, child = ctx.Pipe(duplex=True)
+                    proc = ctx.Process(
+                        target=_worker_main, args=(child,), daemon=True
+                    )
+                    proc.start()
+                    child.close()
+                    self._procs.append(proc)
+                    self._conns.append(parent)
+                    self._locks.append(threading.Lock())
+            except (ValueError, OSError) as e:
+                log.warning("colpool start failed; decoding inline: %s", e)
+                self._break()
+                return False
+        return True
+
+    def _break(self) -> None:
+        self._broken = True
+        self.close()
+
+    def close(self) -> None:
+        conns, self._conns = self._conns, []
+        procs, self._procs = self._procs, []
+        self._locks = []
+        for conn in conns:
+            try:
+                conn.send_bytes(b"")
+            except (BrokenPipeError, OSError):
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for proc in procs:
+            proc.join(timeout=2.0)
+            if proc.is_alive():  # pragma: no cover - stuck worker
+                proc.terminate()
+
+    # -- ops --
+
+    def _round_trip(self, w: int, frame: bytes) -> bytes:
+        conn = self._conns[w]
+        with self._locks[w]:
+            conn.send_bytes(frame)
+            return conn.recv_bytes()
+
+    def _run_op(self, op: int, blobs: list[bytes], with_mask: bool) -> list:
+        """Fan ``blobs`` across the workers (round-robin by index) and
+        collect per-blob results in request order: JobsInfoChunk (or
+        (chunk, mask) for the diff op) or DecodeError. Raises
+        :class:`PoolBroken` on infrastructure failure."""
+        results: list = [None] * len(blobs)
+        width = min(self.width, len(blobs))
+        errors: list[BaseException] = []
+
+        def run(w: int) -> None:
+            try:
+                for i in range(w, len(blobs), width):
+                    resp = self._round_trip(w, bytes([op]) + blobs[i])
+                    st = resp[0]
+                    body = memoryview(resp)[1:]
+                    if st == _ST_DECODE_ERR:
+                        results[i] = coldec.DecodeError(
+                            bytes(body).decode("utf-8", "replace")
+                        )
+                    elif st == _ST_OK:
+                        chunk, off = _unpack_chunk(body, blobs[i])
+                        if with_mask:
+                            mask = np.frombuffer(
+                                body, np.uint8, chunk.rows, off
+                            ).astype(bool)
+                            results[i] = (chunk, mask)
+                        else:
+                            results[i] = chunk
+                    else:
+                        raise PoolBroken(
+                            bytes(body).decode("utf-8", "replace")
+                        )
+            except (EOFError, OSError, IndexError, PoolBroken) as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(w,), daemon=True)
+            for w in range(width)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise PoolBroken(str(errors[0]))
+        return results
+
+    def decode_jobs_info_many(self, blobs: list[bytes]) -> list:
+        """Decode each blob in a worker; per-blob JobsInfoChunk or
+        DecodeError, request order. Falls back to the inline serial
+        decode (and stays there) on any pool-infrastructure failure."""
+        if not blobs:
+            return []
+        if not self._ensure():
+            return decode_serial(blobs)
+        try:
+            return self._run_op(_OP_DECODE, blobs, with_mask=False)
+        except PoolBroken as e:
+            log.warning("colpool broken; decoding inline from now on: %s", e)
+            self._break()
+            return decode_serial(blobs)
+
+    def decode_diff_many(self, blobs: list[bytes], prior: dict) -> list:
+        """Decode + signal-diff each blob in a worker: per-blob
+        ``(JobsInfoChunk, changed mask)`` or DecodeError, request order.
+        ``prior`` is shipped once per participating worker, then each
+        chunk diffs against it in-process — the "decode plus mirror
+        diff" op of ISSUE 16."""
+        if not blobs:
+            return []
+        if not self._ensure():
+            return [
+                r if isinstance(r, coldec.DecodeError)
+                else (r, diff_signals(r, prior))
+                for r in decode_serial(blobs)
+            ]
+        try:
+            pframe = bytes([_OP_SET_PRIOR]) + _pack_prior(prior)
+            width = min(self.width, len(blobs))
+            for w in range(width):
+                resp = self._round_trip(w, pframe)
+                if resp[0] != _ST_OK:
+                    raise PoolBroken(resp[1:].decode("utf-8", "replace"))
+            return self._run_op(_OP_DECODE_DIFF, blobs, with_mask=True)
+        except PoolBroken as e:
+            log.warning("colpool broken; decoding inline from now on: %s", e)
+            self._break()
+            return [
+                r if isinstance(r, coldec.DecodeError)
+                else (r, diff_signals(r, prior))
+                for r in decode_serial(blobs)
+            ]
+
+
+# ---- process-wide pool -------------------------------------------------
+
+_pool: ColPool | None = None
+_pool_width: int | None = None
+_pool_lock = threading.Lock()
+
+
+def configured_width() -> int:
+    """Worker count: ``SBT_COLPOOL_WORKERS`` when set (0 disables),
+    else CPU-affinity cores minus one — the main process keeps a core
+    for the diff/write half of the mirror. ≤1 available core means 0:
+    forking a worker that time-slices against the parent would be pure
+    overhead, so the pool degrades to the inline serial path."""
+    env = os.environ.get("SBT_COLPOOL_WORKERS")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            return 0
+    if not hasattr(os, "fork"):  # pragma: no cover - non-posix
+        return 0
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # pragma: no cover - non-linux
+        cores = os.cpu_count() or 1
+    return max(0, cores - 1)
+
+
+def active_pool() -> ColPool | None:
+    """The process-wide pool, or None when the configured width is 0
+    (the caller runs the serial path inline — zero pool overhead)."""
+    global _pool, _pool_width
+    width = configured_width()
+    if width <= 0:
+        return None
+    with _pool_lock:
+        if _pool is None or _pool_width != width:
+            if _pool is not None:
+                _pool.close()
+            _pool = ColPool(width)
+            _pool_width = width
+        return _pool
+
+
+def reset() -> None:
+    """Tear down the process pool (tests; also runs at exit)."""
+    global _pool, _pool_width
+    with _pool_lock:
+        if _pool is not None:
+            _pool.close()
+        _pool = None
+        _pool_width = None
+
+
+atexit.register(reset)

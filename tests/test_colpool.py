@@ -1,0 +1,285 @@
+"""Worker-pool decode equivalence + per-shard mirror equivalence (ISSUE 16).
+
+The parallel cold path has two digest-critical claims, both proved here
+at small shape:
+
+1. the process pool is INVISIBLE: ``ColPool.decode_jobs_info_many`` /
+   ``decode_diff_many`` return, chunk by chunk and byte for byte, what
+   the inline serial oracle (``decode_serial`` / ``diff_signals``)
+   returns — including which blobs raise ``DecodeError``;
+2. the per-shard mirror split and the overlapped fetch pipeline are
+   digest-neutral: a sharded scenario run with ``shard_mirror`` /
+   ``mirror_pipeline`` on produces the same ``final_state_digest`` as
+   the serial global-pass oracle (both flags off).
+
+The pool tests pin ``SBT_COLPOOL_WORKERS=2`` so real worker processes
+run even on a single-CPU box (where auto-sizing would disable the pool).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from slurm_bridge_tpu.parallel import colpool
+from slurm_bridge_tpu.sim.harness import run_scenario
+from slurm_bridge_tpu.sim.scenarios import sharded_smoke
+from slurm_bridge_tpu.wire import coldec, pb
+
+from tests.test_coldec import _random_response
+
+# --------------------------------------------------------- helpers
+
+
+@pytest.fixture()
+def pool(monkeypatch):
+    """A real 2-wide worker pool, torn down (and the process-wide
+    singleton reset) after the test."""
+    monkeypatch.setenv("SBT_COLPOOL_WORKERS", "2")
+    colpool.reset()
+    p = colpool.active_pool()
+    assert p is not None and p.width == 2
+    yield p
+    colpool.reset()
+
+
+def _materialized(chunk, col: str) -> list[bytes]:
+    starts, lens = chunk.str_spans[col]
+    return [
+        bytes(chunk.data[s : s + ln])
+        for s, ln in zip(starts.tolist(), lens.tolist())
+    ]
+
+
+def _assert_chunk_equal(a, b) -> None:
+    """Byte-for-byte chunk equality: signal + numeric columns, the
+    object-array columns, and every tier-2 string span materialized."""
+    assert a.version == b.version
+    assert a.rows == b.rows
+    for col in (
+        "jid", "id", "state", "start_ts", "limit",
+        "submit_ts", "run_time", "num_nodes",
+    ):
+        np.testing.assert_array_equal(
+            getattr(a, col), getattr(b, col), err_msg=col
+        )
+    for col in ("exit_code", "reason"):
+        assert [*getattr(a, col)] == [*getattr(b, col)], col
+    assert set(a.str_spans) == set(b.str_spans)
+    for col in a.str_spans:
+        assert _materialized(a, col) == _materialized(b, col), col
+
+
+def _blobs(seed: int, n: int, *, corrupt_every: int = 0) -> list[bytes]:
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        raw = _random_response(rng).SerializeToString()
+        if corrupt_every and i % corrupt_every == corrupt_every - 1 and raw:
+            raw = raw[: len(raw) - 1 - int(rng.integers(0, len(raw)))]
+        out.append(raw)
+    return out
+
+
+def _prior_from(chunks) -> dict:
+    """Prior signal columns — jid-ascending, last row per jid wins —
+    built from decoded chunks, the shape ``decode_diff_many`` ships."""
+    jid = np.concatenate([c.jid for c in chunks] or [np.empty(0, np.int64)])
+    cols = {}
+    for name in ("id", "state", "start_ts", "limit"):
+        cols[name] = np.concatenate(
+            [getattr(c, name) for c in chunks] or [np.empty(0, np.int64)]
+        )
+    for name in ("exit_code", "reason"):
+        cols[name] = np.concatenate(
+            [getattr(c, name) for c in chunks] or [np.empty(0, object)]
+        )
+    order = np.argsort(jid, kind="stable")
+    jid = jid[order]
+    keep = np.ones(jid.size, bool)
+    keep[:-1] = jid[:-1] != jid[1:]
+    prior = {"jid": jid[keep]}
+    for name, col in cols.items():
+        prior[name] = col[order][keep]
+    return prior
+
+
+# --------------------------------------- pool ≡ serial (fuzz, ISSUE 16c)
+
+
+class TestPoolSerialEquivalence:
+    def test_fuzz_decode_many_matches_serial_oracle(self, pool):
+        """200 random wire buffers (some truncated): the pool returns
+        exactly the serial result, chunk by chunk, in request order."""
+        for seed in (1, 2, 3, 4):
+            blobs = _blobs(seed, 50, corrupt_every=7)
+            got = pool.decode_jobs_info_many(blobs)
+            want = colpool.decode_serial(blobs)
+            assert len(got) == len(want) == len(blobs)
+            for g, w in zip(got, want):
+                if isinstance(w, coldec.DecodeError):
+                    assert isinstance(g, coldec.DecodeError)
+                    assert str(g) == str(w)
+                else:
+                    _assert_chunk_equal(g, w)
+
+    def test_fuzz_decode_diff_matches_serial_oracle(self, pool):
+        """decode+diff in the workers ≡ decode_serial + diff_signals on
+        the main thread: same chunks, same changed-row masks."""
+        for seed in (11, 12, 13):
+            prior_chunks = [
+                c
+                for c in colpool.decode_serial(_blobs(seed + 100, 8))
+                if not isinstance(c, coldec.DecodeError)
+            ]
+            prior = _prior_from(prior_chunks)
+            blobs = _blobs(seed, 40, corrupt_every=9)
+            got = pool.decode_diff_many(blobs, prior)
+            want = [
+                r
+                if isinstance(r, coldec.DecodeError)
+                else (r, colpool.diff_signals(r, prior))
+                for r in colpool.decode_serial(blobs)
+            ]
+            assert len(got) == len(want)
+            for g, w in zip(got, want):
+                if isinstance(w, coldec.DecodeError):
+                    assert isinstance(g, coldec.DecodeError)
+                else:
+                    gc, gm = g
+                    wc, wm = w
+                    _assert_chunk_equal(gc, wc)
+                    np.testing.assert_array_equal(gm, wm)
+
+    def test_empty_prior_flags_every_row(self, pool):
+        blobs = _blobs(21, 6)
+        empty = {
+            "jid": np.empty(0, np.int64),
+            **{k: np.empty(0, np.int64) for k in ("id", "state", "start_ts", "limit")},
+            **{k: np.empty(0, object) for k in ("exit_code", "reason")},
+        }
+        for r in pool.decode_diff_many(blobs, empty):
+            chunk, mask = r
+            assert mask.all() and mask.size == chunk.rows
+
+    def test_decode_error_text_survives_the_pipe(self, pool):
+        """A truncated buffer raises DecodeError with the SAME message
+        through the pool as inline — error fidelity, not just error
+        presence."""
+        bad = _random_response(np.random.default_rng(5)).SerializeToString()[:-2]
+        (inline,) = colpool.decode_serial([bad])
+        (pooled,) = pool.decode_jobs_info_many([bad])
+        assert isinstance(inline, coldec.DecodeError)
+        assert isinstance(pooled, coldec.DecodeError)
+        assert str(pooled) == str(inline)
+
+    def test_empty_input_short_circuits(self, pool):
+        assert pool.decode_jobs_info_many([]) == []
+        assert pool.decode_diff_many([], {"jid": np.empty(0, np.int64)}) == []
+
+    def test_width_zero_env_disables_pool(self, monkeypatch):
+        monkeypatch.setenv("SBT_COLPOOL_WORKERS", "0")
+        colpool.reset()
+        assert colpool.configured_width() == 0
+        assert colpool.active_pool() is None
+        colpool.reset()
+
+
+# ------------------------------- mirror_groups (per-shard split shape)
+
+
+class _FakePlan:
+    def __init__(self, part_shards):
+        self.part_shards = part_shards
+
+
+def _executor_with(part_shards):
+    from slurm_bridge_tpu.shard.executor import ShardExecutor
+
+    ex = ShardExecutor()
+    ex._plan = _FakePlan(part_shards) if part_shards is not None else None
+    return ex
+
+
+class TestMirrorGroups:
+    def test_no_plan_is_one_global_group(self):
+        ex = _executor_with(None)
+        assert ex.mirror_groups(["b", "a"]) == [["a", "b"]]
+        assert ex.mirror_groups([]) == []
+
+    def test_flattened_output_is_exactly_sorted_input(self):
+        """The digest-critical invariant: however ownership fragments
+        the name order, concatenating the groups reproduces the sorted
+        partition list byte for byte."""
+        part_shards = {
+            "part0": (0,), "part1": (1,), "part10": (0, 2), "part2": (1,),
+            "part3": (2,),
+        }
+        ex = _executor_with(part_shards)
+        names = ["part3", "part10", "part0", "part2", "part1", "partX"]
+        groups = ex.mirror_groups(names)
+        assert [n for g in groups for n in g] == sorted(names)
+
+    def test_groups_are_maximal_contiguous_owner_runs(self):
+        part_shards = {
+            "pa": (0,), "pb": (0,), "pc": (1,), "pd": (0,), "pe": (1,),
+        }
+        ex = _executor_with(part_shards)
+        groups = ex.mirror_groups(["pe", "pd", "pc", "pb", "pa"])
+        # sorted: pa(0) pb(0) | pc(1) | pd(0) | pe(1) — shard 0 owns two
+        # runs because pc interleaves; runs never merge across the gap
+        assert groups == [["pa", "pb"], ["pc"], ["pd"], ["pe"]]
+
+    def test_unknown_partitions_own_themselves(self):
+        ex = _executor_with({"known": (3,)})
+        groups = ex.mirror_groups(["u2", "known", "u1"])
+        assert groups == [["known"], ["u1"], ["u2"]]
+
+
+# ----------------- per-shard mirror + pipeline ≡ global serial mirror
+
+
+class TestMirrorDigestEquivalence:
+    """The sharded smoke scenario run three ways — parallel cold path
+    fully on (the default), per-shard split without the overlap, and the
+    serial global-pass oracle — must land on the SAME final state."""
+
+    @pytest.fixture(scope="class")
+    def runs(self):
+        scn = sharded_smoke(scale=0.25)
+        on = run_scenario(scn)
+        split_only = run_scenario(
+            dataclasses.replace(scn, mirror_pipeline=False)
+        )
+        oracle = run_scenario(
+            dataclasses.replace(scn, shard_mirror=False, mirror_pipeline=False)
+        )
+        return on, split_only, oracle
+
+    def test_scenario_actually_shards(self, runs):
+        on, _, _ = runs
+        assert on.determinism["shard"]["shard_count"] >= 2
+
+    def test_per_shard_mirror_is_digest_neutral(self, runs):
+        on, split_only, oracle = runs
+        assert (
+            split_only.determinism["final_state_digest"]
+            == oracle.determinism["final_state_digest"]
+        )
+        assert on.determinism["final_state_digest"] == oracle.determinism[
+            "final_state_digest"
+        ]
+
+    def test_full_determinism_digest_matches_too(self, runs):
+        on, split_only, oracle = runs
+        assert (
+            on.determinism["digest"]
+            == split_only.determinism["digest"]
+            == oracle.determinism["digest"]
+        )
+
+    def test_no_violations_any_arm(self, runs):
+        for r in runs:
+            assert r.determinism["invariant_violations"] == []
