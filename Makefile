@@ -5,6 +5,7 @@
 
 .PHONY: help test fast check generate apidoc hygiene bench bench-smoke \
         sim-smoke chaos-smoke quality-smoke shard-smoke admission-smoke \
+        fleet-smoke \
         sim sim-bench sim-bench-crash sim-bench-500k sim-bench-steady \
         sim-bench-steady-500k wal-fsync-bench scenarios \
         docker-build install uninstall deploy undeploy run demo
@@ -19,7 +20,7 @@ test: ## Full suite + graft compile contracts + hygiene (ref: make test).
 fast: ## ~2-min signal: everything not marked slow.
 	python -m pytest tests/ -q -m "not slow"
 
-check: test bench-smoke sim-smoke chaos-smoke quality-smoke shard-smoke admission-smoke ## Alias the reference's CI verb (+ encode, sim, chaos, quality, shard & admission gates).
+check: test bench-smoke sim-smoke chaos-smoke quality-smoke shard-smoke admission-smoke fleet-smoke ## Alias the reference's CI verb (+ encode, sim, chaos, quality, shard, admission & fleet gates).
 
 generate: ## Regenerate protobuf bindings + API docs (ref: make generate).
 	hack/regen-proto.sh
@@ -51,6 +52,9 @@ shard-smoke: ## Sharded-placement scenarios: double-run determinism + reconcile 
 
 admission-smoke: ## Streaming-admission scenarios: fast-path p99 + admission-off twin gates.
 	python -m slurm_bridge_tpu.sim --admission
+
+fleet-smoke: ## Fleet scenarios: sidecar gRPC solves, single-process twin digests, kill-owner chaos.
+	python -m slurm_bridge_tpu.sim --fleet
 
 sim: ## Run every fast sim scenario full-size (see --list for names).
 	python -m slurm_bridge_tpu.sim --all

@@ -766,3 +766,15 @@ class WorkloadServicer:
         except SlurmError:
             version = "unknown"
         return pb.WorkloadInfoResponse(name=self.wlm_name, version=version, uid=self.uid)
+
+    def Healthz(self, request: pb.HealthzRequest, context) -> pb.HealthzResponse:
+        # fleet version handshake: a skewed peer shows up as a
+        # schema_version mismatch here instead of a mid-RPC decode error
+        import os
+
+        from slurm_bridge_tpu.fleet.columnar import healthz_response
+
+        return healthz_response(
+            "workload-manager",
+            os.environ.get("SBT_INCARNATION", str(os.getpid())),
+        )

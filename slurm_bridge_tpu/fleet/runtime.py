@@ -1,0 +1,297 @@
+"""FleetRuntime: replica membership + sidecar fleet + the remote seam.
+
+Plugs into ``ShardExecutor.remote``: when attached, per-shard solves are
+dispatched to the shard's *owning* replica's solver sidecar over real
+gRPC (columnar framing, byte-parity with inline by construction — see
+``columnar.py``). The membership table keys shard -> replica
+deterministically from the live set, so killing a shard-owner re-keys
+its shard-set to survivors on the next heartbeat; the returned
+``free_after`` is exactly what ``ShardExecutor._merge_traced`` already
+gossips into the leader's cross-shard reconcile residual.
+
+Two stats surfaces, deliberately split:
+
+- ``stats()``    — deterministic membership facts (replica count, rekeys,
+  lease expiries, kills, recovery ticks). Safe to byte-compare in the
+  sim's determinism dict.
+- ``remote_stats()`` — volatile transport counters (remote solves, inline
+  fallbacks, restarts). These depend on OS scheduling and ride the
+  quality section (``policy_extra``) instead; the fleet smoke gates
+  ``remote_solves > 0`` explicitly so a silently-inline run fails loudly.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+
+from slurm_bridge_tpu.obs.metrics import REGISTRY
+
+log = logging.getLogger("sbt.fleet")
+
+_replicas_live = REGISTRY.gauge(
+    "sbt_fleet_replicas_live", "bridge replicas with a live membership lease"
+)
+_rekeys_total = REGISTRY.counter(
+    "sbt_fleet_rekeys_total", "shard-set re-keys (live membership changes)"
+)
+_remote_solves_total = REGISTRY.counter(
+    "sbt_fleet_remote_solves_total", "per-shard solves dispatched to sidecars"
+)
+_inline_fallbacks_total = REGISTRY.counter(
+    "sbt_fleet_inline_fallbacks_total",
+    "per-shard solves that fell back inline (sidecar down or RPC failed)",
+)
+_sidecar_restarts_total = REGISTRY.counter(
+    "sbt_fleet_sidecar_restarts_total", "sidecar processes re-spawned"
+)
+_gossip_staleness = REGISTRY.gauge(
+    "sbt_fleet_gossip_staleness_ticks",
+    "ticks since a remote solve last gossiped a residual back",
+)
+
+
+#: process-wide registry of live FleetRuntimes — what /debug/fleetz
+#: renders (the SCHEDZ pattern: the page is mounted once by
+#: obs.bootstrap; runtimes register on construction, drop on close)
+_ACTIVE: list["FleetRuntime"] = []
+_ACTIVE_LOCK = threading.Lock()
+
+
+def render_fleetz() -> str:
+    """Text body for the /debug/fleetz zpage."""
+    with _ACTIVE_LOCK:
+        runtimes = list(_ACTIVE)
+    if not runtimes:
+        return "fleetz — no fleet runtime active in this process\n"
+    return "\n".join(rt.fleetz() for rt in runtimes)
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Fleet topology + lease tuning; rides ``Scenario.fleet``."""
+
+    replicas: int = 1
+    lease_duration_s: float = 12.0
+    restart_backoff_ticks: int = 2
+    startup_timeout_s: float = 60.0
+
+
+class FleetRuntime:
+    """Owns the membership table, the sidecar fleet, and the leader lease."""
+
+    def __init__(self, config: FleetConfig, state_dir: str, *, clock=time.time):
+        import os
+
+        from slurm_bridge_tpu.bridge.leader import LeaderElector
+        from slurm_bridge_tpu.fleet.membership import MembershipTable
+        from slurm_bridge_tpu.fleet.sidecar import SidecarSupervisor
+
+        self.config = config
+        self.state_dir = state_dir
+        self.clock = clock
+        self.membership = MembershipTable(
+            os.path.join(state_dir, "membership.json"),
+            lease_duration=config.lease_duration_s,
+            clock=clock,
+        )
+        self.supervisors = {
+            f"replica-{i}": SidecarSupervisor(
+                f"replica-{i}", state_dir,
+                startup_timeout_s=config.startup_timeout_s,
+                restart_backoff_ticks=config.restart_backoff_ticks,
+            )
+            for i in range(config.replicas)
+        }
+        self.leader = LeaderElector(
+            os.path.join(state_dir, "fleet-leader.lease"),
+            identity="replica-0",
+            lease_duration=config.lease_duration_s,
+            clock=clock,
+        )
+        self._lock = threading.Lock()
+        self._remote_solves = 0
+        self._inline_fallbacks = 0
+        self._last_remote_tick = -1
+        self._tick = 0
+        self.kills = 0
+        self.rekey_ticks: list[int] = []
+        self.recovery_ticks = 0
+        self._pending_rekey_from = -1
+        self._last_live: tuple[str, ...] = ()
+        self._is_leader = False
+        with _ACTIVE_LOCK:
+            _ACTIVE.append(self)
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        self._is_leader = self.leader.try_acquire()
+        for rid, sup in sorted(self.supervisors.items()):
+            if sup.spawn():
+                self.membership.join(rid, sup.incarnation, sup.endpoint)
+            else:
+                self.membership.mark_dead(rid, reason=sup.down_reason)
+        self._last_live = tuple(self.membership.live())
+        _replicas_live.set(len(self._last_live))
+        if not self._last_live:
+            log.warning("fleet started with zero live replicas: all solves inline")
+
+    def heartbeat(self, tick: int) -> None:
+        """Per-tick membership maintenance: renew live leases, detect dead
+        sidecars, restart after backoff, expire lapsed leases, re-key."""
+        self._tick = tick
+        self._is_leader = self.leader.try_acquire()
+        for rid, sup in sorted(self.supervisors.items()):
+            if sup.poll_alive():
+                self.membership.renew(rid)
+            else:
+                if not sup.down:
+                    sup.mark_down(tick, "process exited")
+                    self.membership.mark_dead(rid, reason="process exited")
+                if sup.maybe_restart(tick):
+                    _sidecar_restarts_total.inc()
+                    self.membership.join(rid, sup.incarnation, sup.endpoint)
+        for rid in self.membership.expire():
+            sup = self.supervisors.get(rid)
+            if sup is not None and not sup.down:
+                sup.mark_down(tick, "lease expired")
+        live = tuple(self.membership.live())
+        if live != self._last_live:
+            self.rekey_ticks.append(tick)
+            _rekeys_total.inc()
+            if len(live) < len(self._last_live) and self._pending_rekey_from < 0:
+                self._pending_rekey_from = tick
+            elif len(live) >= len(self._last_live) and self._pending_rekey_from >= 0:
+                self.recovery_ticks = max(
+                    self.recovery_ticks, tick - self._pending_rekey_from
+                )
+                self._pending_rekey_from = -1
+            log.info("fleet re-key at tick %d: live=%s", tick, list(live))
+            self._last_live = live
+        _replicas_live.set(len(live))
+        if self._last_remote_tick >= 0:
+            _gossip_staleness.set(tick - self._last_remote_tick)
+
+    def kill_replica(self, rid: str) -> None:
+        """Chaos hook: SIGKILL the replica's sidecar, synchronously, so the
+        next heartbeat observes the death deterministically."""
+        sup = self.supervisors.get(rid)
+        if sup is None:
+            return
+        self.kills += 1
+        sup.kill()
+        log.info("fleet chaos: killed %s (sidecar pid reaped)", rid)
+
+    def close(self) -> None:
+        with _ACTIVE_LOCK:
+            if self in _ACTIVE:
+                _ACTIVE.remove(self)
+        for sup in self.supervisors.values():
+            sup.stop()
+        self.leader.release()
+
+    # ---- the remote seam ----
+
+    def try_solve(self, sid, engine, policy, snapshot, batch, incumbent):
+        """Dispatch one shard solve to its owner's sidecar. Returns the
+        Placement, or None -> caller solves inline (remembered fallback:
+        the owner is marked down + dead, so subsequent shards skip the
+        RPC entirely until restart re-adopts it)."""
+        # observed shard-space size, for the fleetz ownership rendering
+        if sid >= getattr(self, "num_shards", 0):
+            self.num_shards = sid + 1
+        owner = self.membership.owner_of(sid)
+        sup = self.supervisors.get(owner) if owner else None
+        if sup is None or sup.client is None:
+            with self._lock:
+                self._inline_fallbacks += 1
+            _inline_fallbacks_total.inc()
+            return None
+        from slurm_bridge_tpu.fleet.columnar import (
+            encode_place_shard,
+            placement_from_response,
+        )
+
+        request = encode_place_shard(sid, engine, policy, snapshot, batch, incumbent)
+        try:
+            resp = sup.client.PlaceShard(request, timeout=self.config.startup_timeout_s)
+        except Exception as exc:  # noqa: BLE001 - any transport failure
+            sup.mark_down(self._tick, f"PlaceShard: {exc}")
+            self.membership.mark_dead(owner, reason="rpc failed")
+            with self._lock:
+                self._inline_fallbacks += 1
+            _inline_fallbacks_total.inc()
+            return None
+        with self._lock:
+            self._remote_solves += 1
+            self._last_remote_tick = self._tick
+        _remote_solves_total.inc()
+        return placement_from_response(resp, batch.num_shards, snapshot.num_nodes)
+
+    # ---- introspection ----
+
+    def stats(self) -> dict:
+        """Deterministic membership facts only (see module docstring)."""
+        return {
+            "replicas": self.config.replicas,
+            "live_final": len(self.membership.live()),
+            "rekeys": self.membership.rekey_count,
+            "lease_expiries": self.membership.lease_expiries,
+            "kills": self.kills,
+            "recovery_ticks": self.recovery_ticks,
+        }
+
+    def remote_stats(self) -> dict:
+        """Volatile transport counters (quality section, not digests)."""
+        with self._lock:
+            return {
+                "remote_solves": self._remote_solves,
+                "inline_fallbacks": self._inline_fallbacks,
+                "sidecar_restarts": sum(
+                    s.restart_count for s in self.supervisors.values()
+                ),
+            }
+
+    def fleetz(self) -> str:
+        """Text zpage body for /debug/fleetz."""
+        lines = [
+            "fleet runtime",
+            f"  replicas: {self.config.replicas}  "
+            f"live: {len(self.membership.live())}  "
+            f"leader: {'yes' if self._is_leader else 'no'}",
+            f"  rekeys: {self.membership.rekey_count}  "
+            f"lease_expiries: {self.membership.lease_expiries}  "
+            f"kills: {self.kills}  recovery_ticks: {self.recovery_ticks}",
+        ]
+        rs = self.remote_stats()
+        lines.append(
+            f"  remote_solves: {rs['remote_solves']}  "
+            f"inline_fallbacks: {rs['inline_fallbacks']}  "
+            f"sidecar_restarts: {rs['sidecar_restarts']}"
+        )
+        staleness = (
+            self._tick - self._last_remote_tick
+            if self._last_remote_tick >= 0 else -1
+        )
+        lines.append(f"  gossip_staleness_ticks: {staleness}")
+        lines.append("")
+        lines.append("replicas")
+        for rid in sorted(self.supervisors):
+            sup = self.supervisors[rid]
+            rec = self.membership.replicas.get(rid, {})
+            state = rec.get("state", "absent")
+            lines.append(
+                f"  {rid:<12} {state:<5} incarnation={sup.incarnation or '-'} "
+                f"restarts={sup.restart_count} "
+                f"down_reason={sup.down_reason or '-'}"
+            )
+        num_shards = getattr(self, "num_shards", 0)
+        if num_shards:
+            lines.append("")
+            lines.append("shard ownership")
+            for rid, sids in sorted(self.membership.shard_sets(num_shards).items()):
+                lines.append(f"  {rid:<12} shards={list(sids)}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,85 @@
+"""Solver sidecar entrypoint: ``python -m slurm_bridge_tpu.fleet.worker``.
+
+A deliberately thin PlacementSolver servicer: PlaceShard runs the pure
+columnar solve (``columnar.solve_place_shard``), Healthz answers the
+supervisor's version handshake. The full ``solver/service.py`` servicer
+(device sessions, XLA bucketing) stays for Place; this process exists to
+be spawned per bridge replica, killed by chaos, and restarted cheaply.
+
+Protocol with the supervisor (test_failover_process.py pattern): after
+the server binds, print ONE JSON line ``{"ready": true, "pid": ...,
+"endpoint": ...}`` on stdout and flush — a crashed worker closes stdout,
+so the supervisor's readline returns "" instead of hanging.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+from slurm_bridge_tpu.fleet.columnar import healthz_response, solve_place_shard
+from slurm_bridge_tpu.wire import workload_pb2 as pb
+
+
+class SidecarServicer:
+    """PlaceShard + Healthz; everything else degrades to UNIMPLEMENTED."""
+
+    def __init__(self, incarnation: str, shard_set: tuple[int, ...] = ()):
+        self.incarnation = incarnation
+        self.shard_set = tuple(shard_set)
+
+    def PlaceShard(self, request: pb.PlaceShardRequest, context) -> pb.PlaceShardResponse:
+        return solve_place_shard(request)
+
+    def Healthz(self, request: pb.HealthzRequest, context) -> pb.HealthzResponse:
+        return healthz_response("solver", self.incarnation, self.shard_set)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="slurm_bridge_tpu.fleet.worker",
+        description="solver sidecar: PlaceShard + Healthz over gRPC",
+    )
+    parser.add_argument("--listen", required=True,
+                        help="endpoint to bind (host:port or /path.sock)")
+    parser.add_argument("--replica-id", default="replica-0",
+                        help="owning bridge replica (labels only)")
+    parser.add_argument("--incarnation", default="0",
+                        help="spawn-unique id echoed by Healthz")
+    parser.add_argument("--shards", default="",
+                        help="comma-separated shard ids this sidecar serves")
+    args = parser.parse_args(argv)
+
+    from slurm_bridge_tpu.wire.rpc import serve
+
+    shard_set = tuple(
+        int(s) for s in args.shards.split(",") if s.strip()
+    )
+    servicer = SidecarServicer(args.incarnation, shard_set)
+    server = serve({"PlacementSolver": servicer}, args.listen, max_workers=4)
+
+    print(json.dumps({
+        "ready": True,
+        "pid": os.getpid(),
+        "endpoint": args.listen,
+        "incarnation": args.incarnation,
+    }), flush=True)
+
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    stop.wait()
+    server.stop(grace=0.5)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

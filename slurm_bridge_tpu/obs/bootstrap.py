@@ -55,6 +55,7 @@ def start_observability(
     )
     if not getattr(args, "metrics_port", 0):
         return None
+    from slurm_bridge_tpu.fleet.runtime import render_fleetz
     from slurm_bridge_tpu.obs.explain import SCHEDZ
     from slurm_bridge_tpu.obs.profiling import sample_profile
 
@@ -68,10 +69,13 @@ def start_observability(
             # placement pressure (ISSUE 15): the live reason-code
             # ledger every PlacementScheduler publishes per solve tick
             "/debug/schedz": lambda: ("text/plain", SCHEDZ.render()),
+            # fleet membership/ownership/sidecar health (ISSUE 17):
+            # every live FleetRuntime in the process renders here
+            "/debug/fleetz": lambda: ("text/plain", render_fleetz()),
         },
         health_checks=health_checks or {"ping": lambda: None},
         ready_checks=ready_checks or {},
     )
-    log.info("%s: metrics/healthz/tracez/profilez/schedz on :%d",
+    log.info("%s: metrics/healthz/tracez/profilez/schedz/fleetz on :%d",
              service, args.metrics_port)
     return httpd

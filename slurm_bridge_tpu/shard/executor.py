@@ -163,13 +163,24 @@ class ShardExecutor:
         self.reconcile_placed_total = 0
         self.locality_sum = 0.0
         self.locality_count = 0
+        #: fleet seam (ISSUE 17): when a FleetRuntime is attached, the
+        #: greedy/native per-shard solves dispatch to the shard owner's
+        #: solver sidecar over gRPC (byte-parity by construction); None
+        #: keeps every solve in-process with zero overhead
+        self.remote = None
+        #: drift re-key probe cache: (base plan key, base plan) so the
+        #: drained-fraction check doesn't rebuild the base plan per tick
+        self._drift_probe: tuple | None = None
 
     # ---- plan + sub-inventory caching ----
 
     def _ensure_plan(self, partitions, nodes) -> ShardPlan:
         key = plan_token(partitions, nodes, self.config)
+        drained = frozenset()
+        if self.config.drift_rekey_fraction > 0:
+            key, drained = self._drift_key(key, partitions, nodes)
         if self._plan is None or key != self._plan_key:
-            self._plan = build_plan(partitions, nodes, self.config)
+            self._plan = build_plan(partitions, nodes, self.config, drained)
             self._plan_key = key
             # a re-plan re-keys every shard's node set: drop shard states
             # whose ids fall away; survivors keep their caches (their
@@ -182,6 +193,29 @@ class ShardExecutor:
             self._sub_cache = None
             _shard_count.set(self._plan.num_shards)
         return self._plan
+
+    def _drift_key(self, base_key, partitions, nodes):
+        """Drift re-key probe (ISSUE 17): when any BASE-plan shard's
+        drained fraction exceeds ``drift_rekey_fraction``, the effective
+        plan key grows the drained set — deterministic (a pure function
+        of node states) and cheap (the base plan is cached on its own
+        key; the per-shard check is one membership scan)."""
+        from slurm_bridge_tpu.shard.planner import drained_positions
+
+        drained = drained_positions(nodes)
+        if not drained:
+            return base_key, frozenset()
+        if self._drift_probe is None or self._drift_probe[0] != base_key:
+            self._drift_probe = (
+                base_key, build_plan(partitions, nodes, self.config)
+            )
+        base_plan = self._drift_probe[1]
+        thresh = self.config.drift_rekey_fraction
+        for shard in base_plan.shards:
+            hit = sum(1 for pos in shard.node_idx if int(pos) in drained)
+            if hit and hit / len(shard.node_idx) > thresh:
+                return (base_key, tuple(sorted(drained))), drained
+        return base_key, frozenset()
 
     def _sub_lists(self, plan, partitions, nodes, sid):
         if (
@@ -378,7 +412,7 @@ class ShardExecutor:
             with TRACER.span("scheduler.shard.solve") as span:
                 span.set_tag("shard", str(sid))
                 placement, engine = self._solve_shard(
-                    st, snapshot, batch, incumbent
+                    st, snapshot, batch, incumbent, sid=sid
                 )
                 span.set_tag("engine", engine)
                 span.count("shards", int(batch.num_shards))
@@ -454,9 +488,29 @@ class ShardExecutor:
             batch.priority[batch.job_of >= n_pend_local] += 0.5
         return incumbent, shard_rows
 
-    def _solve_shard(self, st, snapshot, batch, incumbent):
-        """Route ONE shard's solve; returns (placement, engine name)."""
+    def _remote_solve(self, sid, engine, policy, snapshot, batch, incumbent):
+        """Fleet dispatch (ISSUE 17): ship this shard's columns to its
+        owning replica's solver sidecar. None -> solve inline (no fleet
+        attached, shard unkeyed, or the remembered-fallback path after a
+        sidecar death — the tick always completes)."""
+        remote = self.remote
+        if remote is None or sid < 0:
+            return None
+        return remote.try_solve(sid, engine, policy, snapshot, batch, incumbent)
+
+    def _solve_shard(self, st, snapshot, batch, incumbent, sid=-1):
+        """Route ONE shard's solve; returns (placement, engine name).
+
+        The remote engine names ("greedy-remote"/"native-remote") surface
+        in ``last_routes`` and metrics only — placements are byte-parity
+        with inline (fleet/columnar.py), so digests never see the split.
+        """
         if self.backend == "greedy":
+            placement = self._remote_solve(
+                sid, "greedy", "", snapshot, batch, incumbent
+            )
+            if placement is not None:
+                return placement, "greedy-remote"
             return (
                 greedy_place(snapshot, batch, incumbent=incumbent),
                 "greedy",
@@ -495,12 +549,18 @@ class ShardExecutor:
                     indexed_place_native,
                 )
 
+                policy = native_fit_policy(bool((incumbent >= 0).any()))
+                placement = self._remote_solve(
+                    sid, "native", policy, snapshot, batch, incumbent
+                )
+                if placement is not None:
+                    return placement, "native-remote"
                 return (
                     indexed_place_native(
                         snapshot,
                         batch,
                         incumbent=incumbent,
-                        policy=native_fit_policy(bool((incumbent >= 0).any())),
+                        policy=policy,
                     ),
                     "native",
                 )

@@ -63,6 +63,13 @@ class ShardConfig:
     device_solve: bool | None = None
     #: P×N floor for the device shard_map sweep (routing.use_sharded)
     sharded_threshold: int = 1 << 20
+    #: drift re-key threshold (ISSUE 17): when > 0 and any shard's
+    #: drained-node fraction exceeds it, the plan re-keys with drained
+    #: nodes quarantined into their own islands instead of keeping stale
+    #: boundaries (a half-drained shard solves at half capacity but
+    #: still pays full encode). 0 disables the probe — every pinned
+    #: digest is preserved because the plan key never changes shape.
+    drift_rekey_fraction: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -128,12 +135,28 @@ def plan_token(
     )
 
 
+def drained_positions(nodes: list[NodeInfo]) -> frozenset[int]:
+    """Global positions of drained/down nodes (sim agent's drain rule)."""
+    return frozenset(
+        i
+        for i, nd in enumerate(nodes)
+        if "DRAIN" in nd.state.upper() or "DOWN" in nd.state.upper()
+    )
+
+
 def build_plan(
     partitions: list[PartitionInfo],
     nodes: list[NodeInfo],
     config: ShardConfig,
+    drained: frozenset[int] = frozenset(),
 ) -> ShardPlan:
-    """Decompose the inventory into islands and pack them into shards."""
+    """Decompose the inventory into islands and pack them into shards.
+
+    ``drained`` (global node positions) quarantines those nodes into
+    dedicated ``<kind>-drained`` islands — the drift re-key path: live
+    nodes re-pack densely while the drained remainder stays routable (a
+    node can un-drain next tick) without diluting live shards.
+    """
     cap = max(1, config.max_nodes_per_shard)
     name_pos = {nd.name: i for i, nd in enumerate(nodes)}
     owned: set[int] = set()
@@ -149,7 +172,16 @@ def build_plan(
         owned.update(mine)
         gpu = [i for i in mine if nodes[i].gpus > 0]
         cpu = [i for i in mine if nodes[i].gpus <= 0]
+        groups: list[tuple[str, list[int]]] = []
         for kind, group in (("gpu", gpu), ("cpu", cpu)):
+            if not drained:
+                groups.append((kind, group))
+                continue
+            groups.append((kind, [i for i in group if i not in drained]))
+            groups.append(
+                (kind + "-drained", [i for i in group if i in drained])
+            )
+        for kind, group in groups:
             if not group:
                 continue
             nchunks = (len(group) + cap - 1) // cap

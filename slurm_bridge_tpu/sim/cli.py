@@ -30,6 +30,7 @@ from slurm_bridge_tpu.sim.harness import SimHarness, run_scenario
 from slurm_bridge_tpu.sim.scenarios import (
     ADMISSION_SCENARIOS,
     CHAOS_SCENARIOS,
+    FLEET_SCENARIOS,
     QUALITY_SCENARIOS,
     SCENARIOS,
     SHARD_SCENARIOS,
@@ -460,6 +461,111 @@ def _quality(label: str = "quality-smoke") -> int:
     return 0
 
 
+def _fleet(label: str = "fleet-smoke") -> int:
+    """The fleet gate (ISSUE 17): each fleet scenario runs TWICE
+    (double-run determinism — membership facts included), then its
+    single-process twin at the same seed:
+
+    - **fleet twin**: the fleet run's ``final_state_digest`` must be
+      byte-identical to the same scenario with ``fleet=None`` and the
+      ``kill_replica`` faults stripped — remote solves are byte-parity
+      with inline and a re-key only changes WHO solves, so any
+      divergence is a lost bind or a corrupted shard merge;
+    - **engagement**: ``remote_solves > 0`` — a fleet run that silently
+      solved everything inline is a failed gate, not a pass;
+    - **chaos** (``fleet_kill_owner``): the kill actually happened, the
+      dead replica's sidecar was re-adopted (``live_final`` back to
+      full strength) within ``max_recovery_ticks``, and zero
+      VirtualNode deletions (no node flap from a fleet event).
+    """
+    from slurm_bridge_tpu.sim.faults import FLEET_KINDS
+
+    failures: list[str] = []
+    for name in FLEET_SCENARIOS:
+        runs = []
+        for _ in range(2):
+            sc = _build(name, seed=None, scale=SMOKE_SCALE, ticks=None)
+            runs.append(run_scenario(sc))
+        a, b = runs
+        det_a, det_b = a.determinism_json(), b.determinism_json()
+        fleet = a.determinism.get("fleet") or {}
+        remote = a.quality.get("fleet_remote") or {}
+        line = {
+            "scenario": name,
+            "deterministic": det_a == det_b,
+            "violations": len(a.determinism["invariant_violations"]),
+            "bound_total": a.determinism["bound_total"],
+            "pending_final": a.determinism["pending_final"],
+            "vnode_deletions": a.determinism["vnode_deletions"],
+            "fleet": fleet,
+            "fleet_remote": remote,
+            "tick_p50_ms": a.timing["tick_p50_ms"],
+        }
+        print(json.dumps(line))
+        if det_a != det_b:
+            failures.append(f"{name}: determinism broke (same seed, different run)")
+        if a.determinism["invariant_violations"]:
+            first = a.determinism["invariant_violations"][0]
+            failures.append(f"{name}: invariant violated: {first}")
+        if not remote.get("remote_solves"):
+            failures.append(
+                f"{name}: fleet attached but remote_solves == 0 — every "
+                "shard solved inline, the gRPC path never engaged"
+            )
+        twin = run_scenario(
+            dataclasses.replace(
+                a.scenario,
+                fleet=None,
+                faults=a.scenario.faults.strip(FLEET_KINDS),
+            )
+        )
+        same = (
+            twin.determinism["final_state_digest"]
+            == a.determinism["final_state_digest"]
+        )
+        print(json.dumps({
+            "scenario": f"{name}[single-process twin]",
+            "compared": "final_state_digest",
+            "final_identical": same,
+        }))
+        if not same:
+            failures.append(
+                f"{name}: final_state_digest diverged from the single-"
+                "process run at the same seed — a remote solve or "
+                "re-key changed placements"
+            )
+        if name == "fleet_kill_owner":
+            if not fleet.get("kills"):
+                failures.append(f"{name}: kill_replica fault never killed anyone")
+            if fleet.get("live_final") != fleet.get("replicas"):
+                failures.append(
+                    f"{name}: fleet ended at {fleet.get('live_final')}/"
+                    f"{fleet.get('replicas')} live — the killed replica "
+                    "was never re-adopted"
+                )
+            bound = a.scenario.max_recovery_ticks
+            rec = fleet.get("recovery_ticks", 0)
+            if bound is not None and rec > bound:
+                failures.append(
+                    f"{name}: fleet recovery_ticks {rec} over the "
+                    f"scenario bound {bound}"
+                )
+            if a.determinism["vnode_deletions"]:
+                failures.append(
+                    f"{name}: {a.determinism['vnode_deletions']} "
+                    "VirtualNode deletions across a replica kill (must be 0)"
+                )
+    if failures:
+        for f in failures:
+            print(f"# {label} FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"# {label} OK: {len(FLEET_SCENARIOS)} scenarios, deterministic, "
+        "fleet twins byte-identical, chaos re-key held", file=sys.stderr,
+    )
+    return 0
+
+
 def _admission(label: str = "admission-smoke") -> int:
     """The streaming-admission gate (ISSUE 12): each admission scenario
     runs TWICE (double-run determinism over the decision stream —
@@ -626,6 +732,15 @@ def main(argv: list[str] | None = None) -> int:
                         help="CI gate: the streaming-admission scenarios "
                         "(double-run determinism + interactive latency "
                         "p99 + admission-off utilization twin)")
+    parser.add_argument("--fleet", action="store_true",
+                        help="CI gate: the fleet scenarios (double-run "
+                        "determinism + single-process twin digest + "
+                        "remote-solve engagement + kill-shard-owner "
+                        "chaos re-key)")
+    parser.add_argument("--sidecars", type=int, default=None, metavar="N",
+                        help="override the fleet replica count for named "
+                        "fleet scenarios (each replica owns a shard-set "
+                        "and a solver sidecar process)")
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--explain", default="", metavar="JOB",
                         help="render one job's placement decision trail "
@@ -653,6 +768,8 @@ def main(argv: list[str] | None = None) -> int:
         return _smoke(SHARD_SCENARIOS, label="shard-smoke")
     if args.admission:
         return _admission()
+    if args.fleet:
+        return _fleet()
     if args.smoke:
         return _smoke()
 
@@ -683,6 +800,16 @@ def main(argv: list[str] | None = None) -> int:
     gate_failures: list[str] = []
     for name in names:
         sc = _build(name, seed=args.seed, scale=args.scale, ticks=args.ticks)
+        if args.sidecars is not None:
+            if sc.fleet is None:
+                parser.error(
+                    f"--sidecars only applies to fleet scenarios; "
+                    f"{name} has no fleet config"
+                )
+            sc = dataclasses.replace(
+                sc,
+                fleet=dataclasses.replace(sc.fleet, replicas=args.sidecars),
+            )
         if args.explain:
             # --explain <job>: trace one job's decision trail (ISSUE 15
             # sink 3). Accept the job name or the sizecar pod name —

@@ -60,9 +60,14 @@ BRIDGE_KINDS = ("crash_restart", "leader_failover")
 #: drops the fake agent's process state (jobs, ledger, queue, per-node
 #: allocation) and rebuilds it from the agent job-state journal
 AGENT_KINDS = ("agent_crash",)
+#: fault kinds that kill a FLEET replica's sidecar process (ISSUE 17):
+#: the harness SIGKILLs the named replica at the start tick; its
+#: shard-set re-keys to survivors on the next membership heartbeat and
+#: the restart-with-backoff path re-adopts it
+FLEET_KINDS = ("kill_replica",)
 #: every kind any delivery mechanism understands — plan validation warns
 #: on anything else (a typo'd kind silently tests nothing)
-ALL_KINDS = RPC_KINDS + CLUSTER_KINDS + BRIDGE_KINDS + AGENT_KINDS
+ALL_KINDS = RPC_KINDS + CLUSTER_KINDS + BRIDGE_KINDS + AGENT_KINDS + FLEET_KINDS
 
 
 @dataclass(frozen=True)
@@ -130,6 +135,9 @@ class Fault:
     #: preemption_storm: cpus_per_task draw for storm jobs (() = the
     #: PR-2 default (4, 8, 16)); node-sized asks force real preemption
     storm_cpus: tuple[int, ...] = ()
+    #: kill_replica: fleet replica id whose sidecar dies ("" = the
+    #: owner of shard 0 at the start tick)
+    replica: str = ""
 
     def active(self, tick: int) -> bool:
         return self.start_tick <= tick < self.end_tick
@@ -248,6 +256,8 @@ class FaultPlan:
                 d.update(jobs=f.jobs)
             elif f.kind == "leader_failover":
                 d.update(graceful=f.graceful)
+            elif f.kind == "kill_replica":
+                d.update(replica=f.replica or "shard-0-owner")
             out.append(d)
         return out
 

@@ -240,6 +240,11 @@ class Scenario:
     #: is non-empty: fault draws must stay on the plain sequenced path.
     #: False is the sequential oracle
     mirror_pipeline: bool = True
+    #: fleet runtime config (fleet.FleetConfig): replicas + solver
+    #: sidecar processes; per-shard solves dispatch to the shard
+    #: owner's sidecar over real gRPC (byte-parity with inline — the
+    #: fleet twin gate proves it). None = single-process, zero overhead
+    fleet: object | None = None
 
 
 @dataclass
@@ -436,6 +441,9 @@ class SimHarness:
         self.events.add_sink(self._count_event)
         #: the pipelined mirror's overlap thread (lazy; stack-scoped)
         self._mirror_pool = None
+        #: fleet runtime (ISSUE 17) — built after the state dir below;
+        #: None until then so _build_stack's attach guard no-ops
+        self.fleet = None
         self._build_stack()
         #: the tick flight recorder — always-on unless the scenario opts
         #: out (the overhead gate's control arm); every run_tick is one
@@ -499,7 +507,11 @@ class SimHarness:
         self._standby: LeaderElector | None = None
         self._active_elector: LeaderElector | None = None
         self._dead_elector: LeaderElector | None = None
-        if self._needs_persistence or self._needs_agent_journal:
+        if (
+            self._needs_persistence
+            or self._needs_agent_journal
+            or scenario.fleet is not None
+        ):
             self._state_dir = tempfile.mkdtemp(prefix="sbt-sim-state-")
         if self._needs_persistence:
             self.state_file = os.path.join(self._state_dir, "bridge-state.json")
@@ -533,6 +545,24 @@ class SimHarness:
                 clock=lambda: self.vt,
             )
             self._active_elector = self.elector
+
+        if scenario.fleet is not None:
+            from slurm_bridge_tpu.fleet.runtime import FleetRuntime
+
+            # leases run on virtual time (the sim drives heartbeats);
+            # sidecar spawn/handshake is wall-time OS work, like any
+            # other subprocess the harness owns
+            self.fleet = FleetRuntime(
+                scenario.fleet, self._state_dir, clock=lambda: self.vt
+            )
+            self.fleet.start()
+            self._attach_fleet()
+
+    def _attach_fleet(self) -> None:
+        """Point the executor's remote seam at the fleet (re-run after a
+        crash reload rebuilds the scheduler)."""
+        if self.fleet is not None and self.scheduler.shard is not None:
+            self.scheduler.shard.remote = self.fleet
 
     def _make_persistence(self) -> StorePersistence:
         """StorePersistence in the sim's deterministic posture: manual
@@ -622,6 +652,10 @@ class SimHarness:
             self._trail_lines = self.scheduler.explain_trail.lines
         self._pod_watch = self.store.watch((Pod.KIND,))
         self._node_watch = self.store.watch((VirtualNode.KIND,))
+        # fleet re-attach (no-op at init: the fleet is built after the
+        # first _build_stack; crash reloads re-point the fresh executor)
+        if getattr(self, "fleet", None) is not None:
+            self._attach_fleet()
 
     # ---- crash / failover machinery ----
 
@@ -1222,6 +1256,13 @@ class SimHarness:
         self._agent_faults(tick)
         self._bridge_faults(tick)
         self._apply_fault_boundaries(tick)
+        if self.fleet is not None:
+            # kill BEFORE the heartbeat so death + re-key land in the
+            # same tick deterministically (kill_replica is synchronous)
+            for f in self.scenario.faults.starting("kill_replica", tick):
+                rid = f.replica or self.fleet.membership.owner_of(0) or ""
+                self.fleet.kill_replica(rid)
+            self.fleet.heartbeat(tick)
         if self.scheduler.explain_trail is not None:
             self.scheduler.explain_trail.tick = tick
         # store/scheduler may have been replaced by a bridge fault above —
@@ -1492,6 +1533,11 @@ class SimHarness:
         return hashlib.sha256(payload.encode()).hexdigest()
 
     def _cleanup(self) -> None:
+        if self.fleet is not None:
+            # before the rmtree below: sidecars hold sockets + the
+            # membership WAL inside the state dir
+            self.fleet.close()
+            self.fleet = None
         if self.agent_journal is not None:
             self.agent_journal.close()
         if self._mirror_pool is not None:
@@ -1652,6 +1698,13 @@ class SimHarness:
             # reason) are decision facts, fully virtual-deterministic —
             # the admission-smoke double-run gate covers the fast path
             determinism["admission"] = self.scheduler.admission.stats()
+        if self.fleet is not None:
+            # membership facts only (replica count, rekeys, expiries,
+            # kills, recovery) — deterministic on virtual time, so they
+            # ride the byte-compared section. Transport counters (remote
+            # vs inline solves) are OS-scheduling-volatile and ride the
+            # quality section instead (policy_extra["fleet_remote"])
+            determinism["fleet"] = self.fleet.stats()
         phase_arr = {
             k: np.asarray([p.get(k, 0.0) for p in self._tick_phases])
             for k in (*PHASES, "tick", "cpu")
@@ -1769,6 +1822,12 @@ class SimHarness:
             policy_extra["admission_misses"] = dict(
                 sorted(self.scheduler.admission.misses.items())
             )
+        if self.fleet is not None:
+            # volatile transport counters (remote solves vs inline
+            # fallbacks depend on OS scheduling of real subprocesses) —
+            # quality section only; the fleet smoke asserts
+            # remote_solves > 0 here so a silently-inline run fails
+            policy_extra["fleet_remote"] = self.fleet.remote_stats()
         result = ScenarioResult(
             scenario=sc,
             determinism=determinism,

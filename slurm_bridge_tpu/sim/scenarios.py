@@ -681,6 +681,80 @@ def sharded_smoke(scale: float = 1.0, seed: int = 58) -> Scenario:
     )
 
 
+def fleet_smoke(scale: float = 1.0, seed: int = 58) -> Scenario:
+    """The fleet-of-1 twin gate (ISSUE 17): ``sharded_smoke``'s exact
+    shape and seed with a 1-replica fleet attached — every greedy/native
+    shard solve round-trips through a real solver sidecar process over
+    gRPC, and the ``final_state_digest`` must be byte-identical to the
+    single-process run (the fleet-smoke gate strips ``fleet`` for the
+    twin arm). The gate also requires ``remote_solves > 0``: a fleet run
+    that silently solved inline is a failed gate, not a pass."""
+    from slurm_bridge_tpu.fleet.runtime import FleetConfig
+
+    base = sharded_smoke(scale=scale, seed=seed)
+    import dataclasses
+
+    return dataclasses.replace(
+        base,
+        name="fleet_smoke",
+        description="1-replica fleet over real gRPC; digest byte-"
+        "identical to the single-process twin",
+        fleet=FleetConfig(replicas=1),
+    )
+
+
+def fleet_kill_owner(scale: float = 1.0, seed: int = 61) -> Scenario:
+    """The fleet chaos gate (ISSUE 17): 3 replicas each owning a
+    shard-set; a priority storm lands at tick 6 and the owner of shard 0
+    is SIGKILLed at tick 7, mid-storm. Its shard-set must re-key to
+    survivors on the same tick's membership heartbeat (remote solves are
+    byte-parity with inline, so the re-key is invisible to digests — the
+    gate compares ``final_state_digest`` against the kill-stripped twin),
+    with zero lost binds, zero VirtualNode deletions, and recovery
+    (restart-with-backoff re-adopting the sidecar) within
+    ``max_recovery_ticks``."""
+    from slurm_bridge_tpu.fleet.runtime import FleetConfig
+
+    n_nodes = _n(600, scale)
+    return Scenario(
+        name="fleet_kill_owner",
+        description="kill the shard-0 owner mid-storm: re-key to "
+        "survivors, zero lost binds, bounded recovery",
+        cluster=ClusterSpec(
+            num_nodes=n_nodes,
+            num_partitions=3,
+            partition_features=("tier0", "tier1"),
+        ),
+        workload=WorkloadSpec(
+            jobs=_n(1600, scale, floor=60),
+            arrival="poisson",
+            spread_ticks=8,
+            gang_fraction=0.2,
+        ),
+        faults=FaultPlan(
+            (
+                Fault(
+                    kind="preemption_storm",
+                    start_tick=6,
+                    end_tick=7,
+                    jobs=_n(120, scale, floor=10),
+                    priority=1000,
+                ),
+                Fault(kind="kill_replica", start_tick=7, end_tick=8),
+            )
+        ),
+        ticks=16,
+        preemption=True,
+        drain_grace_ticks=100,
+        seed=seed,
+        sharding=ShardConfig(
+            max_nodes_per_shard=max(12, n_nodes // 9), workers=2
+        ),
+        fleet=FleetConfig(replicas=3, restart_backoff_ticks=2),
+        max_recovery_ticks=6,
+    )
+
+
 def sharded_gang_split(scale: float = 1.0, seed: int = 59) -> Scenario:
     """The cross-shard reconciliation shape: gangs of 8 on partitions
     deliberately split into shards too small to host them
@@ -934,6 +1008,8 @@ SCENARIOS = {
         steady_state_soak,
         sharded_smoke,
         sharded_gang_split,
+        fleet_smoke,
+        fleet_kill_owner,
         full_500kx100k,
         full_500kx100k_steady,
         full_1mx200k,
@@ -981,6 +1057,16 @@ SHARD_SCENARIOS = (
 #: must be WORSE than the gate or the comparison is vacuous)
 ADMISSION_SCENARIOS = ("interactive_storm",)
 
+#: the fleet subset `make fleet-smoke` runs (ISSUE 17): double-run
+#: determinism, the fleet-of-1 single-process twin digest, the
+#: remote-solve engagement floor, and the kill-shard-owner chaos gate
+#: (re-key to survivors, zero lost binds, bounded recovery). Excluded
+#: from sim-smoke: each fleet run spawns real sidecar subprocesses
+FLEET_SCENARIOS = (
+    "fleet_smoke",
+    "fleet_kill_owner",
+)
+
 #: the fast set `make sim-smoke` double-runs: everything not slow-marked,
 #: MINUS the chaos and quality subsets (and the shard subset except
 #: sharded_smoke, see above) — `make check` and CI run sim-smoke,
@@ -993,5 +1079,6 @@ SMOKE_SCENARIOS = tuple(
     and n not in CHAOS_SCENARIOS
     and n not in QUALITY_SCENARIOS
     and n not in ADMISSION_SCENARIOS
+    and n not in FLEET_SCENARIOS
     and (n not in SHARD_SCENARIOS or n == "sharded_smoke")
 )

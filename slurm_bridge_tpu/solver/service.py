@@ -208,6 +208,22 @@ class PlacementSolverServicer:
             backend=backend, devices=devices, mesh=mesh, solvers=list(SOLVERS)
         )
 
+    def PlaceShard(self, request: pb.PlaceShardRequest, context) -> pb.PlaceShardResponse:
+        # the fleet sidecar path: pure columnar solve, no device session —
+        # byte-parity with the bridge's in-process engines by construction
+        from slurm_bridge_tpu.fleet.columnar import solve_place_shard
+
+        return solve_place_shard(request)
+
+    def Healthz(self, request: pb.HealthzRequest, context) -> pb.HealthzResponse:
+        import os
+
+        from slurm_bridge_tpu.fleet.columnar import healthz_response
+
+        return healthz_response(
+            "solver", os.environ.get("SBT_INCARNATION", str(os.getpid()))
+        )
+
     # ---- lowering ----
 
     def _encode(

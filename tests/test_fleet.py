@@ -1,0 +1,459 @@
+"""Fleet runtime (ISSUE 17): sidecar solve parity, supervision,
+membership re-keying, drift re-key, and the end-to-end sim gates.
+
+Layering mirrors the subsystem: pure columnar framing first (no
+processes), then the membership table (virtual clock, no processes),
+then real sidecar processes (spawn/crash/re-adopt), then the full sim
+twins (slow-marked — ``make fleet-smoke`` runs the same gates in CI).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import tempfile
+
+import numpy as np
+import pytest
+
+from slurm_bridge_tpu.core.types import NodeInfo, PartitionInfo
+from slurm_bridge_tpu.fleet import (
+    FleetConfig,
+    FleetRuntime,
+    MembershipTable,
+    decode_place_shard,
+    encode_place_shard,
+    placement_from_response,
+    schema_digest,
+    solve_place_shard,
+)
+from slurm_bridge_tpu.shard.planner import (
+    ShardConfig,
+    build_plan,
+    drained_positions,
+)
+from slurm_bridge_tpu.solver.greedy import greedy_place
+from slurm_bridge_tpu.solver.snapshot import ClusterSnapshot, JobBatch
+
+
+def _shape(rng, n, p, *, gangs=False):
+    snap = ClusterSnapshot(
+        node_names=[f"n{i}" for i in range(n)],
+        capacity=np.full((n, 3), 64, np.float32),
+        free=rng.uniform(0, 64, (n, 3)).astype(np.float32),
+        partition_of=rng.integers(0, 3, n).astype(np.int32),
+        features=rng.integers(0, 4, n).astype(np.uint32),
+        partition_codes={"a": 0, "b": 1, "c": 2},
+        feature_codes={"f0": 0, "f1": 1},
+    )
+    gang = (
+        rng.integers(0, max(1, p // 3), p).astype(np.int32)
+        if gangs else np.arange(p, dtype=np.int32)
+    )
+    batch = JobBatch(
+        demand=rng.uniform(0.5, 16, (p, 3)).astype(np.float32),
+        partition_of=rng.integers(-1, 3, p).astype(np.int32),
+        req_features=rng.integers(0, 4, p).astype(np.uint32),
+        priority=rng.uniform(0, 100, p).astype(np.float32),
+        gang_id=gang,
+        job_of=np.arange(p, dtype=np.int32),
+    )
+    return snap, batch
+
+
+# --------------------------------------------------------------------------
+# columnar framing (pure; no processes)
+# --------------------------------------------------------------------------
+
+
+def test_place_shard_roundtrip_preserves_solver_columns():
+    rng = np.random.default_rng(7)
+    snap, batch = _shape(rng, 24, 30)
+    incumbent = np.full(30, -1, np.int32)
+    incumbent[3] = 5
+    req = encode_place_shard(2, "greedy", "", snap, batch, incumbent)
+    snap2, batch2, inc2 = decode_place_shard(req)
+    np.testing.assert_array_equal(snap2.free, snap.free)
+    np.testing.assert_array_equal(snap2.partition_of, snap.partition_of)
+    np.testing.assert_array_equal(snap2.features, snap.features)
+    for f in ("demand", "partition_of", "req_features", "priority",
+              "gang_id", "job_of"):
+        np.testing.assert_array_equal(getattr(batch2, f), getattr(batch, f))
+    np.testing.assert_array_equal(inc2, incumbent)
+    assert snap2.num_nodes == 24
+    # decoded arrays must be writable: the engines mutate free in place
+    snap2.free[0, 0] = 1.0
+
+
+def test_place_shard_no_incumbent_decodes_to_none():
+    rng = np.random.default_rng(8)
+    snap, batch = _shape(rng, 8, 6)
+    req = encode_place_shard(0, "greedy", "", snap, batch, None)
+    _, _, inc = decode_place_shard(req)
+    assert inc is None
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_solve_place_shard_parity_with_inline_greedy(seed):
+    """The remote-parity foundation, fuzzed in-process: the worker-side
+    solve over decoded columns must be byte-identical to the inline
+    engine over the original objects."""
+    rng = np.random.default_rng(100 + seed)
+    n = int(rng.integers(4, 60))
+    p = int(rng.integers(1, 80))
+    snap, batch = _shape(rng, n, p, gangs=bool(seed % 2))
+    incumbent = None
+    if seed % 3 == 0:
+        incumbent = np.where(
+            rng.random(p) < 0.2, rng.integers(0, n, p), -1
+        ).astype(np.int32)
+        # pinned rows must actually fit where they are pinned — mirror
+        # _pin_incumbents, which releases usage before the solve
+        for row in np.nonzero(incumbent >= 0)[0]:
+            snap.free[incumbent[row]] += batch.demand[row]
+    inline = greedy_place(
+        ClusterSnapshot(
+            node_names=list(snap.node_names),
+            capacity=snap.capacity.copy(),
+            free=snap.free.copy(),
+            partition_of=snap.partition_of,
+            features=snap.features,
+            partition_codes=snap.partition_codes,
+            feature_codes=snap.feature_codes,
+        ),
+        batch,
+        incumbent=incumbent,
+    )
+    resp = solve_place_shard(
+        encode_place_shard(0, "greedy", "", snap, batch, incumbent)
+    )
+    remote = placement_from_response(resp, p, n)
+    np.testing.assert_array_equal(remote.node_of, inline.node_of)
+    np.testing.assert_array_equal(remote.placed, inline.placed)
+    np.testing.assert_array_equal(remote.free_after, inline.free_after)
+
+
+def test_schema_digest_is_stable_and_short():
+    assert schema_digest() == schema_digest()
+    assert len(schema_digest()) == 16
+
+
+# --------------------------------------------------------------------------
+# membership table (virtual clock; no processes)
+# --------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_membership_lease_expiry_rekeys_to_survivors():
+    clock = _Clock()
+    with tempfile.TemporaryDirectory() as d:
+        table = MembershipTable(
+            os.path.join(d, "m.json"), lease_duration=10.0, clock=clock
+        )
+        table.join("replica-0", "replica-0.1", "a.sock")
+        table.join("replica-1", "replica-1.1", "b.sock")
+        assert table.live() == ["replica-0", "replica-1"]
+        owners = [table.owner_of(s) for s in range(4)]
+        assert owners == ["replica-0", "replica-1", "replica-0", "replica-1"]
+        rekeys_before = table.rekey_count
+        # replica-1 stops renewing; replica-0 keeps its lease alive
+        clock.t = 8.0
+        table.renew("replica-0")
+        clock.t = 11.0
+        assert table.expire() == ["replica-1"]
+        assert table.lease_expiries == 1
+        assert table.live() == ["replica-0"]
+        assert table.rekey_count == rekeys_before + 1
+        # every shard re-keys to the survivor
+        assert [table.owner_of(s) for s in range(4)] == ["replica-0"] * 4
+        # rejoin re-keys back
+        table.join("replica-1", "replica-1.2", "b.sock")
+        assert table.owner_of(1) == "replica-1"
+
+
+def test_membership_persists_and_reloads():
+    clock = _Clock()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.json")
+        table = MembershipTable(path, lease_duration=10.0, clock=clock)
+        table.join("replica-0", "replica-0.1", "a.sock")
+        table.mark_dead("replica-0", reason="test")
+        table.join("replica-1", "replica-1.1", "b.sock")
+        reloaded = MembershipTable(path, lease_duration=10.0, clock=clock)
+        assert reloaded.live() == ["replica-1"]
+        assert reloaded.rekey_count == table.rekey_count
+        # the WAL recorded the events, not the renews
+        with open(path + ".wal", encoding="utf-8") as fh:
+            events = [line.split('"event": "')[1].split('"')[0]
+                      for line in fh if '"event"' in line]
+        assert "join" in events and "dead" in events and "rekey" in events
+
+
+def test_shard_sets_partition_the_shard_space():
+    clock = _Clock()
+    with tempfile.TemporaryDirectory() as d:
+        table = MembershipTable(
+            os.path.join(d, "m.json"), lease_duration=10.0, clock=clock
+        )
+        for i in range(3):
+            table.join(f"replica-{i}", f"replica-{i}.1", f"{i}.sock")
+        sets = table.shard_sets(10)
+        flat = sorted(s for sids in sets.values() for s in sids)
+        assert flat == list(range(10))
+        assert all(sets[rid] for rid in table.live())
+
+
+# --------------------------------------------------------------------------
+# drift re-key (pure planner; digest-pinned regression)
+# --------------------------------------------------------------------------
+
+
+def _drift_inventory(drained_count: int):
+    nodes = [
+        NodeInfo(
+            name=f"n{i:02d}", cpus=16, memory_mb=32768,
+            state="DRAINED" if i < drained_count else "IDLE",
+        )
+        for i in range(16)
+    ]
+    partitions = [
+        PartitionInfo(name="batch", nodes=tuple(nd.name for nd in nodes))
+    ]
+    return partitions, nodes
+
+
+def _plan_digest(plan) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for shard in plan.shards:
+        h.update(repr((shard.sid, shard.node_idx.tolist(),
+                       shard.island_keys)).encode())
+    return h.hexdigest()[:16]
+
+
+def test_drift_rekey_quarantines_drained_nodes():
+    """>50% of one shard drained -> the drained nodes move into their own
+    ``cpu-drained`` islands; live nodes re-pack densely. Digest-pinned on
+    both sides so the re-key is a deterministic function of node state —
+    any planner change that shifts either layout must update these pins
+    consciously."""
+    partitions, nodes = _drift_inventory(drained_count=6)
+    config = ShardConfig(max_nodes_per_shard=8)
+    base = build_plan(partitions, nodes, config)
+    rekeyed = build_plan(
+        partitions, nodes, config, drained=drained_positions(nodes)
+    )
+    assert _plan_digest(base) == "03516263814ab69e"
+    assert _plan_digest(rekeyed) == "b64efdbd841269e9"
+    drained_keys = {
+        k for s in rekeyed.shards for k in s.island_keys if "drained" in k[1]
+    }
+    assert drained_keys, "no drained island was built"
+    # drained islands hold exactly the drained nodes
+    drained_nodes = {
+        pos
+        for isl in rekeyed.islands
+        if "drained" in isl.key[1]
+        for pos in isl.nodes
+    }
+    assert drained_nodes == set(drained_positions(nodes))
+
+
+def test_executor_drift_probe_rekeys_only_past_threshold():
+    from slurm_bridge_tpu.shard.executor import ShardExecutor
+
+    config = ShardConfig(max_nodes_per_shard=8, drift_rekey_fraction=0.5)
+    ex = ShardExecutor(config, backend="greedy")
+    # 2/16 drained: no shard crosses 50% -> base plan, stable key
+    partitions, nodes = _drift_inventory(drained_count=2)
+    plan_a = ex._ensure_plan(partitions, nodes)
+    assert not any(
+        "drained" in isl.key[1] for isl in plan_a.islands
+    )
+    # 6/16 drained: the first 8-node shard is 6/8 drained -> re-key
+    partitions, nodes = _drift_inventory(drained_count=6)
+    plan_b = ex._ensure_plan(partitions, nodes)
+    assert any("drained" in isl.key[1] for isl in plan_b.islands)
+    # drift off: same inventory keeps stale boundaries (digest safety)
+    ex_off = ShardExecutor(
+        ShardConfig(max_nodes_per_shard=8), backend="greedy"
+    )
+    plan_off = ex_off._ensure_plan(partitions, nodes)
+    assert not any("drained" in isl.key[1] for isl in plan_off.islands)
+
+
+# --------------------------------------------------------------------------
+# sidecar processes (spawn / crash / inline fallback / re-adopt)
+# --------------------------------------------------------------------------
+
+
+def _runtime(tmp, replicas=1, **kw):
+    clock = _Clock()
+    rt = FleetRuntime(
+        FleetConfig(replicas=replicas, **kw), tmp, clock=clock
+    )
+    rt.start()
+    return rt, clock
+
+
+def test_sidecar_remote_solve_parity_over_grpc():
+    rng = np.random.default_rng(3)
+    snap, batch = _shape(rng, 20, 24)
+    inline = greedy_place(
+        dataclasses.replace(snap, free=snap.free.copy()), batch, incumbent=None
+    )
+    with tempfile.TemporaryDirectory() as d:
+        rt, _ = _runtime(d)
+        try:
+            remote = rt.try_solve(0, "greedy", "", snap, batch, None)
+            assert remote is not None
+            np.testing.assert_array_equal(remote.node_of, inline.node_of)
+            np.testing.assert_array_equal(remote.placed, inline.placed)
+            np.testing.assert_array_equal(
+                remote.free_after, inline.free_after
+            )
+            assert rt.remote_stats()["remote_solves"] == 1
+        finally:
+            rt.close()
+
+
+def test_sidecar_death_mid_tick_degrades_to_inline():
+    """Kill the sidecar WITHOUT a heartbeat: the next try_solve hits the
+    dead socket, marks the replica down+dead (remembered fallback), and
+    returns None — the caller solves inline and the tick completes."""
+    rng = np.random.default_rng(4)
+    snap, batch = _shape(rng, 12, 10)
+    with tempfile.TemporaryDirectory() as d:
+        rt, _ = _runtime(d)
+        try:
+            sup = rt.supervisors["replica-0"]
+            os.kill(sup.proc.pid, signal.SIGKILL)
+            sup.proc.wait(timeout=10)
+            assert rt.try_solve(0, "greedy", "", snap, batch, None) is None
+            assert sup.down
+            assert rt.membership.live() == []
+            # remembered: the next call skips the RPC entirely
+            assert rt.try_solve(1, "greedy", "", snap, batch, None) is None
+            assert rt.remote_stats()["inline_fallbacks"] == 2
+        finally:
+            rt.close()
+
+
+def test_sidecar_crash_then_backoff_restart_readopts():
+    rng = np.random.default_rng(5)
+    snap, batch = _shape(rng, 12, 10)
+    with tempfile.TemporaryDirectory() as d:
+        rt, _ = _runtime(d, restart_backoff_ticks=2)
+        try:
+            rt.kill_replica("replica-0")
+            rt.heartbeat(1)
+            assert rt.membership.live() == []
+            rt.heartbeat(2)  # backoff not yet elapsed
+            assert rt.membership.live() == []
+            rt.heartbeat(3)  # 3 - 1 >= 2: restart + rejoin
+            assert rt.membership.live() == ["replica-0"]
+            assert rt.remote_stats()["sidecar_restarts"] == 1
+            assert rt.supervisors["replica-0"].incarnation == "replica-0.2"
+            remote = rt.try_solve(0, "greedy", "", snap, batch, None)
+            assert remote is not None
+            assert rt.stats()["recovery_ticks"] == 2
+        finally:
+            rt.close()
+
+
+def test_fleetz_renders_membership_and_ownership():
+    from slurm_bridge_tpu.fleet.runtime import render_fleetz
+
+    with tempfile.TemporaryDirectory() as d:
+        rt, _ = _runtime(d, replicas=2)
+        try:
+            rng = np.random.default_rng(6)
+            snap, batch = _shape(rng, 8, 6)
+            rt.try_solve(1, "greedy", "", snap, batch, None)
+            page = render_fleetz()
+            assert "replica-0" in page and "replica-1" in page
+            assert "shard ownership" in page
+            assert "remote_solves: 1" in page
+        finally:
+            rt.close()
+        assert "no fleet runtime" in render_fleetz()
+
+
+def test_healthz_reports_schema_and_incarnation():
+    from slurm_bridge_tpu.wire import workload_pb2 as pb
+    from slurm_bridge_tpu.wire.rpc import ServiceClient, dial
+
+    with tempfile.TemporaryDirectory() as d:
+        rt, _ = _runtime(d)
+        try:
+            sup = rt.supervisors["replica-0"]
+            client = ServiceClient(
+                dial(sup.endpoint), "PlacementSolver", retry=None
+            )
+            hz = client.Healthz(pb.HealthzRequest(), timeout=30)
+            assert hz.service == "solver"
+            assert hz.schema_version == schema_digest()
+            assert hz.incarnation == "replica-0.1"
+            assert hz.pid == sup.proc.pid
+            client.close()
+        finally:
+            rt.close()
+
+
+# --------------------------------------------------------------------------
+# end-to-end sim gates (slow; `make fleet-smoke` runs the same shapes)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", (58, 91))
+def test_fleet_of_one_digest_matches_single_process(seed):
+    from slurm_bridge_tpu.sim.harness import run_scenario
+    from slurm_bridge_tpu.sim.scenarios import sharded_smoke
+
+    base = sharded_smoke(scale=0.1, seed=seed)
+    single = run_scenario(base)
+    fleet = run_scenario(
+        dataclasses.replace(base, fleet=FleetConfig(replicas=1))
+    )
+    assert (
+        fleet.determinism["final_state_digest"]
+        == single.determinism["final_state_digest"]
+    )
+    assert fleet.quality["fleet_remote"]["remote_solves"] > 0
+
+
+@pytest.mark.slow
+def test_kill_shard_owner_chaos_zero_lost_binds():
+    from slurm_bridge_tpu.sim.faults import FLEET_KINDS
+    from slurm_bridge_tpu.sim.harness import run_scenario
+    from slurm_bridge_tpu.sim.scenarios import fleet_kill_owner
+
+    sc = fleet_kill_owner(scale=0.1)
+    chaos = run_scenario(sc)
+    fleet = chaos.determinism["fleet"]
+    assert fleet["kills"] == 1
+    assert fleet["live_final"] == fleet["replicas"]
+    assert fleet["recovery_ticks"] <= sc.max_recovery_ticks
+    assert chaos.determinism["vnode_deletions"] == 0
+    assert not chaos.determinism["invariant_violations"]
+    # zero lost binds: byte-identical to the same run without the kill
+    # AND without the fleet (remote parity + re-key neutrality at once)
+    twin = run_scenario(
+        dataclasses.replace(
+            sc, fleet=None, faults=sc.faults.strip(FLEET_KINDS)
+        )
+    )
+    assert (
+        chaos.determinism["final_state_digest"]
+        == twin.determinism["final_state_digest"]
+    )

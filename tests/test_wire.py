@@ -36,14 +36,15 @@ from slurm_bridge_tpu.wire.rpc import normalize_endpoint
 
 def test_contract_covers_reference_rpcs():
     """All 12 reference RPCs (workload.proto:23-62) plus JobState, the
-    PR-3 batched JobsInfo, and the PR-4 batched SubmitJobs exist."""
+    PR-3 batched JobsInfo, the PR-4 batched SubmitJobs, and the ISSUE 17
+    Healthz probe exist."""
     _, specs = service_methods("WorkloadManager")
     names = {s.name for s in specs}
     assert names == {
         "SubmitJob", "SubmitJobs", "SubmitJobContainer", "CancelJob",
         "JobInfo", "JobsInfo", "JobSteps", "JobState", "OpenFile",
         "TailFile", "Resources", "Partitions", "Partition", "Nodes",
-        "WorkloadInfo",
+        "WorkloadInfo", "Healthz",
     }
     kinds = {s.name: s.kind for s in specs}
     assert kinds["OpenFile"] == "unary_stream"  # server-stream
@@ -55,7 +56,9 @@ def test_contract_covers_reference_rpcs():
 
 def test_solver_service_exists():
     _, specs = service_methods("PlacementSolver")
-    assert {s.name for s in specs} == {"Place", "SolverInfo"}
+    assert {s.name for s in specs} == {
+        "Place", "SolverInfo", "PlaceShard", "Healthz"
+    }
 
 
 @pytest.mark.parametrize(
