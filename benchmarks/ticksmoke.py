@@ -70,6 +70,18 @@ attribute).
 
     SBT_SMOKE_COLD_BUDGET_MS       cold (first) tick ceiling  (default 8000)
     SBT_SMOKE_COLD_UNATTRIBUTED_PCT flight phase-sum gap ceiling (default 2)
+
+The process-parallel write side (ISSUE 18) adds the submit-encode
+micro-stage (``benchmarks.stages --submit``: pb2 ``fill_submit_request``
+serial oracle vs the colpool ``_OP_ENCODE_SUBMIT`` workers over 10k
+demand rows) with a byte-identical-wire digest gate that always binds,
+plus a speedup floor that binds only when the ambient env forces
+``SBT_COLPOOL_WORKERS`` ≥ 2 — this CI box is 1-core, where fork+pipe
+overhead makes the pool SLOWER inline; the win records on the overlap
+path. The cold-tick gate grows the write-side parity arm: pool forced
+on vs forced off must produce the same ``final_state_digest``.
+
+    SBT_SMOKE_SUBMIT_MIN_SPEEDUP   submit-encode pool floor   (default 1.2)
 """
 
 from __future__ import annotations
@@ -306,9 +318,16 @@ def profile_cold_tick(scale: float = 0.02) -> dict:
     overlap — the fraction of the tick span no phase claims. Pipelined
     fetches run under the NEXT group's classification, so a broken
     phase clock shows up here as unattributed wall time.
+
+    ISSUE 18 adds the write-side parity arm: the same scenario with the
+    colpool FORCED to 2 workers (submit-encode offload + sharded sweep
+    builders engaged even on a 1-core CI box) must land on the same
+    ``final_state_digest`` as the pool-disabled run — offloaded encodes
+    and builds that change bytes are a bug at any speed.
     """
     import dataclasses
 
+    from slurm_bridge_tpu.parallel import colpool
     from slurm_bridge_tpu.sim.harness import SimHarness
     from slurm_bridge_tpu.sim.scenarios import SCENARIOS
 
@@ -323,6 +342,20 @@ def profile_cold_tick(scale: float = 0.02) -> dict:
     oracle = SimHarness(
         dataclasses.replace(scn, shard_mirror=False, mirror_pipeline=False)
     ).run()
+    prior = os.environ.get("SBT_COLPOOL_WORKERS")
+    try:
+        os.environ["SBT_COLPOOL_WORKERS"] = "0"
+        colpool.reset()
+        pool_off = SimHarness(scn).run()
+        os.environ["SBT_COLPOOL_WORKERS"] = "2"
+        colpool.reset()
+        pool_on = SimHarness(scn).run()
+    finally:
+        colpool.reset()
+        if prior is None:
+            os.environ.pop("SBT_COLPOOL_WORKERS", None)
+        else:
+            os.environ["SBT_COLPOOL_WORKERS"] = prior
     return {
         "scenario": "full_500kx100k",
         "scale": scale,
@@ -336,8 +369,17 @@ def profile_cold_tick(scale: float = 0.02) -> dict:
             on.determinism["final_state_digest"]
             == oracle.determinism["final_state_digest"]
         ),
+        # ISSUE 18: pool-forced vs pool-disabled write side, same bytes
+        "write_digest_pool_on": pool_on.determinism["final_state_digest"],
+        "write_digest_pool_off": pool_off.determinism["final_state_digest"],
+        "write_digest_identical": (
+            pool_on.determinism["final_state_digest"]
+            == pool_off.determinism["final_state_digest"]
+        ),
         "violations": len(on.determinism["invariant_violations"])
-        + len(oracle.determinism["invariant_violations"]),
+        + len(oracle.determinism["invariant_violations"])
+        + len(pool_on.determinism["invariant_violations"])
+        + len(pool_off.determinism["invariant_violations"]),
     }
 
 
@@ -347,7 +389,12 @@ def main() -> int:
         # the non-gating fsync-realism record (see wal_fsync_profile)
         print(json.dumps(wal_fsync_profile()))
         return 0
-    from benchmarks.stages import profile_decode, profile_reconcile, profile_tick
+    from benchmarks.stages import (
+        profile_decode,
+        profile_reconcile,
+        profile_submit_encode,
+        profile_tick,
+    )
 
     budget_ms = float(os.environ.get("SBT_SMOKE_ENCODE_BUDGET_MS", "50"))
     min_speedup = float(os.environ.get("SBT_SMOKE_MIN_SPEEDUP", "3"))
@@ -374,9 +421,18 @@ def main() -> int:
     cold_unattr_pct = float(
         os.environ.get("SBT_SMOKE_COLD_UNATTRIBUTED_PCT", "2")
     )
+    submit_floor = float(
+        os.environ.get("SBT_SMOKE_SUBMIT_MIN_SPEEDUP", "1.2")
+    )
+    # the floor binds only when the ambient env FORCES a multi-worker
+    # pool: on this 1-core CI box the pool is legitimately slower inline
+    # (fork+pipe overhead, no second core), and the win records on the
+    # overlap path — but the wire digest must match everywhere, always
+    ambient_workers = int(os.environ.get("SBT_COLPOOL_WORKERS", "0") or "0")
     out = profile_tick(1_000, 5_000, seed=2)
     rec = profile_reconcile(500)
     dec = profile_decode(10_000)
+    sub = profile_submit_encode(10_000)
     trace = profile_trace_overhead()
     wal = profile_wal_overhead()
     explain = profile_explain_overhead()
@@ -384,6 +440,8 @@ def main() -> int:
     cold = profile_cold_tick()
     out["reconcile"] = rec
     out["decode"] = dec
+    out["submit"] = sub
+    out["submit_min_speedup"] = submit_floor
     out["cold"] = cold
     out["cold_budget_ms"] = cold_budget_ms
     out["cold_unattributed_budget_pct"] = cold_unattr_pct
@@ -426,11 +484,19 @@ def main() -> int:
     # the ISSUE 14 wire-decode gate: coldec must decode column-identical
     # to the pb2 path AND beat it by the floor multiple
     decode_ok = dec["digest_identical"] and dec["coldec_speedup"] >= decode_floor
+    # the ISSUE 18 submit-encode gate: the pooled SubmitJobsRequest bytes
+    # must be identical to pb2's everywhere; the speedup floor binds only
+    # where the env forces real parallel workers
+    submit_ok = sub["digest_identical"] and (
+        ambient_workers < 2 or sub["pool_speedup"] >= submit_floor
+    )
     # the ISSUE 16 parallel-cold-path gate: digest identity with the
     # serial oracle is structural (any speed); the budget and the
-    # phase-sum ceiling catch a cold path or phase clock regression
+    # phase-sum ceiling catch a cold path or phase clock regression.
+    # ISSUE 18 folds in the write-side parity arm (pool on ≡ pool off).
     cold_ok = (
         cold["digest_identical"]
+        and cold["write_digest_identical"]
         and cold["violations"] == 0
         and cold["cold_tick_ms"] <= cold_budget_ms
         and cold["unattributed_pct"] <= cold_unattr_pct
@@ -447,6 +513,7 @@ def main() -> int:
         and explain_ok
         and steady_ok
         and decode_ok
+        and submit_ok
         and cold_ok
     )
     out["ok"] = ok
@@ -477,8 +544,12 @@ def main() -> int:
             f"{cold['cold_tick_ms']} ms (budget {cold_budget_ms}), "
             f"unattributed {cold['unattributed_pct']}% (budget "
             f"{cold_unattr_pct}%), parallel≡serial "
-            f"{cold['digest_identical']} (must be true), violations "
-            f"{cold['violations']} (must be 0)",
+            f"{cold['digest_identical']} (must be true), write-pool≡off "
+            f"{cold['write_digest_identical']} (must be true), violations "
+            f"{cold['violations']} (must be 0) / submit-encode wire "
+            f"digest {sub['digest_identical']} (must be true), speedup "
+            f"{sub['pool_speedup']}x (floor {submit_floor}x iff "
+            f"SBT_COLPOOL_WORKERS≥2, ambient {ambient_workers})",
             file=sys.stderr,
         )
     return 0 if ok else 1

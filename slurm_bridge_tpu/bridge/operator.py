@@ -69,6 +69,7 @@ from slurm_bridge_tpu.core.types import JobDemand
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
 from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.obs.tracing import TRACER, current_span
+from slurm_bridge_tpu.parallel import colpool, writeops
 from slurm_bridge_tpu.policy.classes import (
     CLASS_LABEL as _CLASS_LABEL,
     TENANT_LABEL as _TENANT_LABEL,
@@ -86,6 +87,16 @@ _reconcile_seconds = REGISTRY.histogram(
     "sbt_operator_reconcile_seconds",
     "one single-key reconcile, or one whole dirty-set sweep pass",
 )
+_sweep_pool_rows = REGISTRY.counter(
+    "sbt_operator_sweep_pool_rows_total",
+    "sizecar creates whose demand/label resolution ran in colpool "
+    "workers (ISSUE 18 write-side offload)",
+)
+
+#: sizecar creates per _OP_BUILD_ROWS frame: big enough that the frame
+#: header/pack overhead amortizes, small enough that a 100k-create storm
+#: still fans out across every worker
+_BUILD_CHUNK = 2048
 
 #: CR state transitions worth an event (UpdateSBJStatus's recorder calls)
 _STATE_REASONS = {
@@ -464,6 +475,78 @@ class BridgeOperator:
             )
         return fd
 
+    def _start_sizecar_build(self, creates: list[tuple]):
+        """Kick ``_OP_BUILD_ROWS`` for the sweep's sizecar creates —
+        non-blocking (``colpool.start_frames``), so the header parse +
+        override resolution runs in the workers while the caller
+        finishes the locked capture. Returns the job handle, or ``None``
+        when there is nothing to build or no pool (1-core box: the
+        serial arm runs with zero overhead)."""
+        if not creates:
+            return None
+        pool = colpool.active_pool()
+        if pool is None:
+            return None
+        chunks = [
+            creates[lo : lo + _BUILD_CHUNK]
+            for lo in range(0, len(creates), _BUILD_CHUNK)
+        ]
+        return pool.start_frames(
+            colpool._OP_BUILD_ROWS, chunks, writeops.pack_build_chunk
+        )
+
+    def _built_sizecar_rows(
+        self, creates: list[tuple], frames: list[bytes]
+    ) -> tuple[list, list]:
+        """Reassemble the worker-resolved columns into the frozen
+        demands + label dicts the create scatter writes — field-for-
+        field what the serial ``demand_for_spec`` + label build
+        produces (fuzz-pinned), with the parent supplying everything
+        that never rode the wire (owner/job_name, run_as_user/group,
+        licenses, priority, the label insertion order)."""
+        sc_demand: list = []
+        sc_labels: list = []
+        i = 0
+        for lo in range(0, len(creates), _BUILD_CHUNK):
+            chunk = creates[lo : lo + _BUILD_CHUNK]
+            cols = writeops.unpack_build_result(frames[i])
+            i += 1
+            for j, (o, s, jl) in enumerate(chunk):
+                dem = frozen_new(
+                    JobDemand,
+                    partition=cols["partition"][j],
+                    script=s.sbatch_script,
+                    job_name=o,
+                    run_as_user=s.run_as_user,
+                    run_as_group=s.run_as_group,
+                    array=cols["array"][j],
+                    cpus_per_task=cols["cpus_per_task"][j],
+                    ntasks=cols["ntasks"][j],
+                    ntasks_per_node=cols["ntasks_per_node"][j],
+                    nodes=cols["nodes"][j],
+                    working_dir=cols["working_dir"][j],
+                    mem_per_cpu_mb=cols["mem_per_cpu_mb"][j],
+                    gres=cols["gres"][j],
+                    licenses=s.licenses,
+                    time_limit_s=cols["time_limit_s"][j],
+                    priority=s.priority,
+                    nodelist=(),
+                )
+                sc_demand.append(dem)
+                labels = {
+                    "role": PodRole.SIZECAR,
+                    "partition": dem.partition,
+                    "request-cpu": cols["request_cpu"][j],
+                    "request-memory-mb": cols["request_mem"][j],
+                }
+                if jl:
+                    for key in (_TENANT_LABEL, _CLASS_LABEL):
+                        val = jl.get(key)
+                        if val:
+                            labels[key] = val
+                sc_labels.append(FrozenDict(labels))
+        return sc_demand, sc_labels
+
     def _sweep_cols(self, span, names, jt, pt) -> list[str]:
         """The sweep on columns, vectorized: one locked scan classifies
         every owner with NumPy column masks (the per-owner Python loop is
@@ -537,6 +620,12 @@ class BridgeOperator:
                 sizecar_creates.append(
                     (ordered[i], spec_col[row], jc.labels[row])
                 )
+            # kick the worker-pool demand/label resolution NOW (ISSUE
+            # 18): specs are immutable snapshots, so the fan-out threads
+            # pack them safely while this thread still holds the lock —
+            # the builds overlap the whole CR/worker capture below, and
+            # the commit block collects (or falls back serially)
+            build_job = self._start_sizecar_build(sizecar_creates)
             act = (act0 & has_s) | m_create
             pod_phase = np.where(has_s, pc.phase[sr], _POD_PHASE_PENDING)
             ilen = np.where(has_s, pc.ilen[sr], 0).astype(np.int64)
@@ -707,27 +796,41 @@ class BridgeOperator:
         if sizecar_creates:
             sc_owners = [o for o, _s, _l in sizecar_creates]
             sc_names = [sizecar_name(o) for o in sc_owners]
-            sc_demand = [
-                demand_for_spec(o, s) for o, s, _l in sizecar_creates
-            ]
-            sc_labels: list[FrozenDict] = []
-            for (_o, _s, jl), dem in zip(sizecar_creates, sc_demand):
-                arr = array_len(dem.array)
-                labels = {
-                    "role": PodRole.SIZECAR,
-                    "partition": dem.partition,
-                    # resource-request labels (pod.go:164-187)
-                    "request-cpu": str(dem.total_cpus(arr)),
-                    "request-memory-mb": str(dem.total_mem_mb(arr)),
-                }
-                if jl:
-                    # policy-bearing labels ride from the CR onto the
-                    # sizecar (cf. _build_sizecar, the object oracle)
-                    for key in (_TENANT_LABEL, _CLASS_LABEL):
-                        val = jl.get(key)
-                        if val:
-                            labels[key] = val
-                sc_labels.append(FrozenDict(labels))
+            with TRACER.span("operator.sweep.build") as bspan:
+                bspan.count("pods", len(sizecar_creates))
+                built = build_job.wait() if build_job is not None else None
+                if built is not None:
+                    sc_demand, sc_labels = self._built_sizecar_rows(
+                        sizecar_creates, built
+                    )
+                    _sweep_pool_rows.inc(len(sizecar_creates))
+                else:
+                    # the serial oracle — also the fallback when the
+                    # pool is off/broken or a build chunk failed (the
+                    # real exception then surfaces here, in context)
+                    sc_demand = [
+                        demand_for_spec(o, s)
+                        for o, s, _l in sizecar_creates
+                    ]
+                    sc_labels = []
+                    for (_o, _s, jl), dem in zip(sizecar_creates, sc_demand):
+                        arr = array_len(dem.array)
+                        labels = {
+                            "role": PodRole.SIZECAR,
+                            "partition": dem.partition,
+                            # resource-request labels (pod.go:164-187)
+                            "request-cpu": str(dem.total_cpus(arr)),
+                            "request-memory-mb": str(dem.total_mem_mb(arr)),
+                        }
+                        if jl:
+                            # policy-bearing labels ride from the CR onto
+                            # the sizecar (cf. _build_sizecar, the object
+                            # oracle)
+                            for key in (_TENANT_LABEL, _CLASS_LABEL):
+                                val = jl.get(key)
+                                if val:
+                                    labels[key] = val
+                        sc_labels.append(FrozenDict(labels))
             sc_owner_arr = oarr(sc_owners)
             sc_name_arr = oarr(sc_names)
             sc_label_arr = oarr(sc_labels)

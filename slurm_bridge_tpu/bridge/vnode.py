@@ -58,7 +58,7 @@ from slurm_bridge_tpu.core.types import JobInfo, JobStatus, NodeInfo, PartitionI
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
 from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.obs.tracing import TRACER, with_current_span
-from slurm_bridge_tpu.parallel import colpool
+from slurm_bridge_tpu.parallel import colpool, writeops
 from slurm_bridge_tpu.wire import ServiceClient, pb
 from slurm_bridge_tpu.wire import coldec
 from slurm_bridge_tpu.wire.convert import (
@@ -94,6 +94,11 @@ _submit_fallbacks = REGISTRY.counter(
     "sbt_provider_submit_fallback_total",
     "provider converges that submitted through the per-pod SubmitJob path "
     "(agent lacks SubmitJobs)",
+)
+_submit_pool_chunks = REGISTRY.counter(
+    "sbt_vnode_submit_pool_chunks_total",
+    "submit chunks whose SubmitJobsRequest bytes were encoded in colpool "
+    "workers (ISSUE 18 write-side offload)",
 )
 _vector_diff_rows = REGISTRY.counter(
     "sbt_colstore_vector_diff_rows_total",
@@ -1025,7 +1030,10 @@ class VirtualNodeProvider:
                 items[lo : lo + _SUBMIT_CHUNK]
                 for lo in range(0, len(items), _SUBMIT_CHUNK)
             ]
-            self._pool_map(self._submit_chunk_cols_safe, chunks)
+            pre = self._precode_submit_chunks(chunks)
+            self._pool_map(
+                self._submit_chunk_cols_safe, list(zip(chunks, pre))
+            )
         if self.incremental:
             mc = self._build_mirror_cache(refresh)
             # the cache survives to the next tick ONLY when this sync had
@@ -1138,21 +1146,81 @@ class VirtualNodeProvider:
         if pod is not None:
             self._sync_pod_safe(pod)
 
-    def _submit_chunk_cols_safe(self, items: list[_SubmitItem]) -> None:
+    @staticmethod
+    def _submit_rows(items: list[_SubmitItem]) -> list[tuple]:
+        """The effective wire rows for a submit chunk — the converge
+        pass's filter + submitter + nodelist-hint logic as a PURE
+        function (no pod failing, no events), shared by the worker-pool
+        pre-encode and kept in lockstep with :meth:`_submit_chunk_cols`
+        by the row-count cross-check there."""
+        rows: list[tuple] = []
+        for it in items:
+            demand = it.demand
+            if demand is None or not demand.script.strip():
+                continue
+            submitter = it.uid if not it.gen else f"{it.uid}#g{it.gen}"
+            if it.hint and not demand.nodelist:
+                demand = fast_replace(demand, nodelist=it.hint)
+            rows.append((demand, submitter))
+        return rows
+
+    def _precode_submit_chunks(self, chunks: list) -> list:
+        """Pool-encoded ``SubmitJobsRequest`` bytes per chunk: a list
+        parallel to ``chunks`` of ``(row count, wire bytes)`` — or
+        ``None`` entries when the chunk must encode inline (no bytes
+        RPC, no pool, pool broken, payload failure). Runs on the
+        prepare side, so under the staged mirror the pool encode for
+        provider i+1 overlaps provider i's fetch/apply."""
+        none: list = [None] * len(chunks)
+        if self._bytes_rpc("SubmitJobs") is None:
+            return none
+        pool = colpool.active_pool()
+        if pool is None:
+            return none
+        with TRACER.span("vnode.submit_chunk.encode") as span:
+            rows_per_chunk = [self._submit_rows(c) for c in chunks]
+            frames = [
+                writeops.pack_submit_frame(rows) for rows in rows_per_chunk
+            ]
+            encoded = pool.encode_submit_many(frames)
+            span.count("chunks", len(chunks))
+            span.count("pods", sum(len(r) for r in rows_per_chunk))
+            if encoded is None:
+                return none
+            _submit_pool_chunks.inc(len(chunks))
+            return [
+                (len(rows), raw)
+                for rows, raw in zip(rows_per_chunk, encoded)
+            ]
+
+    def _submit_chunk_cols_safe(self, chunk) -> None:
+        items, pre = (
+            chunk if isinstance(chunk, tuple) else (chunk, None)
+        )
         try:
-            self._submit_chunk_cols(items)
+            self._submit_chunk_cols(items, pre)
         except Exception:
             log.exception("batch submit of %d pods failed", len(items))
 
-    def _submit_chunk_cols(self, items: list[_SubmitItem]) -> None:
+    def _submit_chunk_cols(
+        self, items: list[_SubmitItem], pre: tuple | None = None
+    ) -> None:
         """The batched submit, fed from columns: requests are written in
         place into ONE ``SubmitJobsRequest`` (no per-entry message copy),
         accepted job ids land as one row-commit — the per-item semantics
         (transient stays Pending, rejection fails the pod, UNIMPLEMENTED
-        flips the provider) are exactly the object path's."""
+        flips the provider) are exactly the object path's.
+
+        ``pre`` is the chunk's worker-pool pre-encode, ``(row count,
+        SubmitJobsRequest wire bytes)`` — byte-identical to what the
+        inline encode below would serialize (fuzz-pinned), used only
+        when its row count matches this pass's converge filter (the two
+        run the same ``_submit_rows`` logic; the cross-check turns any
+        future drift into a silent inline re-encode, never a wrong
+        submit). The converge side effects — failing script-less pods —
+        always run HERE, pooled or not."""
         with TRACER.span("vnode.submit_chunk") as span:
             span.count("pods", len(items))
-            breq = pb.SubmitJobsRequest()
             sent: list[_SubmitItem] = []
             for it in items:
                 demand = it.demand
@@ -1162,19 +1230,31 @@ class VirtualNodeProvider:
                     except NotFound:
                         pass
                     continue
-                submitter = it.uid if not it.gen else f"{it.uid}#g{it.gen}"
-                if it.hint and not demand.nodelist:
-                    demand = fast_replace(demand, nodelist=it.hint)
-                fill_submit_request(breq.requests.add(), demand, submitter)
                 sent.append(it)
             if not sent:
                 return
             bytes_fn = self._bytes_rpc("SubmitJobs")
+            raw_req: bytes | None = None
+            breq = None
+            if (
+                pre is not None
+                and bytes_fn is not None
+                and pre[0] == len(sent)
+            ):
+                raw_req = pre[1]
+            else:
+                with TRACER.span("vnode.submit_chunk.encode") as espan:
+                    espan.count("pods", len(sent))
+                    breq = pb.SubmitJobsRequest()
+                    for demand, submitter in self._submit_rows(sent):
+                        fill_submit_request(
+                            breq.requests.add(), demand, submitter
+                        )
             results_cols = None
             resp = None
             try:
                 if bytes_fn is not None:
-                    raw = bytes_fn(breq)
+                    raw = bytes_fn(raw_req if raw_req is not None else breq)
                     try:
                         results_cols = coldec.decode_submit_jobs(raw)
                     except coldec.DecodeError as e:

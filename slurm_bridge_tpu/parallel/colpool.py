@@ -29,6 +29,17 @@ serial path's per-chunk fallback contract. An infrastructure failure
 (worker death, torn pipe) permanently disables the pool for the
 process and decodes the remaining chunks inline; it can never corrupt
 a column, only cost the speedup.
+
+Write side (ISSUE 18): two more ops run the cold path's remaining
+single-thread loops in the workers — ``_OP_ENCODE_SUBMIT`` serializes
+``SubmitJobsRequest`` chunk bytes from demand columns, and
+``_OP_BUILD_ROWS`` resolves the operator sweep's sizecar demand/label
+scalars. The frames live in :mod:`~slurm_bridge_tpu.parallel.writeops`;
+a payload failure on either op (a malformed array spec, say) reports
+per-chunk like a DecodeError and sends the CALLER back to its serial
+arm — which re-raises the real exception in context — without breaking
+the pool. Infrastructure failures break the pool exactly as on the
+decode side: remembered, inline from then on.
 """
 
 from __future__ import annotations
@@ -57,9 +68,14 @@ log = logging.getLogger("sbt.colpool")
 _OP_DECODE = 0x01
 _OP_SET_PRIOR = 0x02
 _OP_DECODE_DIFF = 0x03
+_OP_ENCODE_SUBMIT = 0x04
+_OP_BUILD_ROWS = 0x05
 _ST_OK = 0x00
 _ST_DECODE_ERR = 0x01
 _ST_ERROR = 0x02
+
+#: the write-side ops: request body and reply body are writeops frames
+_WRITE_OPS = (_OP_ENCODE_SUBMIT, _OP_BUILD_ROWS)
 
 #: response-frame column order for the fixed int64 block (length = rows
 #: each); must match JobsInfoChunk's numeric slots
@@ -239,6 +255,24 @@ def _worker_main(conn) -> None:  # pragma: no cover - runs in the child
                     )
                     body += np.ascontiguousarray(mask, np.uint8).tobytes()
                 out = bytes([_ST_OK]) + body
+            elif op in _WRITE_OPS:
+                # lazy: the ops only need writeops once a write-side
+                # caller engages; a decode-only worker never imports it
+                from slurm_bridge_tpu.parallel import writeops
+
+                fn = (
+                    writeops.encode_submit_frame
+                    if op == _OP_ENCODE_SUBMIT
+                    else writeops.build_rows_frame
+                )
+                try:
+                    out = bytes([_ST_OK]) + fn(memoryview(frame)[1:])
+                except Exception as e:
+                    # payload problem (malformed array spec, bad utf8):
+                    # per-chunk like a DecodeError — the caller reruns
+                    # its serial arm, which raises the real exception in
+                    # context; the pool itself stays healthy
+                    out = bytes([_ST_DECODE_ERR]) + repr(e).encode("utf-8")
             else:
                 out = bytes([_ST_ERROR]) + f"unknown op {op}".encode()
         except coldec.DecodeError as e:
@@ -254,6 +288,80 @@ def _worker_main(conn) -> None:  # pragma: no cover - runs in the child
 class PoolBroken(RuntimeError):
     """Infrastructure failure (worker death / torn pipe) — the caller
     decodes inline; never surfaced as a DecodeError."""
+
+
+class PayloadError(RuntimeError):
+    """A write-op chunk failed INSIDE its compute (malformed array spec,
+    undecodable frame) — the pool is healthy, but the caller must rerun
+    its serial arm so the real exception surfaces in context."""
+
+
+class _WriteJob:
+    """One in-flight write-op fan-out, kicked without blocking the
+    caller: packing AND the pipe round-trips run on the fan-out threads,
+    so the kicking thread (the operator sweep's locked capture, say)
+    keeps the interpreter while the workers chew. ``wait()`` joins and
+    returns per-chunk reply bytes in request order, or ``None`` when the
+    caller must run its serial arm — pool broken (remembered, like the
+    decode side) or a per-chunk payload failure (pool stays up)."""
+
+    def __init__(self, pool: "ColPool", op: int, chunks: list, pack_fn):
+        self._pool = pool
+        n = len(chunks)
+        self._results: list = [None] * n
+        self._infra: list[BaseException] = []
+        self._payload: list[str] = []
+        width = min(pool.width, n)
+        opb = bytes([op])
+
+        def run(w: int) -> None:
+            try:
+                for i in range(w, n, width):
+                    try:
+                        frame = opb + pack_fn(chunks[i])
+                    except Exception as e:
+                        # pack blew up on chunk data: a payload problem,
+                        # not pool infrastructure — serial arm re-raises
+                        self._payload.append(repr(e))
+                        return
+                    resp = self._pool._round_trip(w, frame)
+                    st = resp[0]
+                    if st == _ST_OK:
+                        self._results[i] = resp[1:]
+                    elif st == _ST_DECODE_ERR:
+                        self._payload.append(
+                            resp[1:].decode("utf-8", "replace")
+                        )
+                        return
+                    else:
+                        raise PoolBroken(resp[1:].decode("utf-8", "replace"))
+            except (EOFError, OSError, IndexError, PoolBroken) as e:
+                self._infra.append(e)
+
+        self._threads = [
+            threading.Thread(target=run, args=(w,), daemon=True)
+            for w in range(width)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def wait(self) -> list[bytes] | None:
+        for t in self._threads:
+            t.join()
+        if self._infra:
+            log.warning(
+                "colpool broken; write ops inline from now on: %s",
+                self._infra[0],
+            )
+            self._pool._break()
+            return None
+        if self._payload:
+            log.warning(
+                "colpool write op payload failure; serial arm re-runs: %s",
+                self._payload[0],
+            )
+            return None
+        return self._results
 
 
 class ColPool:
@@ -302,6 +410,11 @@ class ColPool:
         self.close()
 
     def close(self) -> None:
+        """Reap the workers. Idempotent and deliberately LOCK-FREE: the
+        list swaps are single bytecodes under the GIL, so a second close
+        (harness teardown racing atexit, say) finds empty lists and
+        returns — and ``_break()`` may call this while ``_ensure`` still
+        holds ``_start_lock``, so taking it here would deadlock."""
         conns, self._conns = self._conns, []
         procs, self._procs = self._procs, []
         self._locks = []
@@ -373,6 +486,82 @@ class ColPool:
         if errors:
             raise PoolBroken(str(errors[0]))
         return results
+
+    def _run_frames(self, op: int, frames: list[bytes]) -> list[bytes]:
+        """Fan pre-packed write-op frames across the workers (round-robin
+        by index, like :meth:`_run_op`) and collect per-frame reply bytes
+        in request order. Raises :class:`PoolBroken` on infrastructure
+        failure, :class:`PayloadError` when any chunk's compute failed —
+        the caller's serial arm re-raises the real exception in context."""
+        results: list = [None] * len(frames)
+        width = min(self.width, len(frames))
+        infra: list[BaseException] = []
+        payload: list[str] = []
+
+        def run(w: int) -> None:
+            try:
+                for i in range(w, len(frames), width):
+                    resp = self._round_trip(w, bytes([op]) + frames[i])
+                    st = resp[0]
+                    if st == _ST_OK:
+                        results[i] = resp[1:]
+                    elif st == _ST_DECODE_ERR:
+                        payload.append(resp[1:].decode("utf-8", "replace"))
+                        return
+                    else:
+                        raise PoolBroken(resp[1:].decode("utf-8", "replace"))
+            except (EOFError, OSError, IndexError, PoolBroken) as e:
+                infra.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(w,), daemon=True)
+            for w in range(width)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if infra:
+            raise PoolBroken(str(infra[0]))
+        if payload:
+            raise PayloadError(payload[0])
+        return results
+
+    def encode_submit_many(self, frames: list[bytes]) -> list[bytes] | None:
+        """Pre-packed submit frames (:func:`writeops.pack_submit_frame`)
+        → serialized ``SubmitJobsRequest`` bytes per frame, request
+        order, or ``None`` when the caller must encode inline — pool
+        unavailable, broken (remembered), or a payload failure (the
+        serial arm surfaces the real error)."""
+        if not frames:
+            return []
+        if not self._ensure():
+            return None
+        try:
+            return self._run_frames(_OP_ENCODE_SUBMIT, frames)
+        except PoolBroken as e:
+            log.warning(
+                "colpool broken; write ops inline from now on: %s", e
+            )
+            self._break()
+            return None
+        except PayloadError as e:
+            log.warning(
+                "colpool submit-encode payload failure; "
+                "serial arm re-runs: %s", e,
+            )
+            return None
+
+    def start_frames(self, op: int, chunks: list, pack_fn) -> _WriteJob | None:
+        """Kick a write-op fan-out WITHOUT blocking: ``pack_fn(chunk)``
+        builds each request frame on the fan-out threads, so the caller
+        (holding a store lock, say) overlaps the whole pack + round-trip
+        with its own work and collects via ``handle.wait()``. Returns
+        ``None`` when the pool can't start — the caller runs its serial
+        arm at collect time, same as a ``wait() is None``."""
+        if not chunks or not self._ensure():
+            return None
+        return _WriteJob(self, op, chunks, pack_fn)
 
     def decode_jobs_info_many(self, blobs: list[bytes]) -> list:
         """Decode each blob in a worker; per-blob JobsInfoChunk or

@@ -949,6 +949,13 @@ class SimWorkloadClient:
         return data
 
     def SubmitJobsBytes(self, request, timeout=None) -> bytes:
+        if isinstance(request, (bytes, bytearray, memoryview)):
+            # the provider's worker-pool pre-encode ships raw wire bytes
+            # (ISSUE 18) — a real channel's request serializer passes
+            # them through; this in-process seam parses them back, so
+            # the submit draws are a pure function of the wire content
+            # either way
+            request = pb.SubmitJobsRequest.FromString(bytes(request))
         parts = []
         for r in request.requests:
             e = b"\x08" + uvarint(self.cluster.submit(r)) + b"\x10\x01"

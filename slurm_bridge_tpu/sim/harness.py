@@ -84,6 +84,7 @@ from slurm_bridge_tpu.obs.flight import FlightRecorder
 from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.obs.tracing import TRACER, with_current_span
 from slurm_bridge_tpu.agent.journal import AgentJournal
+from slurm_bridge_tpu.parallel import colpool
 from slurm_bridge_tpu.policy.classes import CLASS_LABEL, TENANT_LABEL
 from slurm_bridge_tpu.policy.engine import PlacementPolicy
 from slurm_bridge_tpu.policy.score import QualityTracker
@@ -1546,6 +1547,15 @@ class SimHarness:
         if self._state_dir is not None:
             shutil.rmtree(self._state_dir, ignore_errors=True)
             self._state_dir = None
+        # reap the process-wide colpool workers (ISSUE 18): run() is
+        # finally-guarded, so a scenario raising MID-TICK still joins the
+        # forked workers and closes their pipe fds instead of leaking
+        # them until atexit; the next run lazily re-forks. close() is
+        # idempotent/lock-free, so racing atexit or a nested reset is
+        # safe. (Deliberately NOT in _teardown_stack — the crash-fault
+        # path restarts the bridge stack, not the process, and keeps its
+        # warm workers.)
+        colpool.reset()
 
     # ---- the full run ----
 

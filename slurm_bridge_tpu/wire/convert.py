@@ -21,6 +21,7 @@ from slurm_bridge_tpu.core.types import (
     PartitionInfo,
 )
 from slurm_bridge_tpu.wire import workload_pb2 as pb
+from slurm_bridge_tpu.wire.coldec import uvarint as _uvarint
 
 
 def _ts(dt: datetime | None) -> int:
@@ -88,6 +89,137 @@ def fill_submit_request(
     m.licenses = demand.licenses
     m.time_limit_s = demand.time_limit_s
     m.priority = demand.priority
+
+
+#: precomputed proto3 tags for SubmitJobRequest (workload.proto:64-82):
+#: tag = (field_number << 3) | wire_type, wire type 2 for strings, 0 for
+#: the int64 varints. Fields 16+ need a 2-byte tag.
+_T_SCRIPT = b"\x0a"          # 1, string
+_T_PARTITION = b"\x12"       # 2, string
+_T_SUBMITTER = b"\x1a"       # 3, string
+_T_RUN_AS_USER = b"\x20"     # 4, int64
+_T_RUN_AS_GROUP = b"\x28"    # 5, int64
+_T_CPUS_PER_TASK = b"\x30"   # 6, int64
+_T_NTASKS = b"\x38"          # 7, int64
+_T_NTASKS_PER_NODE = b"\x40"  # 8, int64
+_T_NODES = b"\x48"           # 9, int64
+_T_MEM_PER_CPU = b"\x50"     # 10, int64
+_T_ARRAY = b"\x5a"           # 11, string
+_T_JOB_NAME = b"\x62"        # 12, string
+_T_WORKING_DIR = b"\x6a"     # 13, string
+_T_GRES = b"\x72"            # 14, string
+_T_LICENSES = b"\x7a"        # 15, string
+_T_TIME_LIMIT = b"\x80\x01"  # 16, int64
+_T_PRIORITY = b"\x88\x01"    # 17, int64
+_T_NODELIST = b"\x92\x01"    # 18, repeated string
+#: SubmitJobsRequest.requests (workload.proto), field 1 length-delimited
+_T_REQUESTS = b"\x0a"
+
+
+def encode_submit_entry(
+    script: str,
+    partition: str,
+    submitter_id: str,
+    run_as_user: int,
+    run_as_group: int,
+    cpus_per_task: int,
+    ntasks: int,
+    ntasks_per_node: int,
+    nodes: int,
+    mem_per_cpu_mb: int,
+    array: str,
+    job_name: str,
+    working_dir: str,
+    gres: str,
+    licenses: str,
+    time_limit_s: int,
+    priority: int,
+    nodelist,
+) -> bytes:
+    """One serialized ``SubmitJobRequest`` message body built by hand —
+    byte-identical to pb2 ``SerializeToString`` (held by the fuzz suite
+    in tests/test_colpool_write.py): known fields emit in field-number
+    order, proto3 default scalars (0 / "") are omitted, repeated string
+    entries always emit (an explicit empty hostname still rides the
+    wire). This is the column-driven twin of :func:`fill_submit_request`
+    that the colpool write op runs in worker processes — no pb2 message
+    graph is ever built off the main interpreter."""
+    parts = []
+    if script:
+        b = script.encode("utf-8")
+        parts += (_T_SCRIPT, _uvarint(len(b)), b)
+    if partition:
+        b = partition.encode("utf-8")
+        parts += (_T_PARTITION, _uvarint(len(b)), b)
+    if submitter_id:
+        b = submitter_id.encode("utf-8")
+        parts += (_T_SUBMITTER, _uvarint(len(b)), b)
+    if run_as_user:
+        parts += (_T_RUN_AS_USER, _uvarint(run_as_user))
+    if run_as_group:
+        parts += (_T_RUN_AS_GROUP, _uvarint(run_as_group))
+    if cpus_per_task:
+        parts += (_T_CPUS_PER_TASK, _uvarint(cpus_per_task))
+    if ntasks:
+        parts += (_T_NTASKS, _uvarint(ntasks))
+    if ntasks_per_node:
+        parts += (_T_NTASKS_PER_NODE, _uvarint(ntasks_per_node))
+    if nodes:
+        parts += (_T_NODES, _uvarint(nodes))
+    if mem_per_cpu_mb:
+        parts += (_T_MEM_PER_CPU, _uvarint(mem_per_cpu_mb))
+    if array:
+        b = array.encode("utf-8")
+        parts += (_T_ARRAY, _uvarint(len(b)), b)
+    if job_name:
+        b = job_name.encode("utf-8")
+        parts += (_T_JOB_NAME, _uvarint(len(b)), b)
+    if working_dir:
+        b = working_dir.encode("utf-8")
+        parts += (_T_WORKING_DIR, _uvarint(len(b)), b)
+    if gres:
+        b = gres.encode("utf-8")
+        parts += (_T_GRES, _uvarint(len(b)), b)
+    if licenses:
+        b = licenses.encode("utf-8")
+        parts += (_T_LICENSES, _uvarint(len(b)), b)
+    if time_limit_s:
+        parts += (_T_TIME_LIMIT, _uvarint(time_limit_s))
+    if priority:
+        parts += (_T_PRIORITY, _uvarint(priority))
+    for host in nodelist:
+        b = host.encode("utf-8")
+        parts += (_T_NODELIST, _uvarint(len(b)), b)
+    return b"".join(parts)
+
+
+def encode_submit_request(demand: JobDemand, submitter_id: str = "") -> bytes:
+    """One demand as a serialized ``SubmitJobsRequest`` *entry* — the
+    field-18-last wire bytes pb2 produces for ``requests.add()`` +
+    :func:`fill_submit_request`, wrapped with the repeated-field tag.
+    Concatenating these per-demand entries IS the serialized
+    ``SubmitJobsRequest``."""
+    body = encode_submit_entry(
+        demand.script,
+        demand.partition,
+        submitter_id,
+        demand.run_as_user or 0,
+        demand.run_as_group or 0,
+        demand.cpus_per_task,
+        demand.ntasks,
+        demand.ntasks_per_node,
+        demand.nodes,
+        demand.mem_per_cpu_mb,
+        demand.array,
+        demand.job_name,
+        demand.working_dir,
+        demand.gres,
+        demand.licenses,
+        demand.time_limit_s,
+        demand.priority,
+        demand.nodelist,
+    )
+    return _T_REQUESTS + _uvarint(len(body)) + body
 
 
 def submit_to_demand(req: pb.SubmitJobRequest) -> JobDemand:

@@ -409,9 +409,15 @@ class ServiceClient:
                 call = _retrying_call(spec.name, call, retry)
             setattr(self, spec.name, call)
             if coldec and unary and spec.name in BYTES_METHODS:
+                # request side is a passthrough too (ISSUE 18): the
+                # provider's worker-pool pre-encode hands the twin raw
+                # SubmitJobsRequest bytes; a pb2 message still
+                # serializes exactly as before
                 raw_mc = factory(
                     f"/{full_name}/{spec.name}",
-                    request_serializer=spec.req_cls.SerializeToString,
+                    request_serializer=_bytes_passthrough(
+                        spec.req_cls.SerializeToString
+                    ),
                     response_deserializer=_identity_bytes,
                 )
                 raw_call = _traced_call(spec.name, raw_mc, unary=True)
@@ -454,10 +460,11 @@ def generic_handler(servicer, service_name: str) -> grpc.GenericRpcHandler:
 
 
 def _bytes_passthrough(serialize):
-    """Response serializer accepting EITHER a message or pre-serialized
-    wire bytes — the server half of the ISSUE 14 bytes fast path (a
-    servicer may hand back an already-assembled buffer; the wire is
-    identical either way)."""
+    """Serializer accepting EITHER a message or pre-serialized wire
+    bytes. Used on both halves of the bytes fast path: a servicer may
+    hand back an already-assembled response buffer (ISSUE 14), and a
+    Bytes-twin caller may hand in a pre-encoded request (the ISSUE 18
+    worker-pool submit encode) — the wire is identical either way."""
 
     def ser(resp):
         return resp if isinstance(resp, bytes) else serialize(resp)

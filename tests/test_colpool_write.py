@@ -1,0 +1,403 @@
+"""Write-side colpool ops: equivalence, parity, and failure posture (ISSUE 18).
+
+The write side has the same digest-critical claim as the decode side —
+the pool is INVISIBLE — plus a stricter wire contract: the bytes the
+agent sees must be identical to pb2's, not merely decode-equal. Held
+here at small shape:
+
+1. ``_OP_ENCODE_SUBMIT`` ≡ pb2: ``encode_submit_frame`` over a packed
+   submit frame emits byte-for-byte the ``SubmitJobsRequest`` that
+   ``requests.add()`` + ``fill_submit_request`` + ``SerializeToString``
+   would, over randomized demands (gang submitters, #SBATCH header
+   scripts, unicode, None uids, negative priorities, nodelist hints) —
+   both inline and through a real 2-wide worker pool;
+2. ``_OP_BUILD_ROWS`` ≡ ``demand_for_spec``: the worker's resolved
+   demand scalars and request-cpu / request-memory-mb label strings
+   match the serial sweep's field for field;
+3. scenario parity: ``sharded_smoke`` with the pool FORCED to 2 workers
+   lands on the same ``final_state_digest`` as pool-disabled, the two
+   offload counters prove the work actually left the main thread, and a
+   pool whose workers were killed mid-flight falls back inline (broken
+   state remembered) with the run completing on the same digest;
+4. failure posture: a payload failure (garbage frame, malformed array
+   spec) returns ``None`` WITHOUT breaking the pool; ``close()`` is
+   idempotent; harness teardown reaps the workers even when the
+   scenario raises mid-tick;
+5. the flight record stays reconciled: with the pool forced on, the
+   phase-sum still covers the tick span within the ticksmoke budget —
+   the new child spans are attribution detail, not a phase hole.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import slurm_bridge_tpu.bridge.operator as operator_mod
+import slurm_bridge_tpu.bridge.vnode as vnode_mod
+from slurm_bridge_tpu.bridge.objects import BridgeJobSpec
+from slurm_bridge_tpu.bridge.operator import demand_for_spec
+from slurm_bridge_tpu.core.arrays import array_len
+from slurm_bridge_tpu.core.types import JobDemand
+from slurm_bridge_tpu.parallel import colpool, writeops
+from slurm_bridge_tpu.sim.harness import SimHarness, run_scenario
+from slurm_bridge_tpu.sim.scenarios import SCENARIOS, sharded_smoke
+from slurm_bridge_tpu.wire import pb
+from slurm_bridge_tpu.wire.convert import fill_submit_request
+
+# --------------------------------------------------------- helpers
+
+
+@pytest.fixture()
+def pool(monkeypatch):
+    """A real 2-wide worker pool, torn down (and the process-wide
+    singleton reset) after the test."""
+    monkeypatch.setenv("SBT_COLPOOL_WORKERS", "2")
+    colpool.reset()
+    p = colpool.active_pool()
+    assert p is not None and p.width == 2
+    yield p
+    colpool.reset()
+
+
+_SCRIPTS = (
+    "",
+    "#!/bin/sh\ntrue\n",
+    "#!/bin/bash\n#SBATCH --partition=batch\n#SBATCH --mem-per-cpu=2048\n"
+    "#SBATCH --cpus-per-task=4\nsrun step\n",
+    "#!/bin/bash\n#SBATCH --array=0-7\n#SBATCH --time=01:00:00\n"
+    "#SBATCH --nodes=2\nrun\n",
+    "#!/bin/bash\n#SBATCH --gres=gpu:2\n#SBATCH --chdir=/scratch\nwork\n",
+)
+
+
+def _random_demands(seed: int, n: int) -> list[tuple[JobDemand, str]]:
+    """(demand, submitter) rows covering the emitter's edge cases:
+    defaulted scalars (proto3 omits them), unicode strings, None/0 uids,
+    negative priority (10-byte varint), nodelist hints, gang submitter
+    suffixes, and header-bearing scripts."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        r = int(rng.integers(0, 8))
+        rows.append((
+            JobDemand(
+                partition=("debug", "batch", "", "gpu-α")[i % 4],
+                script=_SCRIPTS[i % len(_SCRIPTS)],
+                job_name=f"job-é{i}" if r == 0 else f"job-{i}",
+                run_as_user=None if r == 1 else int(rng.integers(0, 2**40)),
+                run_as_group=0 if r == 2 else int(rng.integers(0, 2**31)),
+                array=("", "0-15", "1,3,7", "0-99%4")[i % 4],
+                cpus_per_task=int(rng.integers(0, 9)),
+                ntasks=int(rng.integers(0, 5)),
+                ntasks_per_node=i % 3,
+                nodes=int(rng.integers(0, 4)),
+                working_dir="/scratch/ü" if r == 3 else "",
+                mem_per_cpu_mb=int(rng.integers(0, 4097)),
+                gres="gpu:4" if r == 4 else "",
+                licenses="matlab:1,stata:2" if r == 5 else "",
+                time_limit_s=int(rng.integers(0, 86_401)),
+                priority=-2 if r == 6 else int(rng.integers(0, 100)),
+                nodelist=tuple(
+                    f"node-{(i + k) % 97:03d}" for k in range(i % 3)
+                ),
+            ),
+            "" if r == 7 else (f"uid-{i}#g{i % 3}" if i % 5 == 0 else f"uid-{i}"),
+        ))
+    return rows
+
+
+def _pb2_chunk_bytes(rows: list[tuple[JobDemand, str]]) -> bytes:
+    breq = pb.SubmitJobsRequest()
+    for demand, submitter in rows:
+        fill_submit_request(breq.requests.add(), demand, submitter)
+    return breq.SerializeToString()
+
+
+def _random_specs(seed: int, n: int) -> list[tuple[str, BridgeJobSpec, dict]]:
+    """(owner, spec, job labels) triples — the sweep's captured create
+    rows — mixing explicit spec overrides with header-only scripts so
+    every branch of the ``or`` override chain runs both ways."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        r = int(rng.integers(0, 6))
+        spec = BridgeJobSpec(
+            partition="" if r == 0 else f"part{i % 4}",
+            sbatch_script=_SCRIPTS[1 + i % (len(_SCRIPTS) - 1)],
+            run_as_user=None if r == 1 else 1000 + i,
+            run_as_group=100 + (i % 7),
+            array="" if r == 2 else ("0-3", "5", "1,9")[i % 3],
+            cpus_per_task=int(rng.integers(0, 5)),
+            ntasks=int(rng.integers(0, 3)),
+            ntasks_per_node=i % 2,
+            nodes=int(rng.integers(0, 3)),
+            working_dir="" if r == 3 else "/work",
+            mem_per_cpu_mb=int(rng.integers(0, 2049)),
+            gres="" if r == 4 else "gpu:1",
+            licenses="lic:1" if r == 5 else "",
+            priority=int(rng.integers(0, 10)),
+        )
+        labels = {"team": f"t{i % 3}"} if i % 2 else {}
+        out.append((f"owner-{i:04d}", spec, labels))
+    return out
+
+
+# ------------------------------ _OP_ENCODE_SUBMIT ≡ pb2 (fuzz, wire bytes)
+
+
+class TestSubmitEncodeEquivalence:
+    def test_fuzz_inline_frame_encode_matches_pb2(self):
+        """encode_submit_frame over a packed frame ≡ pb2 serialization,
+        200 randomized demands across 4 seeds — no pool involved, this
+        pins the frame pack/unpack + hand emitter themselves."""
+        for seed in (1, 2, 3, 4):
+            rows = _random_demands(seed, 50)
+            frame = writeops.pack_submit_frame(rows)
+            assert writeops.encode_submit_frame(memoryview(frame)) == (
+                _pb2_chunk_bytes(rows)
+            )
+
+    def test_fuzz_pool_encode_matches_pb2(self, pool):
+        """The same equivalence through real forked workers, multiple
+        chunks in one fan-out, results in request order."""
+        chunks = [_random_demands(10 + i, 30 + i) for i in range(5)]
+        frames = [writeops.pack_submit_frame(c) for c in chunks]
+        got = pool.encode_submit_many(frames)
+        assert got is not None and len(got) == len(chunks)
+        for raw, rows in zip(got, chunks):
+            assert bytes(raw) == _pb2_chunk_bytes(rows)
+
+    def test_empty_chunk_is_empty_request(self, pool):
+        frame = writeops.pack_submit_frame([])
+        assert writeops.encode_submit_frame(memoryview(frame)) == b""
+        assert pool.encode_submit_many([frame]) == [b""]
+
+    def test_pb2_reparse_roundtrip(self):
+        """The emitted bytes reparse into the same message pb2 built —
+        semantic equality on top of the byte equality above."""
+        rows = _random_demands(9, 40)
+        frame = writeops.pack_submit_frame(rows)
+        raw = writeops.encode_submit_frame(memoryview(frame))
+        want = pb.SubmitJobsRequest.FromString(_pb2_chunk_bytes(rows))
+        assert pb.SubmitJobsRequest.FromString(raw) == want
+
+
+# ------------------------------ _OP_BUILD_ROWS ≡ demand_for_spec (fuzz)
+
+
+class TestBuildRowsEquivalence:
+    def _assert_cols_match(self, creates, cols):
+        assert len(cols["partition"]) == len(creates)
+        for j, (owner, spec, _jl) in enumerate(creates):
+            want = demand_for_spec(owner, spec)
+            for name in ("partition", "array", "working_dir", "gres"):
+                assert cols[name][j] == getattr(want, name), (owner, name)
+            for name in (
+                "cpus_per_task", "ntasks", "ntasks_per_node", "nodes",
+                "mem_per_cpu_mb", "time_limit_s",
+            ):
+                assert cols[name][j] == getattr(want, name), (owner, name)
+            arr = array_len(want.array)
+            assert cols["request_cpu"][j] == str(want.total_cpus(arr))
+            assert cols["request_mem"][j] == str(want.total_mem_mb(arr))
+
+    def test_fuzz_inline_build_matches_serial(self):
+        for seed in (21, 22, 23):
+            creates = _random_specs(seed, 40)
+            frame = writeops.pack_build_chunk(creates)
+            cols = writeops.unpack_build_result(
+                writeops.build_rows_frame(memoryview(frame))
+            )
+            self._assert_cols_match(creates, cols)
+
+    def test_fuzz_pool_build_matches_serial(self, pool):
+        chunks = [_random_specs(30 + i, 25) for i in range(4)]
+        job = pool.start_frames(
+            colpool._OP_BUILD_ROWS, chunks, writeops.pack_build_chunk
+        )
+        assert job is not None
+        frames = job.wait()
+        assert frames is not None and len(frames) == len(chunks)
+        for creates, raw in zip(chunks, frames):
+            self._assert_cols_match(creates, writeops.unpack_build_result(raw))
+
+
+# ------------------------------------------- failure posture (per-op)
+
+
+class TestWriteFailurePosture:
+    def test_garbage_frame_is_payload_failure_not_breakage(self, pool):
+        """An undecodable frame → ``None`` (serial arm re-runs) with the
+        pool still healthy: the NEXT op on the same pool succeeds."""
+        assert pool.encode_submit_many([b"\x00garbage"]) is None
+        assert not pool._broken
+        rows = _random_demands(41, 10)
+        got = pool.encode_submit_many([writeops.pack_submit_frame(rows)])
+        assert got is not None and bytes(got[0]) == _pb2_chunk_bytes(rows)
+
+    def test_malformed_array_spec_is_payload_failure(self, pool):
+        """A bad ``--array`` value blows up INSIDE the worker's resolve —
+        per-chunk payload failure, pool stays up, and the serial arm
+        raises the same error class in context."""
+        bad = [("owner-x", BridgeJobSpec(
+            sbatch_script="#!/bin/sh\ntrue\n", array="garbage!!",
+        ), {})]
+        job = pool.start_frames(
+            colpool._OP_BUILD_ROWS, [bad], writeops.pack_build_chunk
+        )
+        assert job is not None and job.wait() is None
+        assert not pool._broken
+        # the serial arm hits the same error where the label math runs
+        dem = demand_for_spec("owner-x", bad[0][1])
+        with pytest.raises(ValueError):
+            array_len(dem.array)
+
+    def test_killed_workers_break_pool_and_return_none(self, pool):
+        """Infrastructure death mid-encode → ``None`` AND the broken
+        state is remembered: every later call short-circuits inline."""
+        assert pool._ensure()
+        for proc in pool._procs:
+            proc.terminate()
+        for proc in pool._procs:
+            proc.join(timeout=5.0)
+        frames = [writeops.pack_submit_frame(_random_demands(51, 5))]
+        assert pool.encode_submit_many(frames) is None
+        assert pool._broken
+        assert pool.encode_submit_many(frames) is None
+        assert pool.start_frames(
+            colpool._OP_BUILD_ROWS, [[]], writeops.pack_build_chunk
+        ) is None
+
+    def test_close_is_idempotent(self, pool):
+        assert pool._ensure()
+        pool.close()
+        pool.close()  # second close finds empty lists, returns
+        assert pool._conns == [] and pool._procs == []
+
+
+# ----------------- scenario parity: pool forced on ≡ pool disabled
+
+
+class TestWriteSideDigestParity:
+    """``sharded_smoke`` run three ways — pool disabled (the serial
+    oracle), pool forced to 2 workers, and pool forced to 2 workers with
+    the workers killed before the run (the broken-pool inline fallback)
+    — must land on the SAME final state; the forced run must prove via
+    the offload counters that submit encodes and sweep builds actually
+    ran in the workers."""
+
+    @pytest.fixture(scope="class")
+    def runs(self):
+        import os
+
+        scn = sharded_smoke(scale=0.25)
+        prior = os.environ.get("SBT_COLPOOL_WORKERS")
+        try:
+            os.environ["SBT_COLPOOL_WORKERS"] = "0"
+            colpool.reset()
+            serial = run_scenario(scn)
+            os.environ["SBT_COLPOOL_WORKERS"] = "2"
+            colpool.reset()
+            sub0 = vnode_mod._submit_pool_chunks.total()
+            row0 = operator_mod._sweep_pool_rows.total()
+            pooled = run_scenario(scn)
+            sub_delta = vnode_mod._submit_pool_chunks.total() - sub0
+            row_delta = operator_mod._sweep_pool_rows.total() - row0
+            colpool.reset()
+            p = colpool.active_pool()
+            assert p is not None and p._ensure()
+            for proc in p._procs:
+                proc.terminate()
+            for proc in p._procs:
+                proc.join(timeout=5.0)
+            broken = run_scenario(scn)
+        finally:
+            colpool.reset()
+            if prior is None:
+                os.environ.pop("SBT_COLPOOL_WORKERS", None)
+            else:
+                os.environ["SBT_COLPOOL_WORKERS"] = prior
+        return serial, pooled, broken, sub_delta, row_delta
+
+    def test_pool_is_digest_neutral(self, runs):
+        serial, pooled, broken, _, _ = runs
+        assert (
+            pooled.determinism["final_state_digest"]
+            == serial.determinism["final_state_digest"]
+        )
+        assert (
+            broken.determinism["final_state_digest"]
+            == serial.determinism["final_state_digest"]
+        )
+
+    def test_full_determinism_digest_matches_too(self, runs):
+        serial, pooled, broken, _, _ = runs
+        assert (
+            pooled.determinism["digest"]
+            == broken.determinism["digest"]
+            == serial.determinism["digest"]
+        )
+
+    def test_offloaded_work_left_the_main_thread(self, runs):
+        """The acceptance assertion: submit-encode chunks AND sweep
+        build rows ran in the workers during the forced run — the
+        counters only increment on the pool-result path."""
+        _, _, _, sub_delta, row_delta = runs
+        assert sub_delta > 0
+        assert row_delta > 0
+
+    def test_no_violations_any_arm(self, runs):
+        for r in runs[:3]:
+            assert r.determinism["invariant_violations"] == []
+
+
+# ----------------------------- teardown reap + flight reconciliation
+
+
+class TestHarnessTeardown:
+    def test_raising_scenario_still_reaps_workers(self, monkeypatch):
+        """A scenario that dies mid-tick must not leak forked workers:
+        ``run()``'s finally-guarded cleanup resets the process pool even
+        on the exception path."""
+        monkeypatch.setenv("SBT_COLPOOL_WORKERS", "2")
+        colpool.reset()
+        p = colpool.active_pool()
+        assert p is not None and p._ensure()
+        procs = list(p._procs)
+        assert procs and all(pr.is_alive() for pr in procs)
+        h = SimHarness(sharded_smoke(scale=0.1))
+        monkeypatch.setattr(
+            h, "run_tick",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("mid-tick")),
+        )
+        with pytest.raises(RuntimeError, match="mid-tick"):
+            h.run()
+        assert colpool._pool is None
+        for pr in procs:
+            pr.join(timeout=5.0)
+        assert all(not pr.is_alive() for pr in procs)
+        colpool.reset()
+
+
+class TestFlightReconciliation:
+    def test_phase_sum_holds_with_pool_forced_on(self, monkeypatch):
+        """The offloaded encode/build runs inside existing phase spans
+        (``sim.mirror`` / ``sim.arrive`` wall time), so the flight
+        record's phase-sum must still cover the tick span within the
+        ticksmoke reconciliation budget — the new child spans are
+        attribution detail, not a phase hole."""
+        monkeypatch.setenv("SBT_COLPOOL_WORKERS", "2")
+        colpool.reset()
+        try:
+            scn = SCENARIOS["full_500kx100k"](scale=0.02)
+            result = run_scenario(dataclasses.replace(scn, tracing=True))
+        finally:
+            colpool.reset()
+        fr = result.flight_record
+        span = fr.get("tick_span_p50_ms") or 0.0
+        psum = fr.get("phase_sum_p50_ms") or 0.0
+        assert span > 0 and psum > 0
+        assert abs(span - psum) / span * 100.0 <= 5.0
