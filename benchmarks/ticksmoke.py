@@ -82,6 +82,19 @@ path. The cold-tick gate grows the write-side parity arm: pool forced
 on vs forced off must produce the same ``final_state_digest``.
 
     SBT_SMOKE_SUBMIT_MIN_SPEEDUP   submit-encode pool floor   (default 1.2)
+
+The partitioned store commit (ISSUE 19) adds the commit micro-stage
+(``benchmarks.stages --commit``: serial decode + ONE ``update_rows``
+column scatter vs the ``_OP_DIFF_FRAMES`` workers building per-chunk
+commit frames merged through ``store.apply_frames``) with a final-state
+digest gate that always binds, plus a ≥1.2× speedup floor that — like
+the submit-encode floor — binds only when the ambient env forces
+``SBT_COLPOOL_WORKERS`` ≥ 2. The cold-tick gate grows the frames parity
+arm: the same forced-2 scenario with ``mirror_frames=False`` (the PR-18
+serial commit, byte-for-byte) must land on the same
+``final_state_digest`` as the frames-on run.
+
+    SBT_SMOKE_COMMIT_MIN_SPEEDUP   commit frame-merge floor   (default 1.2)
 """
 
 from __future__ import annotations
@@ -350,6 +363,27 @@ def profile_cold_tick(scale: float = 0.02) -> dict:
         os.environ["SBT_COLPOOL_WORKERS"] = "2"
         colpool.reset()
         pool_on = SimHarness(scn).run()
+        # ISSUE 19: frames parity arms, pool still forced to 2. The
+        # scaled-down shape fits every provider's id list in ONE
+        # JobsInfo chunk, and a single-chunk fetch never engages the
+        # pool — shrink the chunk so the frames path genuinely runs,
+        # and prove it via the store's frames-applied counter.
+        # mirror_frames=False under the same chunking is the PR-18
+        # serial column scatter byte-for-byte.
+        import slurm_bridge_tpu.bridge.store as store_mod
+        import slurm_bridge_tpu.bridge.vnode as vnode_mod
+
+        prev_chunk = vnode_mod._BULK_CHUNK
+        vnode_mod._BULK_CHUNK = 256
+        try:
+            f0 = store_mod._frames_applied.total()
+            frames_on = SimHarness(scn).run()
+            frames_rows = store_mod._frames_applied.total() - f0
+            frames_off = SimHarness(
+                dataclasses.replace(scn, mirror_frames=False)
+            ).run()
+        finally:
+            vnode_mod._BULK_CHUNK = prev_chunk
     finally:
         colpool.reset()
         if prior is None:
@@ -376,10 +410,23 @@ def profile_cold_tick(scale: float = 0.02) -> dict:
             pool_on.determinism["final_state_digest"]
             == pool_off.determinism["final_state_digest"]
         ),
+        # ISSUE 19: frames-on (pool forced) vs frames-off, same bytes —
+        # and the frame path must have actually run (rows > 0)
+        "frames_digest_on": frames_on.determinism["final_state_digest"],
+        "frames_digest_off": frames_off.determinism["final_state_digest"],
+        "frames_rows": frames_rows,
+        "frames_digest_identical": (
+            frames_rows > 0
+            and frames_on.determinism["final_state_digest"]
+            == frames_off.determinism["final_state_digest"]
+            == on.determinism["final_state_digest"]
+        ),
         "violations": len(on.determinism["invariant_violations"])
         + len(oracle.determinism["invariant_violations"])
         + len(pool_on.determinism["invariant_violations"])
-        + len(pool_off.determinism["invariant_violations"]),
+        + len(pool_off.determinism["invariant_violations"])
+        + len(frames_on.determinism["invariant_violations"])
+        + len(frames_off.determinism["invariant_violations"]),
     }
 
 
@@ -390,6 +437,7 @@ def main() -> int:
         print(json.dumps(wal_fsync_profile()))
         return 0
     from benchmarks.stages import (
+        profile_commit,
         profile_decode,
         profile_reconcile,
         profile_submit_encode,
@@ -424,6 +472,9 @@ def main() -> int:
     submit_floor = float(
         os.environ.get("SBT_SMOKE_SUBMIT_MIN_SPEEDUP", "1.2")
     )
+    commit_floor = float(
+        os.environ.get("SBT_SMOKE_COMMIT_MIN_SPEEDUP", "1.2")
+    )
     # the floor binds only when the ambient env FORCES a multi-worker
     # pool: on this 1-core CI box the pool is legitimately slower inline
     # (fork+pipe overhead, no second core), and the win records on the
@@ -433,6 +484,7 @@ def main() -> int:
     rec = profile_reconcile(500)
     dec = profile_decode(10_000)
     sub = profile_submit_encode(10_000)
+    com = profile_commit(10_000)
     trace = profile_trace_overhead()
     wal = profile_wal_overhead()
     explain = profile_explain_overhead()
@@ -442,6 +494,8 @@ def main() -> int:
     out["decode"] = dec
     out["submit"] = sub
     out["submit_min_speedup"] = submit_floor
+    out["commit"] = com
+    out["commit_min_speedup"] = commit_floor
     out["cold"] = cold
     out["cold_budget_ms"] = cold_budget_ms
     out["cold_unattributed_budget_pct"] = cold_unattr_pct
@@ -490,6 +544,12 @@ def main() -> int:
     submit_ok = sub["digest_identical"] and (
         ambient_workers < 2 or sub["pool_speedup"] >= submit_floor
     )
+    # the ISSUE 19 partitioned-commit gate: the frame merge must land
+    # value-identical final columns everywhere, always; the speedup floor
+    # binds only where the env forces real parallel workers
+    commit_ok = com["digest_identical"] and (
+        ambient_workers < 2 or com["frame_speedup"] >= commit_floor
+    )
     # the ISSUE 16 parallel-cold-path gate: digest identity with the
     # serial oracle is structural (any speed); the budget and the
     # phase-sum ceiling catch a cold path or phase clock regression.
@@ -497,6 +557,7 @@ def main() -> int:
     cold_ok = (
         cold["digest_identical"]
         and cold["write_digest_identical"]
+        and cold["frames_digest_identical"]
         and cold["violations"] == 0
         and cold["cold_tick_ms"] <= cold_budget_ms
         and cold["unattributed_pct"] <= cold_unattr_pct
@@ -514,6 +575,7 @@ def main() -> int:
         and steady_ok
         and decode_ok
         and submit_ok
+        and commit_ok
         and cold_ok
     )
     out["ok"] = ok
@@ -549,7 +611,11 @@ def main() -> int:
             f"{cold['violations']} (must be 0) / submit-encode wire "
             f"digest {sub['digest_identical']} (must be true), speedup "
             f"{sub['pool_speedup']}x (floor {submit_floor}x iff "
-            f"SBT_COLPOOL_WORKERS≥2, ambient {ambient_workers})",
+            f"SBT_COLPOOL_WORKERS≥2, ambient {ambient_workers}) / "
+            f"commit frame-merge digest {com['digest_identical']} (must "
+            f"be true), speedup {com['frame_speedup']}x (floor "
+            f"{commit_floor}x iff SBT_COLPOOL_WORKERS≥2), frames-on≡off "
+            f"{cold['frames_digest_identical']} (must be true)",
             file=sys.stderr,
         )
     return 0 if ok else 1

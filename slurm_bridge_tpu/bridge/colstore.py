@@ -34,6 +34,7 @@ notifies watchers, and attributes commits.
 
 from __future__ import annotations
 
+import struct
 import threading
 import weakref
 
@@ -41,9 +42,12 @@ import numpy as np
 
 __all__ = [
     "ColumnBlock",
+    "CommitFrame",
+    "FrameError",
     "SegmentHeap",
     "KindTable",
     "ROWS_GAUGE",
+    "build_commit_frame",
     "object_array",
     "object_full",
 ]
@@ -161,6 +165,138 @@ class SegmentHeap(ColumnBlock):
         self.cap = max(total, 256)
         self.n = total
         self.dead = 0
+        return out
+
+
+# ---- commit frames (ISSUE 19) -----------------------------------------
+#
+# The partitioned-commit wire format: a pool worker that decoded+diffed a
+# mirror chunk packages the tier-2 string columns for ITS changed rows as
+# one raw frame — local row indices plus per-column (lens, utf8 payload)
+# pairs sliced straight from the wire blob's lazy spans, the same framing
+# discipline as parallel/writeops.py. No decode happens in the worker and
+# no object crosses the pipe; the parent gathers strings lazily per
+# committed row. The store-side merge (ObjectStore.apply_frames) scatters
+# each partition's frame under ONE short lock in deterministic order, so
+# rv assignment, events and dirty-set fan-out stay main-thread and the
+# digests stay byte-identical to the serial column-scatter arm.
+
+#: tier-2 string columns a commit frame carries, in frame order — must
+#: stay in lockstep with ColdecScratch._OBJ_COLS (bridge/columns.py)
+FRAME_COLS = (
+    "user_id", "name", "workdir", "stdout", "stderr",
+    "partition", "nodelist", "batch_host", "array_id",
+)
+
+_FRAME_VERSION = 1
+#: header: version, covered-row count
+_FRAME_HDR = struct.Struct("<qq")
+
+
+class FrameError(ValueError):
+    """A commit frame is malformed (truncated, wrong version, stale or
+    uncovered row indices, undecodable payload). The caller falls back to
+    the serial span-materialization arm for the affected rows — the pool
+    stays healthy; this is a payload problem, never infrastructure."""
+
+
+def build_commit_frame(chunk, rows_local) -> bytes:
+    """Pack the commit frame for one decoded chunk's changed rows
+    (chunk-local indices, ascending). Runs in the pool worker: the string
+    payloads are raw utf8 slices lifted from the chunk's lazy spans —
+    nothing is decoded here, so a worker can never observe (or mask) a
+    bad-utf8 row the serial arm would have surfaced."""
+    rows = np.ascontiguousarray(np.asarray(rows_local, np.int64))
+    parts = [_FRAME_HDR.pack(_FRAME_VERSION, rows.size), rows.tobytes()]
+    data = chunk.data
+    for cname in FRAME_COLS:
+        s, ln = chunk.str_spans[cname]
+        ss = s[rows].tolist()
+        ll = ln[rows].tolist()
+        payload = b"".join(data[a : a + b] for a, b in zip(ss, ll))
+        parts.append(struct.pack("<q", len(payload)))
+        parts.append(np.ascontiguousarray(ln[rows], np.int64).tobytes())
+        parts.append(payload)
+    return b"".join(parts)
+
+
+class CommitFrame:
+    """Parsed parent-side view of one worker-built commit frame.
+
+    Parsing validates framing only (version, lengths); string bytes stay
+    raw until :meth:`gather` decodes exactly the rows a commit touches.
+    Any inconsistency — truncation, rows the frame does not cover, utf8
+    the spans should never have produced — raises :class:`FrameError`,
+    and the caller re-runs the serial arm so a genuine decode problem
+    surfaces through the same path it always did."""
+
+    __slots__ = ("rows", "_lens", "_starts", "_payloads")
+
+    def __init__(self, buf: bytes):
+        buf = memoryview(buf)
+        if len(buf) < _FRAME_HDR.size:
+            raise FrameError("truncated commit frame header")
+        version, n = _FRAME_HDR.unpack_from(buf, 0)
+        if version != _FRAME_VERSION:
+            raise FrameError(f"unknown commit frame version {version}")
+        if n < 0:
+            raise FrameError("negative row count")
+        off = _FRAME_HDR.size
+        if len(buf) < off + n * 8:
+            raise FrameError("truncated commit frame row index block")
+        self.rows = np.frombuffer(buf, np.int64, n, off).copy()
+        off += n * 8
+        self._lens: dict[str, np.ndarray] = {}
+        self._starts: dict[str, np.ndarray] = {}
+        self._payloads: dict[str, bytes] = {}
+        for cname in FRAME_COLS:
+            if len(buf) < off + 8:
+                raise FrameError(f"truncated commit frame at column {cname}")
+            (pay_n,) = struct.unpack_from("<q", buf, off)
+            off += 8
+            if pay_n < 0 or len(buf) < off + n * 8 + pay_n:
+                raise FrameError(f"truncated commit frame at column {cname}")
+            lens = np.frombuffer(buf, np.int64, n, off)
+            off += n * 8
+            if n and (int(lens.min()) < 0 or int(lens.sum()) != pay_n):
+                raise FrameError(f"inconsistent lens for column {cname}")
+            self._lens[cname] = lens
+            self._starts[cname] = np.concatenate(
+                ([0], np.cumsum(lens[:-1], dtype=np.int64))
+            ) if n else np.zeros(0, np.int64)
+            self._payloads[cname] = bytes(buf[off : off + pay_n])
+            off += pay_n
+
+    def positions(self, rows_local) -> np.ndarray:
+        """Frame positions of chunk-local ``rows_local``; raises
+        :class:`FrameError` when any requested row is not covered (a
+        stale index after the working set moved, say)."""
+        want = np.asarray(rows_local, np.int64)
+        pos = np.searchsorted(self.rows, want)
+        pos_c = np.minimum(pos, max(self.rows.size - 1, 0))
+        if want.size and (
+            not self.rows.size or not bool(np.all(self.rows[pos_c] == want))
+        ):
+            raise FrameError("commit frame does not cover requested rows")
+        return pos_c
+
+    def gather(self, rows_local) -> dict[str, np.ndarray]:
+        """Decode the frame's string columns for chunk-local rows —
+        value-for-value what span materialization over the wire blob
+        yields for the same rows."""
+        pos = self.positions(rows_local)
+        out: dict[str, np.ndarray] = {}
+        for cname in FRAME_COLS:
+            payload = self._payloads[cname]
+            starts = self._starts[cname][pos].tolist()
+            lens = self._lens[cname][pos].tolist()
+            col = np.empty(len(starts), object)
+            try:
+                for i, (a, b) in enumerate(zip(starts, lens)):
+                    col[i] = payload[a : a + b].decode("utf-8")
+            except UnicodeDecodeError as e:
+                raise FrameError(f"bad utf8 in column {cname}: {e}") from e
+            out[cname] = col
         return out
 
 

@@ -775,6 +775,7 @@ class ColdecScratch:
 
     __slots__ = (
         "chunks", "row_of_jid", "arr", "_rows", "_tail", "_bounds", "_full",
+        "frames",
     )
 
     def __init__(self):
@@ -785,6 +786,10 @@ class ColdecScratch:
         self._tail: list[int] = []  # UNKNOWN job ids appended after chunks
         self._bounds: np.ndarray | None = None
         self._full: dict[str, np.ndarray] | None = None
+        #: chunk index -> colstore.CommitFrame, set by the frames mirror
+        #: path (ISSUE 19) when pool workers pre-packed the tier-2
+        #: strings; None = no frames, full_cols_framed ≡ full_cols
+        self.frames: dict | None = None
 
     def add_chunk(self, c) -> None:
         """Fold one decoded ``JobsInfoResponse`` in (request order)."""
@@ -889,6 +894,58 @@ class ColdecScratch:
                 continue  # tail UNKNOWN rows: all-"" defaults stand
             sel = np.nonzero(ci == c_idx)[0]
             local = ks[sel] - bounds[c_idx]
+            chunk = self.chunks[c_idx]
+            for cname in self._OBJ_COLS:
+                s, ln = chunk.str_spans[cname]
+                obj[cname][sel] = materialize_strings(
+                    chunk.data, s[local], ln[local]
+                )
+        out.update(obj)
+        return out
+
+    def full_cols_framed(self, ks, on_fallback=None) -> dict[str, np.ndarray]:
+        """:meth:`full_cols` that serves the tier-2 strings from worker-
+        built commit frames (``self.frames``) where available, falling
+        back to span materialization per chunk whose frame is missing,
+        doesn't cover the requested rows (stale indices after the working
+        set moved), or fails to decode — the frame path is all-or-nothing
+        per chunk, so a bad frame can never mix frame and span values for
+        one chunk's rows. ``on_fallback(rows)`` is called with the row
+        count each time a chunk falls back (the frame-fallback counter).
+        Value-for-value identical to :meth:`full_cols` by construction:
+        frames carry the same utf8 bytes the spans point at."""
+        from slurm_bridge_tpu.bridge.colstore import FrameError
+        from slurm_bridge_tpu.wire.coldec import materialize_strings
+
+        frames = self.frames
+        if not frames:
+            return self.full_cols(ks)
+        arr = self.finalize()
+        ks = np.asarray(ks, np.int64)
+        out = {c: arr[c][ks] for c in SIGNAL_COLS}
+        num = self._full_numeric()
+        for c in ("submit_ts", "run_time", "num_nodes"):
+            out[c] = num[c][ks]
+        obj = {c: np.full(int(ks.size), "", object) for c in self._OBJ_COLS}
+        bounds = self._bounds
+        ci = np.searchsorted(bounds, ks, side="right") - 1
+        for c_idx in np.unique(ci).tolist():
+            if c_idx >= len(self.chunks):
+                continue  # tail UNKNOWN rows: all-"" defaults stand
+            sel = np.nonzero(ci == c_idx)[0]
+            local = ks[sel] - bounds[c_idx]
+            frame = frames.get(c_idx)
+            if frame is not None:
+                try:
+                    got = frame.gather(local)
+                    for cname in self._OBJ_COLS:
+                        obj[cname][sel] = got[cname]
+                    continue
+                except FrameError:
+                    if on_fallback is not None:
+                        on_fallback(int(sel.size))
+            elif on_fallback is not None:
+                on_fallback(int(sel.size))
             chunk = self.chunks[c_idx]
             for cname in self._OBJ_COLS:
                 s, ln = chunk.str_spans[cname]

@@ -39,6 +39,7 @@ class Configurator:
         provider_status_interval: float | None = None,
         incremental: bool = False,
         use_coldec: bool = True,
+        mirror_frames: bool = True,
         inventory_listener=None,
     ):
         self.store = store
@@ -62,6 +63,10 @@ class Configurator:
         #: zero-object wire->column decode (ISSUE 14), forwarded per
         #: provider; off = the pb2 bulk path byte-for-byte
         self.use_coldec = use_coldec
+        #: partitioned commit frames (ISSUE 19), forwarded per provider;
+        #: engages only when a colpool is active — off (or width 0) runs
+        #: the serial column scatter byte-for-byte
+        self.mirror_frames = mirror_frames
         #: per-provider inventory-change callback (ISSUE 15 /
         #: ROADMAP streaming-admission follow-up c): the scheduler's
         #: admission-window maintenance seam, forwarded to every
@@ -156,6 +161,7 @@ class Configurator:
             sync_workers=self.pod_sync_workers,
             incremental=self.incremental,
             use_coldec=self.use_coldec,
+            mirror_frames=self.mirror_frames,
             inventory_listener=self.inventory_listener,
             **kwargs,
         )

@@ -69,6 +69,13 @@ def main(argv: list[str] | None = None) -> int:
                              "decode of the bulk RPCs (ISSUE 14): on by "
                              "default, this flag keeps every response "
                              "on the pb2 object path")
+    parser.add_argument("--no-mirror-frames", action="store_true",
+                        help="disable the partitioned store commit "
+                             "(ISSUE 19): worker-built commit frames "
+                             "merged per writer partition — on by "
+                             "default (engages only when the colpool "
+                             "has workers), this flag keeps the serial "
+                             "column scatter")
     parser.add_argument("--no-explain", action="store_true",
                         help="disable placement explainability (ISSUE "
                              "15): structured per-job reason codes, the "
@@ -158,6 +165,7 @@ def main(argv: list[str] | None = None) -> int:
         shard=shard,
         incremental=not args.no_incremental,
         use_coldec=not args.no_coldec,
+        mirror_frames=not args.no_mirror_frames,
         explain=not args.no_explain,
         state_file=args.state_file,
         configurator_interval=args.configurator_interval,

@@ -580,7 +580,17 @@ class StorePersistence:
         start_rv = self.store.current_rv()
         items: list[dict] = []
         for kind in _kind_registry():
-            rv, changed, _ = self.store.changes_since(kind, self._last_rv)
+            # partitioned dirty-set (ISSUE 19): when frame commits have
+            # recorded per-writer-partition dirty ranges for this kind,
+            # read them directly (identical output, and the flush walks
+            # each partition's own records). The O(1) no-change probe is
+            # shared by both arms, so an idle flush stays zero-I/O.
+            cs = (
+                self.store.changes_since_partitioned
+                if self.store.has_partitioned_dirty(kind)
+                else self.store.changes_since
+            )
+            rv, changed, _ = cs(kind, self._last_rv)
             for name, doc in self._kind_docs(kind, changed):
                 items.append({
                     "op": "put",

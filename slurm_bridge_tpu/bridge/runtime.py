@@ -68,6 +68,7 @@ class Bridge:
         shard=None,
         incremental: bool = True,
         use_coldec: bool = True,
+        mirror_frames: bool = True,
         explain: bool = True,
     ):
         self.agent_endpoint = agent_endpoint
@@ -118,6 +119,7 @@ class Bridge:
             pod_sync_workers=pod_sync_workers,
             incremental=incremental,
             use_coldec=use_coldec,
+            mirror_frames=mirror_frames,
             # admission-window maintenance from the periodic inventory
             # probe (ROADMAP follow-up c); late-bound — providers only
             # sync after start(), by which time the scheduler exists

@@ -63,7 +63,7 @@ from slurm_bridge_tpu.bridge.freeze import (
     thaw,
 )
 from slurm_bridge_tpu.obs.metrics import REGISTRY, Histogram
-from slurm_bridge_tpu.obs.tracing import current_span
+from slurm_bridge_tpu.obs.tracing import TRACER, current_span
 
 __all__ = [
     "AlreadyExists",
@@ -79,6 +79,23 @@ _list_seconds = REGISTRY.histogram(
     "store list/list_by_node wall time per call (copy-on-read path)",
     buckets=Histogram.FAST_BUCKETS,
 )
+
+_frames_applied = REGISTRY.counter(
+    "sbt_store_frames_applied_total",
+    "rows committed through the partitioned frame-merge path "
+    "(ObjectStore.apply_frames)",
+)
+_frame_fallback = REGISTRY.counter(
+    "sbt_store_frame_fallback_total",
+    "commit-frame payload fallbacks: rows whose frame was missing or "
+    "malformed and were re-materialized on the serial span arm",
+)
+
+
+def frame_fallback_counter():
+    """The frame-fallback counter, for the consumers (vnode) that count
+    per-chunk serial re-runs without importing the metrics registry."""
+    return _frame_fallback
 
 
 class _CommitsCollector:
@@ -202,6 +219,14 @@ class ObjectStore:
         #: and the tombstone side: kind -> name -> rv at delete
         self._changed: dict[str, dict[str, int]] = {}
         self._tombstones: dict[str, dict[str, int]] = {}
+        #: partitioned dirty-set (ISSUE 19): kind -> writer partition id
+        #: -> name -> rv, populated by :meth:`apply_frames` when the
+        #: caller names its partition. Keyed lazily — a store that never
+        #: sees a partitioned commit carries no extra state, and
+        #: ``changes_since`` unions these with the catch-all ``_changed``
+        #: so every existing consumer stays correct; the WAL flush reads
+        #: the partitions directly via :meth:`changes_since_partitioned`.
+        self._dirty_parts: dict[str, dict[int, dict[str, int]]] = {}
         #: per-kind high-water mark: the global rv of the kind's LAST
         #: change or delete. ``changes_since`` answers "nothing moved"
         #: in O(1) off this — the incremental tick (PR-11) probes the
@@ -335,6 +360,10 @@ class ObjectStore:
 
     def _record_delete(self, kind: str, name: str) -> None:
         self._changed.get(kind, {}).pop(name, None)
+        parts = self._dirty_parts.get(kind)
+        if parts:
+            for pdirty in parts.values():
+                pdirty.pop(name, None)
         self._kind_rv[kind] = self._rv
         tombs = self._tombstones.setdefault(kind, {})
         tombs[name] = self._rv
@@ -677,17 +706,66 @@ class ObjectStore:
                 # O(1) idle probe: the kind's last change/delete is at or
                 # before the caller's cursor — nothing to enumerate
                 return rv, [], []
-            changed = sorted(
-                n
-                for n, r in self._changed.get(kind, {}).items()
-                if r > since_rv
-            )
+            parts = self._dirty_parts.get(kind)
+            if parts:
+                names = {
+                    n
+                    for n, r in self._changed.get(kind, {}).items()
+                    if r > since_rv
+                }
+                for pdirty in parts.values():
+                    names.update(
+                        n for n, r in pdirty.items() if r > since_rv
+                    )
+                changed = sorted(names)
+            else:
+                changed = sorted(
+                    n
+                    for n, r in self._changed.get(kind, {}).items()
+                    if r > since_rv
+                )
             deleted = sorted(
                 n
                 for n, r in self._tombstones.get(kind, {}).items()
                 if r > since_rv
             )
         return rv, changed, deleted
+
+    def has_partitioned_dirty(self, kind: str) -> bool:
+        """True when ``kind`` has any per-partition dirty records — the
+        WAL flush switches to :meth:`changes_since_partitioned` then."""
+        with self._lock:
+            parts = self._dirty_parts.get(kind)
+            return bool(parts) and any(parts.values())
+
+    def changes_since_partitioned(
+        self, kind: str, since_rv: int
+    ) -> tuple[int, list[str], list[str]]:
+        """:meth:`changes_since`, reading the per-partition dirty dicts
+        directly (partition-id order) plus the catch-all set — identical
+        output by construction, but the flush walks each writer
+        partition's own records instead of one global per-kind dict."""
+        with self._lock:
+            rv = self._rv
+            if self._kind_rv.get(kind, 0) <= since_rv:
+                return rv, [], []
+            names = {
+                n
+                for n, r in self._changed.get(kind, {}).items()
+                if r > since_rv
+            }
+            for pid in sorted(self._dirty_parts.get(kind, {})):
+                names.update(
+                    n
+                    for n, r in self._dirty_parts[kind][pid].items()
+                    if r > since_rv
+                )
+            deleted = sorted(
+                n
+                for n, r in self._tombstones.get(kind, {}).items()
+                if r > since_rv
+            )
+        return rv, sorted(names), deleted
 
     # ---- columnar row access (the PR-6 hot paths) ----
 
@@ -802,6 +880,101 @@ class ObjectStore:
             self.commit_counts[ckey] = self.commit_counts.get(ckey, 0) + int(sel.size)
         self._span_commits(kind, site, int(sel.size))
         return out
+
+    def apply_frames(
+        self,
+        kind: str,
+        parts: list,
+        *,
+        site: str = "other",
+        partition: int | None = None,
+    ) -> list[np.ndarray]:
+        """The partitioned commit merge (ISSUE 19): scatter pre-built
+        writer partitions under ONE short lock, in the deterministic
+        order ``parts`` arrives in.
+
+        ``parts`` is a list of ``(names, expected_rv, writer)`` tuples —
+        each the per-partition slice of what one :meth:`update_rows` call
+        would have committed, with the column values already staged
+        outside the lock (a worker-built commit frame, typically). The
+        merge applies each part with :meth:`update_rows`'s exact
+        bookkeeping — optimistic rv check, sequential resource versions
+        in caller order, dirty-set records, MODIFIED watch events, commit
+        attribution — all main-thread, so the result is byte-identical to
+        the serial column scatter by construction. (Node-index moves are
+        not supported here: the status-commit writers never move a pod's
+        node; callers that need ``node_to`` use :meth:`update_rows`.)
+
+        ``partition`` names the writer partition whose dirty dict the
+        changed names land in; None records into the global per-kind set
+        exactly as :meth:`update_rows` does. Returns one rv-result array
+        per part, aligned with that part's ``names`` (new rv / 0 NotFound
+        / -1 Conflict).
+
+        The merge runs inside a ``store.apply`` child span so the flight
+        record attributes it; the commit-site attribution itself lands on
+        the CALLER's span, matching :meth:`update_rows`'s posture.
+        """
+        table = self._tables[kind]
+        outs: list[np.ndarray] = []
+        total = 0
+        with TRACER.span("store.apply") as span:
+            with self._lock:
+                if partition is None:
+                    dirty = self._changed.setdefault(kind, {})
+                else:
+                    dirty = self._dirty_parts.setdefault(
+                        kind, {}
+                    ).setdefault(int(partition), {})
+                tombs = self._tombstones.get(kind)
+                for names, expected_rv, writer in parts:
+                    n = len(names)
+                    out = np.zeros(n, np.int64)
+                    outs.append(out)
+                    rows = table.rows_for(names)
+                    found = rows >= 0
+                    ok = found.copy()
+                    if expected_rv is not None and n:
+                        cur = table.cols.rv[np.where(found, rows, 0)]
+                        ok &= cur == np.asarray(expected_rv, np.int64)
+                    out[found & ~ok] = -1
+                    sel = np.nonzero(ok)[0]
+                    if not sel.size:
+                        continue
+                    okrows = rows[sel]
+                    writer(okrows, sel)
+                    base = self._rv
+                    new_rvs = base + 1 + np.arange(sel.size, dtype=np.int64)
+                    table.cols.rv[okrows] = new_rvs
+                    self._rv = int(base + sel.size)
+                    out[sel] = new_rvs
+                    names_sel = (
+                        list(names)
+                        if sel.size == n
+                        else [names[p] for p in sel.tolist()]
+                    )
+                    dirty.update(zip(names_sel, new_rvs.tolist()))
+                    self._kind_rv[kind] = self._rv
+                    if tombs:
+                        for name in names_sel:
+                            tombs.pop(name, None)
+                    for q, kinds in self._watchers_snapshot:
+                        if kinds is None or kind in kinds:
+                            put = q.put
+                            for name in names_sel:
+                                put(StoreEvent("MODIFIED", kind, name))
+                    table.rows_written += int(sel.size)
+                    total += int(sel.size)
+                ckey = (kind, site)
+                self.commit_counts[ckey] = (
+                    self.commit_counts.get(ckey, 0) + total
+                )
+            span.count("parts", len(parts))
+            span.count("rows", total)
+        if total:
+            _frames_applied.inc(total)
+        self._span_commits(kind, site, total)
+        return outs
 
     def create_rows(
         self, kind: str, names: list[str], builder, *, site: str = "other"

@@ -27,6 +27,7 @@ from typing import Iterator, NamedTuple
 import grpc
 import numpy as np
 
+from slurm_bridge_tpu.bridge import colstore
 from slurm_bridge_tpu.bridge.columns import (
     LAZY_DT,
     PHASE_CODE,
@@ -52,7 +53,12 @@ from slurm_bridge_tpu.bridge.freeze import (
     frozen_replace,
 )
 from slurm_bridge_tpu.bridge.statusmap import pod_phase_for
-from slurm_bridge_tpu.bridge.store import AlreadyExists, NotFound, ObjectStore
+from slurm_bridge_tpu.bridge.store import (
+    AlreadyExists,
+    NotFound,
+    ObjectStore,
+    frame_fallback_counter,
+)
 from slurm_bridge_tpu.core.arrays import array_len
 from slurm_bridge_tpu.core.types import JobInfo, JobStatus, NodeInfo, PartitionInfo
 from slurm_bridge_tpu.obs.events import EventRecorder, Reason
@@ -290,6 +296,7 @@ class VirtualNodeProvider:
         status_interval: float = 10.0,
         incremental: bool = False,
         use_coldec: bool = True,
+        mirror_frames: bool = True,
         inventory_listener=None,
     ):
         self.store = store
@@ -354,6 +361,20 @@ class VirtualNodeProvider:
         #: bytes) — the PR-12 pb2 tick runs byte-for-byte.
         self.use_coldec = use_coldec and coldec.available()
         self._coldec_fallback: set[str] = set()
+        #: partitioned commit frames (ISSUE 19). On AND a colpool is
+        #: active, the bulk-status decode runs the diff+frames op — pool
+        #: workers pre-pack the tier-2 string columns for changed rows —
+        #: and the status commit merges the per-chunk writer partitions
+        #: through ``store.apply_frames``. With no pool (width 0, the
+        #: 1-core default) or Off, the PR-18 serial column scatter runs
+        #: byte-for-byte; a frame payload failure falls back per chunk
+        #: with the pool healthy, and PoolBroken mid-tick completes the
+        #: tick on the remembered inline arm.
+        self.mirror_frames = mirror_frames
+        #: writer-partition id for the store's per-partition dirty-set —
+        #: the harness group loop stamps the shard-ownership group index
+        #: here; None records into the global per-kind set as before
+        self._dirty_partition: int | None = None
         self._part_decode = PartitionDecodeCache()
         #: store-side cursor: Pod rv watermark of the last classification
         self._scan_rv = 0
@@ -574,15 +595,42 @@ class VirtualNodeProvider:
             self._pool_map(fetch, list(range(len(reqs))))
         elif reqs:
             fetch(0)
+        frames_map: dict[int, object] = {}
         if pool is not None:
             raw_idx = [
                 i for i, r in enumerate(results) if r is not None
                 and r[0] == "raw"
             ]
             if raw_idx:
-                decoded = pool.decode_jobs_info_many(
-                    [results[i][1] for i in raw_idx]
-                )
+                raws = [results[i][1] for i in raw_idx]
+                decoded = None
+                if self.mirror_frames:
+                    # diff+frames op: the workers that decode also pack
+                    # the commit frame for their chunk's changed rows.
+                    # None = pool couldn't serve (broken mid-tick,
+                    # remembered) — decode_jobs_info_many below then
+                    # runs the inline serial arm and the tick completes
+                    # frameless.
+                    framed = pool.decode_diff_frames_many(
+                        raws, colpool.empty_prior()
+                    )
+                    if framed is not None:
+                        decoded = []
+                        for j, d in enumerate(framed):
+                            if isinstance(d, coldec.DecodeError):
+                                decoded.append(d)
+                                continue
+                            chunk, fbytes = d
+                            if fbytes:
+                                try:
+                                    frames_map[raw_idx[j]] = (
+                                        colstore.CommitFrame(fbytes)
+                                    )
+                                except colstore.FrameError:
+                                    pass  # frameless chunk: spans serve
+                            decoded.append(chunk)
+                if decoded is None:
+                    decoded = pool.decode_jobs_info_many(raws)
                 for i, dec in zip(raw_idx, decoded):
                     if isinstance(dec, coldec.DecodeError):
                         results[i] = ("dec", dec)
@@ -611,6 +659,9 @@ class VirtualNodeProvider:
             scratch.add_chunk(chunk)
             versions.append(chunk.version)
             rows += chunk.rows
+        # chunk index in the scratch == position in results (request
+        # order), which is how frames_map was keyed above
+        scratch.frames = frames_map or None
         coldec.rows_counter().inc(rows)
         return "ok", scratch, versions
 
@@ -1438,6 +1489,87 @@ class VirtualNodeProvider:
         ]
         return ids, reqs
 
+    def _full_cols_for_commit(self, scratch, s_changed):
+        """Tier-2 write columns for the changed rows: served from the
+        worker-built commit frames when the frames mirror path attached
+        them, span-materialized otherwise. Frame fallbacks (a frame not
+        covering a row, truncation, bad utf8) count on
+        ``sbt_store_frame_fallback_total`` and re-run the serial arm per
+        chunk — value-identical by construction."""
+        frames = getattr(scratch, "frames", None)
+        if not frames:
+            return scratch.full_cols(s_changed)
+        return scratch.full_cols_framed(
+            s_changed, on_fallback=frame_fallback_counter().inc
+        )
+
+    def _commit_status_rows(
+        self, table, scratch, s_changed, names_c, expected, full, phase_w
+    ) -> np.ndarray:
+        """The status commit shared by the full and incremental mirrors.
+
+        Without frames this is the PR-18 serial column scatter: ONE
+        ``update_rows`` whose writer appends the new info rows to the
+        segment heap and repoints the istart/ilen/phase columns. With
+        frames (``scratch.frames`` set), the same committed rows are
+        split into writer partitions — maximal consecutive runs owned by
+        one decoded chunk — and merged through ``store.apply_frames``
+        under one short lock in request order. Equivalence is by
+        construction: the segment heap allocates at the tail, so
+        consecutive per-part allocs are contiguous and land each info
+        row at exactly the offset the one-shot writer would have; part
+        order concatenated equals ``names_c`` order, so rv assignment,
+        event order, dirty records and commit attribution are identical.
+        The compaction probe runs once, in the LAST part's writer — the
+        same heap state the serial writer's end-of-call probe sees."""
+        h = table.adapter.infos
+        c = table.cols
+
+        def make_writer(base: int, compact: bool):
+            def writer(rws, sel):
+                nc = int(rws.size)
+                start = h.alloc(nc)
+                tgt = np.arange(start, start + nc, dtype=np.int64)
+                gsel = sel + base
+                for hcol, acol in _WRITE_COLS:
+                    getattr(h, hcol)[tgt] = full[acol][gsel]
+                # datetimes derive lazily from the _ts columns on read
+                h.submit[tgt] = LAZY_DT
+                h.start[tgt] = LAZY_DT
+                h.retire(int(c.ilen[rws].sum()))
+                c.istart[rws] = tgt
+                c.ilen[rws] = 1
+                c.phase[rws] = phase_w[gsel]
+                if compact:
+                    table.adapter._maybe_compact_infos(table)
+            return writer
+
+        if not getattr(scratch, "frames", None):
+            return self.store.update_rows(
+                Pod.KIND, names_c, expected,
+                make_writer(0, compact=True), site="vnode.status",
+            )
+        bounds = scratch._bounds
+        ci = np.searchsorted(
+            bounds, np.asarray(s_changed, np.int64), side="right"
+        ) - 1
+        cuts = np.nonzero(np.diff(ci))[0] + 1
+        edges = [0, *cuts.tolist(), len(names_c)]
+        parts = []
+        for k, (lo, hi) in enumerate(zip(edges, edges[1:])):
+            parts.append((
+                names_c[lo:hi],
+                expected[lo:hi],
+                make_writer(lo, compact=(k == len(edges) - 2)),
+            ))
+        outs = self.store.apply_frames(
+            Pod.KIND, parts, site="vnode.status",
+            partition=self._dirty_partition,
+        )
+        if not outs:
+            return np.zeros(0, np.int64)
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
     def _apply_status_full(
         self, table, rb: _RefreshBatch, span, ids, reqs, fetched
     ) -> None:
@@ -1519,25 +1651,9 @@ class VirtualNodeProvider:
             expected = rb.rv[ci]
             # tier-2 decode: the remaining 12 fields, read from the kept
             # proto refs only for the rows the signal compare flagged
-            full = scratch.full_cols(s_changed)
-
-            def writer(rws, sel):
-                nc = int(rws.size)
-                start = h.alloc(nc)
-                tgt = np.arange(start, start + nc, dtype=np.int64)
-                for hcol, acol in _WRITE_COLS:
-                    getattr(h, hcol)[tgt] = full[acol][sel]
-                # datetimes derive lazily from the _ts columns on read
-                h.submit[tgt] = LAZY_DT
-                h.start[tgt] = LAZY_DT
-                h.retire(int(c.ilen[rws].sum()))
-                c.istart[rws] = tgt
-                c.ilen[rws] = 1
-                c.phase[rws] = phase_w[sel]
-                table.adapter._maybe_compact_infos(table)
-
-            results = self.store.update_rows(
-                Pod.KIND, names_c, expected, writer, site="vnode.status"
+            full = self._full_cols_for_commit(scratch, s_changed)
+            results = self._commit_status_rows(
+                table, scratch, s_changed, names_c, expected, full, phase_w
             )
             for i, rc in zip(ci.tolist(), results.tolist()):
                 if rc <= 0:
@@ -1696,24 +1812,9 @@ class VirtualNodeProvider:
             phase_w = PHASE_OF_SINGLE_STATE[arr["state"][s_changed]]
             names_c = [names_cand[int(k)] for k in ci]
             expected = rv_cand[ci]
-            full = scratch.full_cols(s_changed)
-
-            def writer(rws, sel):
-                nc = int(rws.size)
-                start = h.alloc(nc)
-                tgt = np.arange(start, start + nc, dtype=np.int64)
-                for hcol, acol in _WRITE_COLS:
-                    getattr(h, hcol)[tgt] = full[acol][sel]
-                h.submit[tgt] = LAZY_DT
-                h.start[tgt] = LAZY_DT
-                h.retire(int(c.ilen[rws].sum()))
-                c.istart[rws] = tgt
-                c.ilen[rws] = 1
-                c.phase[rws] = phase_w[sel]
-                table.adapter._maybe_compact_infos(table)
-
-            results = self.store.update_rows(
-                Pod.KIND, names_c, expected, writer, site="vnode.status"
+            full = self._full_cols_for_commit(scratch, s_changed)
+            results = self._commit_status_rows(
+                table, scratch, s_changed, names_c, expected, full, phase_w
             )
             for k, rc in zip(ci.tolist(), results.tolist()):
                 if rc <= 0:

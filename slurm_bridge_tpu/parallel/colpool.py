@@ -40,6 +40,14 @@ per-chunk like a DecodeError and sends the CALLER back to its serial
 arm — which re-raises the real exception in context — without breaking
 the pool. Infrastructure failures break the pool exactly as on the
 decode side: remembered, inline from then on.
+
+Partitioned commit (ISSUE 19): ``_OP_DIFF_FRAMES`` extends the diff op —
+the worker that decoded+diffed a chunk also packs the commit frame
+(:func:`colstore.build_commit_frame`) for the chunk's changed rows, so
+the tier-2 string spans the store commit will need arrive pre-sliced
+with the decode instead of being materialized on the main thread. A
+frame-build failure inside the worker degrades to a frameless chunk
+(the parent materializes spans as before) — never an error.
 """
 
 from __future__ import annotations
@@ -60,6 +68,7 @@ __all__ = [
     "configured_width",
     "decode_serial",
     "diff_signals",
+    "empty_prior",
     "reset",
 ]
 
@@ -70,6 +79,7 @@ _OP_SET_PRIOR = 0x02
 _OP_DECODE_DIFF = 0x03
 _OP_ENCODE_SUBMIT = 0x04
 _OP_BUILD_ROWS = 0x05
+_OP_DIFF_FRAMES = 0x06
 _ST_OK = 0x00
 _ST_DECODE_ERR = 0x01
 _ST_ERROR = 0x02
@@ -214,6 +224,19 @@ def diff_signals(chunk, prior: dict) -> np.ndarray:
     return changed
 
 
+def empty_prior() -> dict:
+    """An empty prior for the diff/frames ops: :func:`diff_signals`
+    marks every row changed against it, so a frames caller with no
+    incremental cursor (the cold mirror) gets frames covering all
+    returned rows — which is exactly the cold tick's changed-set."""
+    prior: dict = {"jid": np.empty(0, np.int64)}
+    for name in _DIFF_I64:
+        prior[name] = np.empty(0, np.int64)
+    for name in _DIFF_STR:
+        prior[name] = np.empty(0, object)
+    return prior
+
+
 def decode_serial(blobs: list[bytes]) -> list:
     """The serial oracle: per-blob results in order, each a
     ``JobsInfoChunk`` or the ``DecodeError`` it raised — exactly the
@@ -244,7 +267,7 @@ def _worker_main(conn) -> None:  # pragma: no cover - runs in the child
             if op == _OP_SET_PRIOR:
                 prior = _unpack_prior(memoryview(frame)[1:])
                 out = bytes([_ST_OK])
-            elif op in (_OP_DECODE, _OP_DECODE_DIFF):
+            elif op in (_OP_DECODE, _OP_DECODE_DIFF, _OP_DIFF_FRAMES):
                 blob = frame[1:]
                 chunk = coldec.decode_jobs_info(blob)
                 body = _pack_chunk(chunk)
@@ -254,6 +277,25 @@ def _worker_main(conn) -> None:  # pragma: no cover - runs in the child
                         {"jid": np.empty(0, np.int64)},
                     )
                     body += np.ascontiguousarray(mask, np.uint8).tobytes()
+                elif op == _OP_DIFF_FRAMES:
+                    # lazy, like the write ops: colstore only loads in
+                    # workers once a frames caller engages
+                    from slurm_bridge_tpu.bridge import colstore
+
+                    mask = diff_signals(
+                        chunk, prior if prior is not None else
+                        {"jid": np.empty(0, np.int64)},
+                    )
+                    try:
+                        cf = colstore.build_commit_frame(
+                            chunk, np.nonzero(mask)[0]
+                        )
+                    except Exception:
+                        # frame build is an optimization, not a result:
+                        # degrade to a frameless chunk and let the
+                        # parent materialize spans as before
+                        cf = b""
+                    body += struct.pack("<q", len(cf)) + cf
                 out = bytes([_ST_OK]) + body
             elif op in _WRITE_OPS:
                 # lazy: the ops only need writeops once a write-side
@@ -440,11 +482,15 @@ class ColPool:
             conn.send_bytes(frame)
             return conn.recv_bytes()
 
-    def _run_op(self, op: int, blobs: list[bytes], with_mask: bool) -> list:
+    def _run_op(
+        self, op: int, blobs: list[bytes], with_mask: bool,
+        with_frame: bool = False,
+    ) -> list:
         """Fan ``blobs`` across the workers (round-robin by index) and
         collect per-blob results in request order: JobsInfoChunk (or
-        (chunk, mask) for the diff op) or DecodeError. Raises
-        :class:`PoolBroken` on infrastructure failure."""
+        (chunk, mask) for the diff op, (chunk, frame bytes | None) for
+        the frames op) or DecodeError. Raises :class:`PoolBroken` on
+        infrastructure failure."""
         results: list = [None] * len(blobs)
         width = min(self.width, len(blobs))
         errors: list[BaseException] = []
@@ -461,7 +507,13 @@ class ColPool:
                         )
                     elif st == _ST_OK:
                         chunk, off = _unpack_chunk(body, blobs[i])
-                        if with_mask:
+                        if with_frame:
+                            (frame_n,) = struct.unpack_from("<q", body, off)
+                            fbytes = bytes(
+                                body[off + 8 : off + 8 + frame_n]
+                            )
+                            results[i] = (chunk, fbytes or None)
+                        elif with_mask:
                             mask = np.frombuffer(
                                 body, np.uint8, chunk.rows, off
                             ).astype(bool)
@@ -600,7 +652,10 @@ class ColPool:
                 if resp[0] != _ST_OK:
                     raise PoolBroken(resp[1:].decode("utf-8", "replace"))
             return self._run_op(_OP_DECODE_DIFF, blobs, with_mask=True)
-        except PoolBroken as e:
+        except (PoolBroken, EOFError, OSError) as e:
+            # raw pipe death in the SET_PRIOR round-trips (workers died
+            # between ops) is the same infra failure _run_op reports as
+            # PoolBroken — remember it and run the inline arm
             log.warning("colpool broken; decoding inline from now on: %s", e)
             self._break()
             return [
@@ -608,6 +663,35 @@ class ColPool:
                 else (r, diff_signals(r, prior))
                 for r in decode_serial(blobs)
             ]
+
+    def decode_diff_frames_many(
+        self, blobs: list[bytes], prior: dict
+    ) -> list | None:
+        """Decode + diff each blob in a worker AND pack the commit frame
+        for its changed rows: per-blob ``(JobsInfoChunk, frame bytes or
+        None)`` or DecodeError, request order. Returns ``None`` when the
+        pool can't serve — unavailable or broken (remembered) — and the
+        caller runs its frameless arm (``decode_jobs_info_many`` degrades
+        further to inline serial decode, so mid-tick breakage completes
+        the tick on the inline arm)."""
+        if not blobs:
+            return []
+        if not self._ensure():
+            return None
+        try:
+            pframe = bytes([_OP_SET_PRIOR]) + _pack_prior(prior)
+            width = min(self.width, len(blobs))
+            for w in range(width):
+                resp = self._round_trip(w, pframe)
+                if resp[0] != _ST_OK:
+                    raise PoolBroken(resp[1:].decode("utf-8", "replace"))
+            return self._run_op(
+                _OP_DIFF_FRAMES, blobs, with_mask=False, with_frame=True
+            )
+        except (PoolBroken, EOFError, OSError) as e:
+            log.warning("colpool broken; decoding inline from now on: %s", e)
+            self._break()
+            return None
 
 
 # ---- process-wide pool -------------------------------------------------

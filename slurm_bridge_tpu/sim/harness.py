@@ -241,6 +241,16 @@ class Scenario:
     #: is non-empty: fault draws must stay on the plain sequenced path.
     #: False is the sequential oracle
     mirror_pipeline: bool = True
+    #: partitioned store commit (ISSUE 19): the pool workers that
+    #: decode+diff a mirror chunk also pack its commit frame, and the
+    #: status write merges per-chunk writer partitions through
+    #: ``store.apply_frames`` under one short lock. Engages only when a
+    #: colpool is active (``SBT_COLPOOL_WORKERS`` ≥ 1 or multi-core
+    #: affinity) — on this repo's 1-core CI the flag is inert and the
+    #: serial scatter runs regardless. False is the PR-18 serial
+    #: column-scatter oracle byte-for-byte (fixture-pinned,
+    #: tests/fixtures/frames_off_baseline.json)
+    mirror_frames: bool = True
     #: fleet runtime config (fleet.FleetConfig): replicas + solver
     #: sidecar processes; per-shard solves dispatch to the shard
     #: owner's sidecar over real gRPC (byte-parity with inline — the
@@ -607,6 +617,7 @@ class SimHarness:
             provider_status_interval=float("inf"),
             incremental=scenario.incremental,
             use_coldec=scenario.coldec,
+            mirror_frames=scenario.mirror_frames,
             # admission-window maintenance from the periodic inventory
             # probe (ROADMAP follow-up c) — late-bound: the scheduler is
             # constructed a few lines below, before any provider syncs
@@ -1128,7 +1139,20 @@ class SimHarness:
                 self.scenario.mirror_pipeline
                 and not self.scenario.faults.faults
             )
-            for group in groups:
+            # writer-partition stamping (ISSUE 19): when the mirror runs
+            # in shard-ownership groups AND frames are on, each group's
+            # providers record their dirty names under the group index —
+            # mirror_groups IS the writer-partition map. Frames off
+            # leaves the stamp at None so the dirty-set stays exactly
+            # the PR-18 global per-kind dict.
+            stamp_parts = (
+                self.scenario.mirror_frames and len(groups) > 1
+            )
+            for gidx, group in enumerate(groups):
+                for partition in group:
+                    self.configurator.providers[partition]._dirty_partition = (
+                        gidx if stamp_parts else None
+                    )
                 if pipelined:
                     self._sync_group_pipelined(group)
                 else:

@@ -1,0 +1,442 @@
+"""Partitioned store commit (ISSUE 19): frames, merge, parity, failure.
+
+The commit frame is the write-side sibling of the coldec chunk: a
+worker packs the changed rows' tier-2 string columns as raw LE deltas
+(row indices + per-column length vectors + concatenated utf8 payload)
+WITHOUT decoding them, and the parent merges the per-chunk writer
+partitions through ``store.apply_frames`` — ONE short lock, rv
+assignment / MODIFIED events / dirty records / commit attribution all
+main-thread. Held here:
+
+1. frame round-trip fuzz: ``build_commit_frame`` → ``CommitFrame`` →
+   ``gather`` reproduces ``full_cols`` value-for-value over randomized
+   chunks (unicode, empty strings, UNKNOWN placeholder rows, empty
+   changed-sets), and every malformed input — truncated bytes, a wrong
+   version word, rows the frame does not cover (the stale-index shape a
+   compacted scratch would present) — raises ``FrameError``, never a
+   wrong answer;
+2. ``apply_frames`` ≡ ``update_rows``: twin stores fed the same commit
+   sequence through the two paths agree on returned rvs, final columns,
+   watch-event streams, ``changes_since``, and commit attribution —
+   including NotFound zeros and optimistic-conflict -1s;
+3. partitioned dirty bookkeeping: commits landing in a writer
+   partition's dirty dict stay visible to ``changes_since`` (set-union)
+   and ``changes_since_partitioned`` reads identically; the WAL flush
+   picks them up and a steady flush still appends NOTHING;
+4. scenario parity: ``full_500kx100k`` scaled down, pool forced to 2
+   workers and the id-chunk shrunk so the frames path genuinely engages
+   (proved via the frames-applied counter), lands on the same
+   ``final_state_digest`` as ``mirror_frames=False`` — the PR-18 serial
+   scatter byte-for-byte; a pool whose workers die mid-tick during the
+   frames op completes the tick on the inline arm, same digest;
+5. ``mirror_frames=False`` is pinned to the committed baseline fixture
+   (``tests/fixtures/frames_off_baseline.json``) so the serial arm can
+   never drift while frames evolve;
+6. the flight record stays reconciled with frames on: phase-sum within
+   the ticksmoke budget of the tick span at the scaled 500k shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import slurm_bridge_tpu.bridge.store as store_mod
+import slurm_bridge_tpu.bridge.vnode as vnode_mod
+from slurm_bridge_tpu.bridge.colstore import (
+    FRAME_COLS,
+    CommitFrame,
+    FrameError,
+    build_commit_frame,
+)
+from slurm_bridge_tpu.bridge.columns import ColdecScratch
+from slurm_bridge_tpu.bridge.objects import Meta, Pod, PodSpec
+from slurm_bridge_tpu.bridge.persist import StorePersistence
+from slurm_bridge_tpu.bridge.store import ObjectStore
+from slurm_bridge_tpu.parallel import colpool
+from slurm_bridge_tpu.sim.harness import run_scenario
+from slurm_bridge_tpu.sim.scenarios import SCENARIOS
+from slurm_bridge_tpu.wire import coldec, pb
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+# --------------------------------------------------------- helpers
+
+
+def _random_chunk(seed: int, n_entries: int = 40):
+    """A decoded JobsInfoChunk over a randomized response: unicode and
+    empty strings, multi-info entries, and found=False placeholders —
+    every row shape the frame packer must span."""
+    rng = np.random.default_rng(seed)
+
+    def s(p=0.6, k=12):
+        if rng.random() > p:
+            return ""
+        base = "".join(
+            chr(rng.integers(0x61, 0x7B)) for _ in range(rng.integers(1, k))
+        )
+        return base + ("-é☃" if rng.random() < 0.3 else "")
+
+    resp = pb.JobsInfoResponse(version=int(rng.integers(0, 1 << 30)))
+    for _ in range(n_entries):
+        e = resp.jobs.add(
+            job_id=int(rng.integers(0, 1 << 31)),
+            found=bool(rng.random() < 0.85),
+        )
+        for _ in range(int(rng.integers(0, 3))):
+            e.info.append(pb.JobInfo(
+                id=int(rng.integers(0, 1 << 40)),
+                user_id=s(),
+                name=s(0.9),
+                exit_code=s(0.3),
+                status=int(rng.integers(0, 7)),
+                submit_time=int(rng.integers(0, 1 << 33)),
+                start_time=int(rng.integers(0, 1 << 33)),
+                run_time_s=int(rng.integers(0, 1 << 20)),
+                time_limit_s=int(rng.integers(0, 1 << 20)),
+                working_dir=s(0.3),
+                std_out=s(0.7, 20),
+                std_err=s(0.7, 20),
+                partition=s(0.8),
+                node_list=s(0.6, 30),
+                batch_host=s(0.6),
+                num_nodes=int(rng.integers(0, 64)),
+                array_id=s(0.2),
+                reason=s(0.3, 16),
+            ))
+    return coldec.decode_jobs_info(resp.SerializeToString())
+
+
+def _oracle_cols(chunk, rows: np.ndarray) -> dict:
+    """The serial materialization of the frame columns for ``rows`` —
+    one chunk in a scratch, so local indices are global indices."""
+    scratch = ColdecScratch()
+    scratch.add_chunk(chunk)
+    return scratch.full_cols(rows)
+
+
+# ------------------------------------------ 1: frame round-trip fuzz
+
+
+class TestCommitFrameRoundTrip:
+    def test_fuzz_gather_matches_serial_materialize(self):
+        for seed in (1, 2, 3, 4, 5):
+            chunk = _random_chunk(seed)
+            if chunk.rows == 0:
+                continue
+            rng = np.random.default_rng(100 + seed)
+            mask = rng.random(chunk.rows) < 0.6
+            rows = np.nonzero(mask)[0].astype(np.int64)
+            cf = CommitFrame(build_commit_frame(chunk, rows))
+            got = cf.gather(rows)
+            want = _oracle_cols(chunk, rows)
+            assert set(got) == set(FRAME_COLS)
+            for cname in FRAME_COLS:
+                assert got[cname].tolist() == want[cname].tolist(), (
+                    seed, cname,
+                )
+
+    def test_subset_gather(self):
+        """A frame built for N rows serves any subset of them — the
+        apply side gathers per writer partition, not per frame."""
+        chunk = _random_chunk(7, 30)
+        rows = np.arange(chunk.rows, dtype=np.int64)
+        cf = CommitFrame(build_commit_frame(chunk, rows))
+        sub = rows[::3]
+        got = cf.gather(sub)
+        want = _oracle_cols(chunk, sub)
+        for cname in FRAME_COLS:
+            assert got[cname].tolist() == want[cname].tolist()
+
+    def test_empty_changed_set(self):
+        chunk = _random_chunk(8)
+        cf = CommitFrame(build_commit_frame(chunk, np.empty(0, np.int64)))
+        assert cf.rows.size == 0
+        got = cf.gather(np.empty(0, np.int64))
+        for cname in FRAME_COLS:
+            assert got[cname].size == 0
+
+    def test_truncated_bytes_raise_frame_error(self):
+        chunk = _random_chunk(9)
+        rows = np.arange(chunk.rows, dtype=np.int64)
+        raw = build_commit_frame(chunk, rows)
+        for cut in list(range(0, len(raw), max(1, len(raw) // 40))):
+            with pytest.raises(FrameError):
+                CommitFrame(raw[:cut])
+
+    def test_wrong_version_raises(self):
+        chunk = _random_chunk(10)
+        raw = bytearray(
+            build_commit_frame(chunk, np.arange(chunk.rows, dtype=np.int64))
+        )
+        raw[0] = 0xFF
+        with pytest.raises(FrameError):
+            CommitFrame(bytes(raw))
+
+    def test_uncovered_rows_raise_not_garble(self):
+        """Row indices the frame does not cover — the stale-index shape
+        a later scratch compaction would present — must raise, never
+        return another row's strings."""
+        chunk = _random_chunk(11, 30)
+        assert chunk.rows >= 4
+        covered = np.arange(0, chunk.rows, 2, dtype=np.int64)
+        cf = CommitFrame(build_commit_frame(chunk, covered))
+        with pytest.raises(FrameError):
+            cf.gather(np.asarray([1], np.int64))
+        with pytest.raises(FrameError):
+            cf.gather(np.asarray([chunk.rows + 5], np.int64))
+
+
+# ------------------------------ 2+3: apply_frames ≡ update_rows
+
+
+def _make_store(names: list[str]) -> ObjectStore:
+    store = ObjectStore()
+    store.create_batch([
+        Pod(meta=Meta(name=nm), spec=PodSpec(partition="debug"))
+        for nm in names
+    ])
+    return store
+
+
+def _drain(q) -> list:
+    out = []
+    while True:
+        try:
+            out.append(q.get_nowait())
+        except Exception:
+            break
+    return out
+
+
+class TestApplyFramesEquivalence:
+    def _phase_writer(self, store, val: int):
+        c = store.table(Pod.KIND).cols
+
+        def writer(rws, sel):
+            c.phase[rws] = val
+
+        return writer
+
+    def _parts_of(self, store, names, expected, val, splits=3):
+        """Consecutive slices of ONE update_rows call's inputs — what
+        the per-chunk writer partitions are."""
+        edges = np.linspace(0, len(names), splits + 1).astype(int).tolist()
+        w = self._phase_writer(store, val)
+        return [
+            (
+                names[lo:hi],
+                None if expected is None else expected[lo:hi],
+                w,
+            )
+            for lo, hi in zip(edges, edges[1:])
+        ]
+
+    def test_twin_commit_sequences_agree(self):
+        names = [f"pod-{i:03d}" for i in range(60)]
+        a, b = _make_store(names), _make_store(names)
+        qa, qb = a.watch((Pod.KIND,)), b.watch((Pod.KIND,))
+        _drain(qa), _drain(qb)  # synthetic ADDED backlog
+        rng = np.random.default_rng(3)
+        for round_ in range(4):
+            sel = sorted(
+                rng.choice(len(names), size=30 + round_, replace=False).tolist()
+            )
+            batch = [names[i] for i in sel]
+            rv_a = a.update_rows(
+                Pod.KIND, batch, None,
+                self._phase_writer(a, round_), site="t",
+            )
+            outs = b.apply_frames(
+                Pod.KIND, self._parts_of(b, batch, None, round_), site="t",
+            )
+            rv_b = np.concatenate(outs)
+            assert rv_a.tolist() == rv_b.tolist()
+            assert _drain(qa) == _drain(qb)
+        ca, cb = a.table(Pod.KIND).cols, b.table(Pod.KIND).cols
+        ra, rb = a.table(Pod.KIND).rows_for(names), b.table(Pod.KIND).rows_for(names)
+        assert ca.phase[ra].tolist() == cb.phase[rb].tolist()
+        assert ca.rv[ra].tolist() == cb.rv[rb].tolist()
+        assert a.changes_since(Pod.KIND, 0) == b.changes_since(Pod.KIND, 0)
+        assert a.commit_counts_snapshot() == b.commit_counts_snapshot()
+
+    def test_notfound_and_conflict_results_match(self):
+        names = [f"pod-{i:03d}" for i in range(20)]
+        a, b = _make_store(names), _make_store(names)
+        batch = ["ghost-0", *names[:10], "ghost-1"]
+        cur = a.table(Pod.KIND).cols.rv[
+            a.table(Pod.KIND).rows_for(batch)
+        ].copy()
+        expected = np.where(np.arange(len(batch)) % 3 == 0, cur + 99, cur)
+        rv_a = a.update_rows(
+            Pod.KIND, batch, expected, self._phase_writer(a, 5), site="t",
+        )
+        rv_b = np.concatenate(b.apply_frames(
+            Pod.KIND, self._parts_of(b, batch, expected, 5), site="t",
+        ))
+        assert rv_a.tolist() == rv_b.tolist()
+        assert (rv_a[0], rv_a[-1]) == (0, 0)  # ghosts: NotFound
+        assert (rv_a == -1).any()  # conflicts surfaced identically
+
+    def test_partitioned_dirty_stays_visible(self):
+        names = [f"pod-{i:03d}" for i in range(30)]
+        a, b = _make_store(names), _make_store(names)
+        a.update_rows(Pod.KIND, names, None, self._phase_writer(a, 2), site="t")
+        b.apply_frames(
+            Pod.KIND, self._parts_of(b, names, None, 2),
+            site="t", partition=4,
+        )
+        assert b.has_partitioned_dirty(Pod.KIND)
+        assert not a.has_partitioned_dirty(Pod.KIND)
+        # the union read and the partition-order read agree with the
+        # global-dict store exactly
+        assert b.changes_since(Pod.KIND, 0) == a.changes_since(Pod.KIND, 0)
+        assert (
+            b.changes_since_partitioned(Pod.KIND, 0)
+            == b.changes_since(Pod.KIND, 0)
+        )
+        # deletes purge partition dicts too
+        a.delete(Pod.KIND, names[0])
+        b.delete(Pod.KIND, names[0])
+        assert b.changes_since(Pod.KIND, 0) == a.changes_since(Pod.KIND, 0)
+
+    def test_wal_flush_reads_partitions_and_steady_flush_is_free(
+        self, tmp_path
+    ):
+        names = [f"pod-{i:03d}" for i in range(25)]
+        store = _make_store(names)
+        p = StorePersistence(
+            store, str(tmp_path / "state.json"),
+            auto_flush=False, fsync=False,
+        )
+        try:
+            p.flush()  # the creates
+            store.apply_frames(
+                Pod.KIND,
+                [(names[:12], None, self._phase_writer(store, 3)),
+                 (names[12:], None, self._phase_writer(store, 3))],
+                site="t", partition=1,
+            )
+            assert store.has_partitioned_dirty(Pod.KIND)
+            assert p.flush() == len(names)  # partition dirt reached the WAL
+            size = p.wal_bytes
+            assert p.flush() == 0  # steady: no records...
+            assert p.wal_bytes == size  # ...and no file growth
+        finally:
+            p.abandon()
+
+
+# ---------------- 4: scenario parity + mid-tick breakage posture
+
+
+@pytest.fixture()
+def forced_frames(monkeypatch):
+    """Pool forced to 2 workers AND the JobsInfo id-chunk shrunk so the
+    scaled-down scenarios produce multi-chunk fetches — the only shape
+    where the pool (and so the frames path) engages."""
+    monkeypatch.setenv("SBT_COLPOOL_WORKERS", "2")
+    monkeypatch.setattr(vnode_mod, "_BULK_CHUNK", 256)
+    colpool.reset()
+    yield
+    colpool.reset()
+
+
+class TestFramesDigestParity:
+    def test_frames_on_equals_frames_off(self, forced_frames):
+        scn = SCENARIOS["full_500kx100k"](scale=0.02)
+        f0 = store_mod._frames_applied.total()
+        on = run_scenario(scn)
+        assert store_mod._frames_applied.total() - f0 > 0, (
+            "frames path never engaged — parity below would be vacuous"
+        )
+        off = run_scenario(dataclasses.replace(scn, mirror_frames=False))
+        assert (
+            on.determinism["final_state_digest"]
+            == off.determinism["final_state_digest"]
+        )
+        assert on.determinism["digest"] == off.determinism["digest"]
+        assert on.determinism["invariant_violations"] == []
+        assert off.determinism["invariant_violations"] == []
+
+    def test_mid_tick_pool_breakage_completes_inline(
+        self, forced_frames, monkeypatch
+    ):
+        """Workers killed DURING the first frames op: the op returns
+        None (broken state remembered), the caller serial-decodes the
+        same raws inline, and the run completes frameless on the same
+        bytes."""
+        scn = SCENARIOS["full_500kx100k"](scale=0.02)
+        oracle = run_scenario(
+            dataclasses.replace(scn, mirror_frames=False)
+        )
+        colpool.reset()
+        orig = colpool.ColPool.decode_diff_frames_many
+        sabotaged = {"n": 0}
+
+        def sabotage(self, blobs, prior):
+            if sabotaged["n"] == 0 and self._ensure():
+                sabotaged["n"] = 1
+                for proc in self._procs:
+                    proc.terminate()
+                for proc in self._procs:
+                    proc.join(timeout=5.0)
+            return orig(self, blobs, prior)
+
+        monkeypatch.setattr(
+            colpool.ColPool, "decode_diff_frames_many", sabotage
+        )
+        f0 = store_mod._frames_applied.total()
+        broken = run_scenario(scn)
+        assert sabotaged["n"] == 1  # the op really ran and really died
+        assert store_mod._frames_applied.total() == f0  # frameless ticks
+        assert (
+            broken.determinism["final_state_digest"]
+            == oracle.determinism["final_state_digest"]
+        )
+        assert broken.determinism["invariant_violations"] == []
+
+
+# ------------------------------------------ 5: frames-off pinning
+
+
+def test_frames_off_matches_pinned_baseline():
+    """``mirror_frames=False`` must be the pre-change serial commit
+    byte-for-byte: the fixture digests equal the coldec-era baselines
+    (cross-checkable against ``coldec_off_baseline.json`` — same
+    scenarios, same values), so regenerating this file to paper over a
+    drift defeats the test."""
+    base = json.loads((FIXTURES / "frames_off_baseline.json").read_text())
+    for name, want in sorted(base.items()):
+        sc = dataclasses.replace(
+            SCENARIOS[name](scale=want["scale"], seed=want["seed"]),
+            mirror_frames=False,
+        )
+        d = run_scenario(sc).determinism
+        assert d["digest"] == want["digest"], f"{name}: tick digest drifted"
+        assert d["final_state_digest"] == want["final_state_digest"], (
+            f"{name}: final state drifted"
+        )
+        assert d["events"] == want["events"], f"{name}: event counts drifted"
+        assert d["bound_total"] == want["bound_total"]
+
+
+# --------------------------- 6: flight reconciliation with frames on
+
+
+class TestFlightReconciliationFrames:
+    def test_phase_sum_holds_with_frames_engaged(self, forced_frames):
+        """``store.apply`` is a child span inside ``vnode.status``
+        inside the mirror phase — attribution detail, not a phase hole:
+        the phase-sum still covers the tick span within the ticksmoke
+        reconciliation budget."""
+        scn = SCENARIOS["full_500kx100k"](scale=0.02)
+        result = run_scenario(dataclasses.replace(scn, tracing=True))
+        fr = result.flight_record
+        span = fr.get("tick_span_p50_ms") or 0.0
+        psum = fr.get("phase_sum_p50_ms") or 0.0
+        assert span > 0 and psum > 0
+        assert abs(span - psum) / span * 100.0 <= 5.0
