@@ -210,6 +210,42 @@ def profile_explain_overhead(scale: float = 0.12, rounds: int = 3) -> dict:
     return out
 
 
+def profile_fleet_obs_overhead(scale: float = 0.12, rounds: int = 2) -> dict:
+    """Fleet-observability-on vs -off tick cost, same seed (ISSUE 20
+    gate), on ``fleet_smoke`` — the real sidecar + colpool topology.
+
+    The on arm stitches synthetic sidecar phase spans under every
+    ``rpc.client.PlaceShard`` client span, folds colpool reply timing
+    headers into metrics + ``colpool.<op>`` spans, federates sidecar
+    counters over the heartbeat's Healthz, and records the lifecycle
+    timeline; the off arm disables all parent-side folding (the wire
+    bytes — timing headers, Healthz metric arrays — ride regardless, so
+    this measures the FOLDING cost, which is the only part a deployment
+    can turn off). Digest identity is the hard half: observability that
+    changes a placement decision is a bug at any speed. Two rounds, not
+    three — each arm spawns a real sidecar subprocess per run and the
+    estimator's per-tick minimum converges fast on this topology.
+    """
+    import dataclasses
+
+    from slurm_bridge_tpu.sim.scenarios import SCENARIOS
+
+    base = SCENARIOS["fleet_smoke"](scale=scale)
+    out = _paired_overhead(
+        dataclasses.replace(base, fleet_obs=False),
+        dataclasses.replace(base, fleet_obs=True),
+        rounds,
+    )
+    on = out.pop("_on_result")
+    fleet_section = on.flight_record.get("fleet") or {}
+    out["remote_solves"] = (on.quality.get("fleet_remote") or {}).get(
+        "remote_solves", 0
+    )
+    out["timeline_events"] = len(fleet_section.get("timeline", []))
+    out["federated_replicas"] = len(fleet_section.get("replica_counters", {}))
+    return out
+
+
 def profile_wal_overhead(
     scale: float = 0.12, rounds: int = 3, fsync_ms: float = 0.0
 ) -> dict:
@@ -457,6 +493,12 @@ def main() -> int:
         os.environ.get("SBT_SMOKE_EXPLAIN_OVERHEAD_PCT", "3")
     )
     explain_eps_ms = float(os.environ.get("SBT_SMOKE_EXPLAIN_EPS_MS", "1.5"))
+    fleet_obs_pct = float(
+        os.environ.get("SBT_SMOKE_FLEET_OBS_OVERHEAD_PCT", "3")
+    )
+    fleet_obs_eps_ms = float(
+        os.environ.get("SBT_SMOKE_FLEET_OBS_EPS_MS", "1.5")
+    )
     steady_budget_ms = float(
         os.environ.get("SBT_SMOKE_STEADY_BUDGET_MS", "50")
     )
@@ -488,6 +530,7 @@ def main() -> int:
     trace = profile_trace_overhead()
     wal = profile_wal_overhead()
     explain = profile_explain_overhead()
+    fleet_obs = profile_fleet_obs_overhead()
     steady = profile_steady_tick()
     cold = profile_cold_tick()
     out["reconcile"] = rec
@@ -503,6 +546,7 @@ def main() -> int:
     out["tracing"] = trace
     out["wal"] = wal
     out["explain"] = explain
+    out["fleet_obs"] = fleet_obs
     out["steady"] = steady
     out["steady_budget_ms"] = steady_budget_ms
     out["encode_budget_ms"] = budget_ms
@@ -511,6 +555,7 @@ def main() -> int:
     out["trace_overhead_budget_pct"] = trace_pct
     out["wal_overhead_budget_pct"] = wal_pct
     out["explain_overhead_budget_pct"] = explain_pct
+    out["fleet_obs_overhead_budget_pct"] = fleet_obs_pct
     trace_ok = trace["digest_identical"] and (
         trace["overhead_ms"] <= trace_eps_ms
         or trace["overhead_pct"] <= trace_pct
@@ -522,6 +567,18 @@ def main() -> int:
     explain_ok = explain["digest_identical"] and (
         explain["overhead_ms"] <= explain_eps_ms
         or explain["overhead_pct"] <= explain_pct
+    )
+    # the ISSUE 20 fleet-observability gate: stitching + timing folds +
+    # federation must be free (≤ budget) and digest-invisible on the
+    # real sidecar topology; the on-arm must actually have engaged
+    fleet_obs_ok = (
+        fleet_obs["digest_identical"]
+        and fleet_obs["remote_solves"] > 0
+        and fleet_obs["timeline_events"] > 0
+        and (
+            fleet_obs["overhead_ms"] <= fleet_obs_eps_ms
+            or fleet_obs["overhead_pct"] <= fleet_obs_pct
+        )
     )
     # the PR-11 steady-state HARD gate: zero-work facts are structural —
     # any nonzero means an O(cluster) path snuck back onto the idle tick
@@ -572,6 +629,7 @@ def main() -> int:
         and trace_ok
         and wal_ok
         and explain_ok
+        and fleet_obs_ok
         and steady_ok
         and decode_ok
         and submit_ok
@@ -596,7 +654,12 @@ def main() -> int:
             f"{explain_eps_ms} ms) / digests identical "
             f"trace={trace['digest_identical']} wal={wal['digest_identical']} "
             f"explain={explain['digest_identical']} "
-            "(must be true) / steady tick "
+            f"fleet_obs={fleet_obs['digest_identical']} "
+            "(must be true) / fleet-obs overhead "
+            f"{fleet_obs['overhead_pct']}% (budget {fleet_obs_pct}%, eps "
+            f"{fleet_obs_eps_ms} ms), remote solves "
+            f"{fleet_obs['remote_solves']} (must be >0), timeline events "
+            f"{fleet_obs['timeline_events']} (must be >0) / steady tick "
             f"p50 {steady['steady_tick_p50_ms']} ms (budget "
             f"{steady_budget_ms}), commits {steady['steady_commits']} "
             f"(must be 0), solves {steady['steady_solves']} (must be 0), "

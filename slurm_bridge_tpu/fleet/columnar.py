@@ -39,15 +39,24 @@ def schema_digest() -> str:
 
 
 def healthz_response(
-    service: str, incarnation: str, shard_set: tuple[int, ...] = ()
+    service: str,
+    incarnation: str,
+    shard_set: tuple[int, ...] = (),
+    metrics: dict[str, float] | None = None,
 ) -> pb.HealthzResponse:
-    return pb.HealthzResponse(
+    resp = pb.HealthzResponse(
         service=service,
         incarnation=incarnation,
         schema_version=schema_digest(),
         shard_set=list(shard_set),
         pid=os.getpid(),
     )
+    if metrics:
+        # parallel arrays, sorted for a stable wire shape
+        for name in sorted(metrics):
+            resp.metric_name.append(name)
+            resp.metric_total.append(float(metrics[name]))
+    return resp
 
 
 def _col(a: np.ndarray, dtype) -> bytes:
@@ -121,8 +130,9 @@ def solve_place_shard(request: pb.PlaceShardRequest) -> pb.PlaceShardResponse:
 
     from slurm_bridge_tpu.solver.greedy import greedy_place
 
+    t_in = time.monotonic_ns()
     snapshot, batch, incumbent = decode_place_shard(request)
-    t0 = time.perf_counter()
+    t0 = time.monotonic_ns()
     if request.engine == "native":
         from slurm_bridge_tpu.solver.indexed_native import indexed_place_native
 
@@ -132,14 +142,21 @@ def solve_place_shard(request: pb.PlaceShardRequest) -> pb.PlaceShardResponse:
         )
     else:
         placement = greedy_place(snapshot, batch, incumbent=incumbent)
-    solve_ms = (time.perf_counter() - t0) * 1e3
-    return pb.PlaceShardResponse(
+    t1 = time.monotonic_ns()
+    resp = pb.PlaceShardResponse(
         node_of=_col(placement.node_of, np.int32),
         placed=_col(np.asarray(placement.placed), np.uint8),
         free_after=_col(placement.free_after, np.float32),
         engine=request.engine,
-        solve_ms=solve_ms,
+        solve_ms=(t1 - t0) / 1e6,
     )
+    # worker-side timing summary (ISSUE 20): the bridge stitches these
+    # into synthetic child spans under its rpc.PlaceShard client span
+    resp.decode_ns = t0 - t_in
+    resp.solve_ns = t1 - t0
+    resp.encode_ns = time.monotonic_ns() - t1
+    resp.rows = int(request.num_rows)
+    return resp
 
 
 def placement_from_response(

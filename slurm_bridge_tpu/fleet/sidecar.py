@@ -65,11 +65,12 @@ class SidecarSupervisor:
         env = {**os.environ, "JAX_PLATFORMS": "cpu"}
         try:
             self.proc = subprocess.Popen(
-                cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                 text=True, env=env,
             )
         except OSError as exc:
             return self._adopt_failed(f"spawn: {exc}")
+        self._relay_stderr(self.proc)
         try:
             ready = self._read_ready_line()
         except Exception as exc:  # noqa: BLE001 - any handshake failure
@@ -77,6 +78,30 @@ class SidecarSupervisor:
         if not ready:
             return self._adopt_failed("worker exited before ready line")
         return self._adopt(incarnation)
+
+    def _relay_stderr(self, proc: subprocess.Popen) -> None:
+        """Relay the sidecar's stderr to ours, each line prefixed with the
+        replica id (ISSUE 20 log correlation): the sidecar's own log lines
+        already carry trace_id/span_id via the obs/logging formatters, and
+        the prefix names WHICH process they came from. Daemon thread; ends
+        when the child closes the pipe."""
+        import threading
+
+        stderr = proc.stderr
+        if stderr is None:
+            return
+        prefix = f"[{self.replica_id}] "
+
+        def relay() -> None:
+            try:
+                for line in stderr:
+                    sys.stderr.write(prefix + line)
+            except (ValueError, OSError):
+                pass  # pipe closed mid-read during teardown
+
+        threading.Thread(
+            target=relay, name=f"sidecar-stderr-{self.replica_id}", daemon=True
+        ).start()
 
     def _read_ready_line(self) -> dict | None:
         import threading
@@ -201,4 +226,6 @@ class SidecarSupervisor:
                 return
             if self.proc.stdout is not None:
                 self.proc.stdout.close()
+            if self.proc.stderr is not None:
+                self.proc.stderr.close()
             self.proc = None

@@ -42,6 +42,10 @@ class Counter:
         with self._lock:
             return sum(self._values.values())
 
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
     def collect(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -71,6 +75,10 @@ class Gauge:
                 self._values[key] = value
 
         return set_value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
 
     def collect(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
@@ -107,6 +115,12 @@ class Histogram:
                     self._counts[i] += 1
                     return
             self._counts[-1] += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._total = 0
 
     def quantile(self, q: float) -> float:
         """Approximate quantile from bucket counts (upper bound)."""
@@ -161,6 +175,22 @@ class MetricsRegistry:
         commit counters are plain dicts incremented under the store lock,
         and the collector reads them only at scrape time."""
         return self._register(collector)
+
+    def reset_values(self) -> None:
+        """Zero every metric's observed values, keeping registrations.
+
+        Fork hygiene (ISSUE 20): a forked colpool worker inherits the
+        parent's registry by COW — calling this first thing post-fork
+        means a future worker-side scrape can never double-count parent
+        totals. Custom collectors (no ``reset`` attr) are skipped: their
+        source of truth lives elsewhere.
+        """
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            reset = getattr(m, "reset", None)
+            if callable(reset):
+                reset()
 
     def counter_totals(self) -> dict[str, float]:
         """``{name: summed value}`` for every Counter — the flight

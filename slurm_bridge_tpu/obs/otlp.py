@@ -77,13 +77,24 @@ def span_to_otlp(span: Span) -> dict:
     return out
 
 
-def encode_batch(spans: list[Span], service: str) -> bytes:
-    """OTLP/HTTP JSON request body for one batch."""
+def encode_batch(
+    spans: list[Span], service: str, resource_attrs: dict | None = None
+) -> bytes:
+    """OTLP/HTTP JSON request body for one batch.
+
+    ``resource_attrs`` extends the resource identity beyond
+    ``service.name`` — the sidecar sets process role attributes
+    (``process.pid``, ``sbt.replica``, ``sbt.incarnation``) so stitched
+    traces group per process in Jaeger/Tempo (ISSUE 20).
+    """
+    attrs = [_attr("service.name", service)]
+    for key in sorted(resource_attrs or {}):
+        attrs.append(_attr(key, resource_attrs[key]))
     return json.dumps(
         {
             "resourceSpans": [
                 {
-                    "resource": {"attributes": [_attr("service.name", service)]},
+                    "resource": {"attributes": attrs},
                     "scopeSpans": [
                         {
                             "scope": {"name": "slurm-bridge-tpu"},
@@ -116,10 +127,12 @@ class OtlpHttpExporter:
         flush_interval: float = 2.0,
         queue_limit: int = 4096,
         timeout: float = 5.0,
+        resource_attrs: dict | None = None,
     ):
         base = (endpoint or os.environ.get(ENDPOINT_ENV) or DEFAULT_ENDPOINT)
         self.url = base.rstrip("/") + "/v1/traces"
         self.service = service
+        self.resource_attrs = dict(resource_attrs or {})
         self.batch_size = batch_size
         self.flush_interval = flush_interval
         self.timeout = timeout
@@ -176,7 +189,7 @@ class OtlpHttpExporter:
     def _send(self, batch: list[Span]) -> None:
         if not batch:
             return
-        body = encode_batch(batch, self.service)
+        body = encode_batch(batch, self.service, self.resource_attrs)
         req = urllib.request.Request(
             self.url, data=body, headers={"Content-Type": "application/json"}
         )

@@ -420,6 +420,44 @@ class Tracer:
     def current(self) -> Span | None:
         return _current_span.get()
 
+    def emit_synthetic(
+        self,
+        name: str,
+        *,
+        parent: Span,
+        duration_s: float,
+        start_offset_s: float = 0.0,
+        tags: dict | None = None,
+        counters: dict | None = None,
+    ) -> Span:
+        """Materialize an already-finished child span under ``parent``.
+
+        The cross-process stitching primitive (ISSUE 20): a remote or
+        forked worker reports measured phase durations after the fact
+        (PlaceShardResponse timing ns, colpool reply timing headers) and
+        the parent turns them into child spans, so flight-record
+        attribution crosses fork() and gRPC. Exported immediately — call
+        while ``parent`` is still OPEN so the recorder's child-sum
+        bookkeeping (parent self-time = wall − children) accounts for it.
+        """
+        span = Span(
+            name=name,
+            trace_id=parent.trace_id,
+            span_id=_new_id(8),
+            parent_id=parent.span_id,
+            tags={k: str(v) for k, v in (tags or {}).items()},
+            counters=dict(counters or {}),
+            sampled=parent.sampled,
+            parent=parent,
+        )
+        span.start = (parent.start or time.time()) + start_offset_s
+        span.end = span.start + duration_s
+        if parent._mono0:
+            span._mono0 = parent._mono0 + start_offset_s
+            span._mono1 = span._mono0 + duration_s
+        self._finish(span)
+        return span
+
     def _finish(self, span: Span) -> None:
         if not span.sampled:
             return

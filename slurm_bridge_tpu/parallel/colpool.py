@@ -53,13 +53,16 @@ frame-build failure inside the worker degrades to a frameless chunk
 from __future__ import annotations
 
 import atexit
+import json
 import logging
 import os
 import struct
 import threading
+import time
 
 import numpy as np
 
+from slurm_bridge_tpu.obs.metrics import REGISTRY
 from slurm_bridge_tpu.wire import coldec
 
 __all__ = [
@@ -70,6 +73,7 @@ __all__ = [
     "diff_signals",
     "empty_prior",
     "reset",
+    "set_obs",
 ]
 
 log = logging.getLogger("sbt.colpool")
@@ -80,12 +84,122 @@ _OP_DECODE_DIFF = 0x03
 _OP_ENCODE_SUBMIT = 0x04
 _OP_BUILD_ROWS = 0x05
 _OP_DIFF_FRAMES = 0x06
+_OP_METRICS = 0x07
 _ST_OK = 0x00
 _ST_DECODE_ERR = 0x01
 _ST_ERROR = 0x02
 
 #: the write-side ops: request body and reply body are writeops frames
 _WRITE_OPS = (_OP_ENCODE_SUBMIT, _OP_BUILD_ROWS)
+
+#: op byte → metric/span label
+_OP_NAMES = {
+    _OP_DECODE: "decode",
+    _OP_SET_PRIOR: "set_prior",
+    _OP_DECODE_DIFF: "decode_diff",
+    _OP_ENCODE_SUBMIT: "encode_submit",
+    _OP_BUILD_ROWS: "build_rows",
+    _OP_DIFF_FRAMES: "diff_frames",
+    _OP_METRICS: "metrics",
+}
+
+#: request framing (ISSUE 20): op byte + the parent's monotonic_ns send
+#: stamp. CLOCK_MONOTONIC is system-wide on Linux and the workers are
+#: fork()ed on the same host, so worker recv stamp − this = queue wait.
+_REQ = struct.Struct("<Bq")
+_REQ_OFF = _REQ.size
+#: reply timing header (ISSUE 20): queue-wait ns, op ns, body bytes in,
+#: body bytes out — fixed width, after the status byte on EVERY reply
+#: (errors included), so the parent strips it unconditionally.
+_THDR = struct.Struct("<qqqq")
+_RESP_OFF = 1 + _THDR.size
+
+# -- parent-side worker self-timing (folded from the reply headers) ------
+
+_busy_seconds = REGISTRY.counter(
+    "sbt_colpool_worker_busy_seconds_total",
+    "worker-side op compute time by op, from the reply timing headers",
+)
+_queue_wait_seconds = REGISTRY.counter(
+    "sbt_colpool_queue_wait_seconds_total",
+    "request time spent queued in worker pipes, by op",
+)
+_bytes_total = REGISTRY.counter(
+    "sbt_colpool_bytes_total",
+    "frame payload bytes through the pool, by op and direction",
+)
+_chunks_total = REGISTRY.counter(
+    "sbt_colpool_chunks_total", "chunks served by the pool, by op"
+)
+
+#: parent-side fold switch (ISSUE 20): headers always ride the frames —
+#: the workers need no config — but metric/span folding can be disabled
+#: (the paired profile_fleet_obs_overhead off-arm).
+_OBS_ENABLED = True
+
+
+def set_obs(enabled: bool) -> None:
+    """Enable/disable parent-side folding of worker timing headers into
+    metrics + synthetic ``colpool.<op>`` spans. Digest-neutral either
+    way; the off-arm exists for the paired overhead profile."""
+    global _OBS_ENABLED
+    _OBS_ENABLED = bool(enabled)
+
+
+class _OpStats:
+    """Per-batch accumulator for reply timing headers (thread-safe: the
+    fan-out threads all add to the one batch's stats)."""
+
+    __slots__ = ("queue_ns", "op_ns", "bytes_in", "bytes_out", "chunks", "_lock")
+
+    def __init__(self):
+        self.queue_ns = 0
+        self.op_ns = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.chunks = 0
+        self._lock = threading.Lock()
+
+    def add(self, queue_ns: int, op_ns: int, bi: int, bo: int) -> None:
+        with self._lock:
+            self.queue_ns += queue_ns
+            self.op_ns += op_ns
+            self.bytes_in += bi
+            self.bytes_out += bo
+            self.chunks += 1
+
+
+def _fold_op(label: str, stats: _OpStats, wall_s: float) -> None:
+    """Fold one batch's worker timing into the parent's metrics and — when
+    an ambient sampled span is open (the flight recorder's per-tick
+    window) — a synthetic ``colpool.<label>`` child span whose duration is
+    the summed worker busy time. Queue wait, wall time and byte volumes
+    ride as counters; nothing here enters determinism digests."""
+    if not _OBS_ENABLED or stats.chunks == 0:
+        return
+    busy_s = stats.op_ns / 1e9
+    queue_s = stats.queue_ns / 1e9
+    _busy_seconds.inc(busy_s, op=label)
+    _queue_wait_seconds.inc(queue_s, op=label)
+    _bytes_total.inc(float(stats.bytes_in), op=label, direction="in")
+    _bytes_total.inc(float(stats.bytes_out), op=label, direction="out")
+    _chunks_total.inc(float(stats.chunks), op=label)
+    from slurm_bridge_tpu.obs.tracing import TRACER
+
+    parent = TRACER.current()
+    if parent is not None and parent.sampled:
+        TRACER.emit_synthetic(
+            f"colpool.{label}",
+            parent=parent,
+            duration_s=busy_s,
+            counters={
+                "chunks": float(stats.chunks),
+                "queue_wait_ms": queue_s * 1e3,
+                "wall_ms": wall_s * 1e3,
+                "bytes_in": float(stats.bytes_in),
+                "bytes_out": float(stats.bytes_out),
+            },
+        )
 
 #: response-frame column order for the fixed int64 block (length = rows
 #: each); must match JobsInfoChunk's numeric slots
@@ -254,6 +368,21 @@ def decode_serial(blobs: list[bytes]) -> list:
 
 
 def _worker_main(conn) -> None:  # pragma: no cover - runs in the child
+    # Fork hygiene (ISSUE 20): the child inherits the parent's registry
+    # (and every total it had accumulated) by COW. Swap in a FRESH
+    # registry instead of resetting in place: a parent thread may have
+    # held a metric lock at fork time, so touching inherited locks here
+    # could deadlock the worker before it serves its first op. Anything
+    # the worker registers from now on lands on the clean registry, so a
+    # worker-side scrape (_OP_METRICS) can never double-count parent
+    # totals.
+    from slurm_bridge_tpu.obs import metrics as _obs_metrics
+
+    _obs_metrics.REGISTRY = _registry = _obs_metrics.MetricsRegistry()
+    _ops_served = _registry.counter(
+        "sbt_colpool_worker_ops_total",
+        "ops served by this forked colpool worker, by op",
+    )
     prior: dict | None = None
     while True:
         try:
@@ -262,13 +391,17 @@ def _worker_main(conn) -> None:  # pragma: no cover - runs in the child
             break
         if not frame:
             break  # shutdown sentinel
+        recv_ns = time.monotonic_ns()
         op = frame[0]
+        (sent_ns,) = struct.unpack_from("<q", frame, 1)
+        body_in = len(frame) - _REQ_OFF
+        t0 = time.monotonic_ns()
         try:
             if op == _OP_SET_PRIOR:
-                prior = _unpack_prior(memoryview(frame)[1:])
-                out = bytes([_ST_OK])
+                prior = _unpack_prior(memoryview(frame)[_REQ_OFF:])
+                st, body = _ST_OK, b""
             elif op in (_OP_DECODE, _OP_DECODE_DIFF, _OP_DIFF_FRAMES):
-                blob = frame[1:]
+                blob = frame[_REQ_OFF:]
                 chunk = coldec.decode_jobs_info(blob)
                 body = _pack_chunk(chunk)
                 if op == _OP_DECODE_DIFF:
@@ -296,7 +429,7 @@ def _worker_main(conn) -> None:  # pragma: no cover - runs in the child
                         # parent materialize spans as before
                         cf = b""
                     body += struct.pack("<q", len(cf)) + cf
-                out = bytes([_ST_OK]) + body
+                st = _ST_OK
             elif op in _WRITE_OPS:
                 # lazy: the ops only need writeops once a write-side
                 # caller engages; a decode-only worker never imports it
@@ -308,19 +441,33 @@ def _worker_main(conn) -> None:  # pragma: no cover - runs in the child
                     else writeops.build_rows_frame
                 )
                 try:
-                    out = bytes([_ST_OK]) + fn(memoryview(frame)[1:])
+                    st, body = _ST_OK, fn(memoryview(frame)[_REQ_OFF:])
                 except Exception as e:
                     # payload problem (malformed array spec, bad utf8):
                     # per-chunk like a DecodeError — the caller reruns
                     # its serial arm, which raises the real exception in
                     # context; the pool itself stays healthy
-                    out = bytes([_ST_DECODE_ERR]) + repr(e).encode("utf-8")
+                    st, body = _ST_DECODE_ERR, repr(e).encode("utf-8")
+            elif op == _OP_METRICS:
+                # debug scrape: this worker's own (post-fork) counters
+                st = _ST_OK
+                body = json.dumps({
+                    "pid": os.getpid(),
+                    "counters": _registry.counter_totals(),
+                }).encode("utf-8")
             else:
-                out = bytes([_ST_ERROR]) + f"unknown op {op}".encode()
+                st, body = _ST_ERROR, f"unknown op {op}".encode()
         except coldec.DecodeError as e:
-            out = bytes([_ST_DECODE_ERR]) + str(e).encode("utf-8")
+            st, body = _ST_DECODE_ERR, str(e).encode("utf-8")
         except BaseException as e:
-            out = bytes([_ST_ERROR]) + repr(e).encode("utf-8")
+            st, body = _ST_ERROR, repr(e).encode("utf-8")
+        op_ns = time.monotonic_ns() - t0
+        _ops_served.inc(1.0, op=_OP_NAMES.get(op, str(op)))
+        out = (
+            bytes([st])
+            + _THDR.pack(max(0, recv_ns - sent_ns), op_ns, body_in, len(body))
+            + body
+        )
         try:
             conn.send_bytes(out)
         except (BrokenPipeError, OSError):
@@ -349,34 +496,39 @@ class _WriteJob:
 
     def __init__(self, pool: "ColPool", op: int, chunks: list, pack_fn):
         self._pool = pool
+        self._op = op
+        self._stats = _OpStats()
+        self._t0 = time.perf_counter()
         n = len(chunks)
         self._results: list = [None] * n
         self._infra: list[BaseException] = []
         self._payload: list[str] = []
         width = min(pool.width, n)
-        opb = bytes([op])
 
         def run(w: int) -> None:
             try:
                 for i in range(w, n, width):
                     try:
-                        frame = opb + pack_fn(chunks[i])
+                        body = pack_fn(chunks[i])
                     except Exception as e:
                         # pack blew up on chunk data: a payload problem,
                         # not pool infrastructure — serial arm re-raises
                         self._payload.append(repr(e))
                         return
-                    resp = self._pool._round_trip(w, frame)
-                    st = resp[0]
+                    st, rbody = self._pool._round_trip(
+                        w, op, body, self._stats
+                    )
                     if st == _ST_OK:
-                        self._results[i] = resp[1:]
+                        self._results[i] = bytes(rbody)
                     elif st == _ST_DECODE_ERR:
                         self._payload.append(
-                            resp[1:].decode("utf-8", "replace")
+                            bytes(rbody).decode("utf-8", "replace")
                         )
                         return
                     else:
-                        raise PoolBroken(resp[1:].decode("utf-8", "replace"))
+                        raise PoolBroken(
+                            bytes(rbody).decode("utf-8", "replace")
+                        )
             except (EOFError, OSError, IndexError, PoolBroken) as e:
                 self._infra.append(e)
 
@@ -403,6 +555,13 @@ class _WriteJob:
                 self._payload[0],
             )
             return None
+        # fold at collect time: the waiting thread carries the ambient
+        # span (the kicking thread may have moved on long ago)
+        _fold_op(
+            _OP_NAMES.get(self._op, str(self._op)),
+            self._stats,
+            time.perf_counter() - self._t0,
+        )
         return self._results
 
 
@@ -476,15 +635,26 @@ class ColPool:
 
     # -- ops --
 
-    def _round_trip(self, w: int, frame: bytes) -> bytes:
+    def _round_trip(
+        self, w: int, op: int, body: bytes, stats: _OpStats | None = None
+    ) -> tuple[int, memoryview]:
+        """One request/reply exchange with worker ``w`` — the single choke
+        point for ALL pool traffic. Stamps the request with monotonic_ns
+        (the worker derives queue wait from it), strips the reply's fixed
+        timing header into ``stats``, and returns ``(status, body view)``."""
         conn = self._conns[w]
+        frame = _REQ.pack(op, time.monotonic_ns()) + body
         with self._locks[w]:
             conn.send_bytes(frame)
-            return conn.recv_bytes()
+            resp = conn.recv_bytes()
+        if stats is not None:
+            queue_ns, op_ns, bi, bo = _THDR.unpack_from(resp, 1)
+            stats.add(queue_ns, op_ns, bi, bo)
+        return resp[0], memoryview(resp)[_RESP_OFF:]
 
     def _run_op(
         self, op: int, blobs: list[bytes], with_mask: bool,
-        with_frame: bool = False,
+        with_frame: bool = False, stats: _OpStats | None = None,
     ) -> list:
         """Fan ``blobs`` across the workers (round-robin by index) and
         collect per-blob results in request order: JobsInfoChunk (or
@@ -498,9 +668,7 @@ class ColPool:
         def run(w: int) -> None:
             try:
                 for i in range(w, len(blobs), width):
-                    resp = self._round_trip(w, bytes([op]) + blobs[i])
-                    st = resp[0]
-                    body = memoryview(resp)[1:]
+                    st, body = self._round_trip(w, op, blobs[i], stats)
                     if st == _ST_DECODE_ERR:
                         results[i] = coldec.DecodeError(
                             bytes(body).decode("utf-8", "replace")
@@ -539,7 +707,9 @@ class ColPool:
             raise PoolBroken(str(errors[0]))
         return results
 
-    def _run_frames(self, op: int, frames: list[bytes]) -> list[bytes]:
+    def _run_frames(
+        self, op: int, frames: list[bytes], stats: _OpStats | None = None
+    ) -> list[bytes]:
         """Fan pre-packed write-op frames across the workers (round-robin
         by index, like :meth:`_run_op`) and collect per-frame reply bytes
         in request order. Raises :class:`PoolBroken` on infrastructure
@@ -553,15 +723,14 @@ class ColPool:
         def run(w: int) -> None:
             try:
                 for i in range(w, len(frames), width):
-                    resp = self._round_trip(w, bytes([op]) + frames[i])
-                    st = resp[0]
+                    st, body = self._round_trip(w, op, frames[i], stats)
                     if st == _ST_OK:
-                        results[i] = resp[1:]
+                        results[i] = bytes(body)
                     elif st == _ST_DECODE_ERR:
-                        payload.append(resp[1:].decode("utf-8", "replace"))
+                        payload.append(bytes(body).decode("utf-8", "replace"))
                         return
                     else:
-                        raise PoolBroken(resp[1:].decode("utf-8", "replace"))
+                        raise PoolBroken(bytes(body).decode("utf-8", "replace"))
             except (EOFError, OSError, IndexError, PoolBroken) as e:
                 infra.append(e)
 
@@ -589,8 +758,12 @@ class ColPool:
             return []
         if not self._ensure():
             return None
+        stats = _OpStats()
+        t0 = time.perf_counter()
         try:
-            return self._run_frames(_OP_ENCODE_SUBMIT, frames)
+            out = self._run_frames(_OP_ENCODE_SUBMIT, frames, stats)
+            _fold_op("encode_submit", stats, time.perf_counter() - t0)
+            return out
         except PoolBroken as e:
             log.warning(
                 "colpool broken; write ops inline from now on: %s", e
@@ -623,8 +796,12 @@ class ColPool:
             return []
         if not self._ensure():
             return decode_serial(blobs)
+        stats = _OpStats()
+        t0 = time.perf_counter()
         try:
-            return self._run_op(_OP_DECODE, blobs, with_mask=False)
+            out = self._run_op(_OP_DECODE, blobs, with_mask=False, stats=stats)
+            _fold_op("decode", stats, time.perf_counter() - t0)
+            return out
         except PoolBroken as e:
             log.warning("colpool broken; decoding inline from now on: %s", e)
             self._break()
@@ -644,14 +821,20 @@ class ColPool:
                 else (r, diff_signals(r, prior))
                 for r in decode_serial(blobs)
             ]
+        stats = _OpStats()
+        t0 = time.perf_counter()
         try:
-            pframe = bytes([_OP_SET_PRIOR]) + _pack_prior(prior)
+            pbody = _pack_prior(prior)
             width = min(self.width, len(blobs))
             for w in range(width):
-                resp = self._round_trip(w, pframe)
-                if resp[0] != _ST_OK:
-                    raise PoolBroken(resp[1:].decode("utf-8", "replace"))
-            return self._run_op(_OP_DECODE_DIFF, blobs, with_mask=True)
+                st, body = self._round_trip(w, _OP_SET_PRIOR, pbody, stats)
+                if st != _ST_OK:
+                    raise PoolBroken(bytes(body).decode("utf-8", "replace"))
+            out = self._run_op(
+                _OP_DECODE_DIFF, blobs, with_mask=True, stats=stats
+            )
+            _fold_op("decode_diff", stats, time.perf_counter() - t0)
+            return out
         except (PoolBroken, EOFError, OSError) as e:
             # raw pipe death in the SET_PRIOR round-trips (workers died
             # between ops) is the same infra failure _run_op reports as
@@ -678,18 +861,40 @@ class ColPool:
             return []
         if not self._ensure():
             return None
+        stats = _OpStats()
+        t0 = time.perf_counter()
         try:
-            pframe = bytes([_OP_SET_PRIOR]) + _pack_prior(prior)
+            pbody = _pack_prior(prior)
             width = min(self.width, len(blobs))
             for w in range(width):
-                resp = self._round_trip(w, pframe)
-                if resp[0] != _ST_OK:
-                    raise PoolBroken(resp[1:].decode("utf-8", "replace"))
-            return self._run_op(
-                _OP_DIFF_FRAMES, blobs, with_mask=False, with_frame=True
+                st, body = self._round_trip(w, _OP_SET_PRIOR, pbody, stats)
+                if st != _ST_OK:
+                    raise PoolBroken(bytes(body).decode("utf-8", "replace"))
+            out = self._run_op(
+                _OP_DIFF_FRAMES, blobs, with_mask=False, with_frame=True,
+                stats=stats,
             )
+            _fold_op("diff_frames", stats, time.perf_counter() - t0)
+            return out
         except (PoolBroken, EOFError, OSError) as e:
             log.warning("colpool broken; decoding inline from now on: %s", e)
+            self._break()
+            return None
+
+    def worker_metrics(self, w: int = 0) -> dict | None:
+        """Counter snapshot from worker ``w``'s own post-fork registry
+        (``{"pid": ..., "counters": {...}}``) — the fork-hygiene probe:
+        a freshly forked worker must NOT report the parent's inherited
+        totals. Returns ``None`` when the pool can't serve."""
+        if not self._ensure() or w >= self.width:
+            return None
+        try:
+            st, body = self._round_trip(w, _OP_METRICS, b"")
+            if st != _ST_OK:
+                return None
+            return json.loads(bytes(body).decode("utf-8"))
+        except (EOFError, OSError) as e:
+            log.warning("colpool broken; metrics probe failed: %s", e)
             self._break()
             return None
 

@@ -461,6 +461,31 @@ def _quality(label: str = "quality-smoke") -> int:
     return 0
 
 
+def _stitch_coverage(result) -> tuple[float, float]:
+    """Summed ``(stitched child ms, total ms)`` over every
+    ``rpc.client.PlaceShard`` node in the run's per-tick flight trees —
+    the ISSUE 20 trace-coverage gate's numerator and denominator. The
+    children are the synthetic ``sidecar.*`` phase spans plus the
+    ``rpc.overhead`` residual the stitching hook fabricates while the
+    client span is still open."""
+    covered = 0.0
+    total = 0.0
+
+    def walk(name: str, node: dict) -> None:
+        nonlocal covered, total
+        if name == "rpc.client.PlaceShard":
+            total += node.get("ms", 0.0)
+            for child in node.get("children", {}).values():
+                covered += child.get("ms", 0.0)
+        for child_name, child in node.get("children", {}).items():
+            walk(child_name, child)
+
+    for rec in result.flight_ticks:
+        for name, node in rec.get("tree", {}).items():
+            walk(name, node)
+    return covered, total
+
+
 def _fleet(label: str = "fleet-smoke") -> int:
     """The fleet gate (ISSUE 17): each fleet scenario runs TWICE
     (double-run determinism — membership facts included), then its
@@ -476,7 +501,12 @@ def _fleet(label: str = "fleet-smoke") -> int:
     - **chaos** (``fleet_kill_owner``): the kill actually happened, the
       dead replica's sidecar was re-adopted (``live_final`` back to
       full strength) within ``max_recovery_ticks``, and zero
-      VirtualNode deletions (no node flap from a fleet event).
+      VirtualNode deletions (no node flap from a fleet event);
+    - **trace coverage** (ISSUE 20): ≥95% of every
+      ``rpc.client.PlaceShard`` span's wall time is attributed to the
+      stitched synthetic children (``sidecar.decode/solve/encode`` +
+      the ``rpc.overhead`` residual) — unexplained client-span time
+      means the stitching hook fell off the RPC path.
     """
     from slurm_bridge_tpu.sim.faults import FLEET_KINDS
 
@@ -511,6 +541,29 @@ def _fleet(label: str = "fleet-smoke") -> int:
             failures.append(
                 f"{name}: fleet attached but remote_solves == 0 — every "
                 "shard solved inline, the gRPC path never engaged"
+            )
+        covered, total = _stitch_coverage(a)
+        coverage = covered / total if total > 0 else 0.0
+        print(json.dumps({
+            "scenario": f"{name}[trace-stitching]",
+            "place_shard_ms": round(total, 3),
+            "stitched_ms": round(covered, 3),
+            "coverage": round(coverage, 4),
+            "fleet_timeline_events": len(
+                (a.flight_record.get("fleet") or {}).get("timeline", [])
+            ),
+        }))
+        if remote.get("remote_solves") and total > 0 and coverage < 0.95:
+            failures.append(
+                f"{name}: trace stitching covered {coverage:.1%} of "
+                "rpc.client.PlaceShard wall time (floor 95%) — the "
+                "synthetic sidecar children + rpc.overhead residual "
+                "left client-span time unexplained"
+            )
+        if not (a.flight_record.get("fleet") or {}).get("timeline"):
+            failures.append(
+                f"{name}: flight record carries no fleet lifecycle "
+                "timeline — spawn/ready events never recorded"
             )
         twin = run_scenario(
             dataclasses.replace(

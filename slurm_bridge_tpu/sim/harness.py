@@ -256,6 +256,11 @@ class Scenario:
     #: owner's sidecar over real gRPC (byte-parity with inline — the
     #: fleet twin gate proves it). None = single-process, zero overhead
     fleet: object | None = None
+    #: fleet-wide observability (ISSUE 20): PlaceShard trace stitching,
+    #: colpool worker self-timing folds, metrics federation and the
+    #: lifecycle timeline. Digest-neutral either way; False is the
+    #: control arm of the paired profile_fleet_obs_overhead gate
+    fleet_obs: bool = True
 
 
 @dataclass
@@ -455,6 +460,10 @@ class SimHarness:
         #: fleet runtime (ISSUE 17) — built after the state dir below;
         #: None until then so _build_stack's attach guard no-ops
         self.fleet = None
+        #: ISSUE 20: parent-side folding of colpool worker timing headers
+        #: follows the scenario's obs arm (headers always ride the wire;
+        #: _cleanup restores the process default)
+        colpool.set_obs(scenario.fleet_obs)
         self._build_stack()
         #: the tick flight recorder — always-on unless the scenario opts
         #: out (the overhead gate's control arm); every run_tick is one
@@ -564,7 +573,8 @@ class SimHarness:
             # sidecar spawn/handshake is wall-time OS work, like any
             # other subprocess the harness owns
             self.fleet = FleetRuntime(
-                scenario.fleet, self._state_dir, clock=lambda: self.vt
+                scenario.fleet, self._state_dir, clock=lambda: self.vt,
+                obs=scenario.fleet_obs,
             )
             self.fleet.start()
             self._attach_fleet()
@@ -1580,6 +1590,7 @@ class SimHarness:
         # path restarts the bridge stack, not the process, and keeps its
         # warm workers.)
         colpool.reset()
+        colpool.set_obs(True)  # restore the process default obs arm
 
     # ---- the full run ----
 
@@ -1862,13 +1873,19 @@ class SimHarness:
             # quality section only; the fleet smoke asserts
             # remote_solves > 0 here so a silently-inline run fails
             policy_extra["fleet_remote"] = self.fleet.remote_stats()
+        flight_record = self.flight.aggregate()
+        if self.fleet is not None and self.scenario.fleet_obs:
+            # ISSUE 20: lifecycle timeline + federated per-replica
+            # counters ride the flight record (volatile, never digested)
+            # so scenario JSON and /debug/fleetz read the same story
+            flight_record["fleet"] = self.fleet.fleet_section()
         result = ScenarioResult(
             scenario=sc,
             determinism=determinism,
             timing=timing,
             shape=shape,
             quality=self.quality.scorecard(total_ticks, extra=policy_extra),
-            flight_record=self.flight.aggregate(),
+            flight_record=flight_record,
             flight_ticks=list(self.flight.records),
         )
         return result

@@ -16,6 +16,7 @@ cmd/slurm-agent/slurm-agent.go:33-47).
 from __future__ import annotations
 
 import inspect
+import logging
 import random
 import time
 from dataclasses import dataclass
@@ -309,6 +310,24 @@ class RetryingClient:
         return call
 
 
+#: method name → ``fn(client_span, response)``, invoked while the
+#: ``rpc.client.<Method>`` span is still OPEN (ISSUE 20 trace stitching):
+#: the fleet runtime registers a PlaceShard hook that turns the response's
+#: worker-side timing summary into synthetic child spans, so the flight
+#: recorder's child-sum bookkeeping attributes the round-trip. Hook
+#: failures are swallowed — stitching must never break an RPC.
+_CLIENT_SPAN_HOOKS: dict = {}
+
+
+def set_client_span_hook(method_name: str, hook) -> None:
+    """Register (or, with ``hook=None``, clear) a per-method client-span
+    response hook. Process-wide, last writer wins."""
+    if hook is None:
+        _CLIENT_SPAN_HOOKS.pop(method_name, None)
+    else:
+        _CLIENT_SPAN_HOOKS[method_name] = hook
+
+
 def _traced_call(method_name: str, multicallable, unary: bool):
     """Wrap a multicallable with trace propagation: when the caller is
     inside an active span, a ``traceparent`` metadata entry rides the RPC
@@ -336,7 +355,16 @@ def _traced_call(method_name: str, multicallable, unary: bool):
             md = tuple(metadata or ()) + (
                 ("traceparent", format_traceparent(span)),
             )
-            return multicallable(request, timeout=timeout, metadata=md)
+            response = multicallable(request, timeout=timeout, metadata=md)
+            hook = _CLIENT_SPAN_HOOKS.get(method_name)
+            if hook is not None:
+                try:
+                    hook(span, response)
+                except Exception:
+                    logging.getLogger("sbt.rpc").exception(
+                        "client span hook for %s failed", method_name
+                    )
+            return response
 
     return call
 

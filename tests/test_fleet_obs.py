@@ -1,0 +1,461 @@
+"""Fleet-wide observability (ISSUE 20): cross-process trace stitching,
+colpool worker self-timing, metrics federation, and the lifecycle
+timeline.
+
+Layering mirrors the subsystem: pure stitching math first (fabricated
+spans, no processes), then the federation/timeline rendering surfaces
+(fabricated events, no live supervisor — the flight record's ``fleet``
+section must be enough to read a post-mortem), then colpool timing
+headers + fork hygiene under a forced 2-worker pool, then the real
+sidecar round-trip (worker phase timing, Healthz metric arrays, the
+bridge-scrape ``replica`` label).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from slurm_bridge_tpu.fleet.runtime import (
+    FleetConfig,
+    FleetRuntime,
+    render_timeline,
+    stitch_place_shard,
+)
+from slurm_bridge_tpu.obs.metrics import REGISTRY
+from slurm_bridge_tpu.obs.tracing import TRACER, InMemoryExporter
+from slurm_bridge_tpu.wire import workload_pb2 as pb
+
+from tests.test_fleet import _Clock, _shape
+
+# --------------------------------------------------------------------------
+# trace stitching (pure; fabricated spans, no processes)
+# --------------------------------------------------------------------------
+
+
+def _fake_response(decode_ns=1_000_000, solve_ns=2_000_000,
+                   encode_ns=500_000, rows=42) -> pb.PlaceShardResponse:
+    return pb.PlaceShardResponse(
+        decode_ns=decode_ns, solve_ns=solve_ns, encode_ns=encode_ns, rows=rows
+    )
+
+
+def test_stitch_emits_phase_children_and_named_residual():
+    mem = InMemoryExporter()
+    with TRACER.recording(mem):
+        with TRACER.span("rpc.client.PlaceShard") as span:
+            time.sleep(0.01)  # client-observed wall the residual must cover
+            stitch_place_shard(span, _fake_response())
+    by_name = {s.name: s for s in mem.spans}
+    for name, ns in (
+        ("sidecar.decode", 1_000_000),
+        ("sidecar.solve", 2_000_000),
+        ("sidecar.encode", 500_000),
+    ):
+        child = by_name[name]
+        assert child.parent_id == by_name["rpc.client.PlaceShard"].span_id
+        assert child.trace_id == by_name["rpc.client.PlaceShard"].trace_id
+        assert child.duration == pytest.approx(ns / 1e9, rel=1e-6)
+    assert by_name["sidecar.solve"].counters["rows"] == 42.0
+    # the residual is NAMED, sequenced after the phases, and covers
+    # everything the sidecar did not account for
+    residual = by_name["rpc.overhead"]
+    assert residual.parent_id == by_name["rpc.client.PlaceShard"].span_id
+    parent = by_name["rpc.client.PlaceShard"]
+    phase_s = 3.5e-3
+    assert residual.duration == pytest.approx(
+        parent.duration - phase_s, abs=parent.duration * 0.5
+    )
+    assert residual.duration > 0
+
+
+def test_stitch_coverage_within_client_span_wall():
+    """≥95% of the client span's wall time must be attributed to the
+    synthetic children + residual — the same arithmetic the fleet-smoke
+    trace-coverage gate runs over the flight trees."""
+    mem = InMemoryExporter()
+    with TRACER.recording(mem):
+        with TRACER.span("rpc.client.PlaceShard") as span:
+            time.sleep(0.005)
+            stitch_place_shard(span, _fake_response())
+    by_name = {s.name: s for s in mem.spans}
+    parent = by_name["rpc.client.PlaceShard"]
+    children_s = sum(
+        s.duration for s in mem.spans
+        if s.parent_id == parent.span_id
+    )
+    assert children_s / parent.duration >= 0.95
+    # children never exceed the parent wall (residual is clamped)
+    assert children_s <= parent.duration * 1.01
+
+
+def test_stitch_skips_pre_issue20_response():
+    """A sidecar without the timing summary (all ns zero) stitches
+    nothing — no fabricated zero-width spans, no residual."""
+    mem = InMemoryExporter()
+    with TRACER.recording(mem):
+        with TRACER.span("rpc.client.PlaceShard") as span:
+            stitch_place_shard(span, pb.PlaceShardResponse())
+    assert [s.name for s in mem.spans] == ["rpc.client.PlaceShard"]
+
+
+def test_client_span_hook_registry_set_and_clear():
+    from slurm_bridge_tpu.wire.rpc import (
+        _CLIENT_SPAN_HOOKS,
+        set_client_span_hook,
+    )
+
+    calls = []
+    set_client_span_hook("PlaceShard", lambda s, r: calls.append((s, r)))
+    try:
+        assert "PlaceShard" in _CLIENT_SPAN_HOOKS
+    finally:
+        set_client_span_hook("PlaceShard", None)
+    assert "PlaceShard" not in _CLIENT_SPAN_HOOKS
+
+
+# --------------------------------------------------------------------------
+# lifecycle timeline + federation rendering (no live supervisor)
+# --------------------------------------------------------------------------
+
+#: a kill/backoff/restart story as the flight record's ``fleet`` section
+#: carries it — what a post-mortem loads with no process alive
+_TIMELINE = [
+    {"tick": -1, "event": "spawn", "replica": "replica-0", "detail": ""},
+    {"tick": -1, "event": "ready", "replica": "replica-0",
+     "detail": "incarnation=replica-0.1"},
+    {"tick": 7, "event": "kill", "replica": "replica-0",
+     "detail": "chaos: SIGKILL"},
+    {"tick": 7, "event": "dead", "replica": "replica-0",
+     "detail": "process exited"},
+    {"tick": 7, "event": "backoff", "replica": "replica-0",
+     "detail": "restart eligible at tick 9"},
+    {"tick": 7, "event": "rekey", "replica": "",
+     "detail": "live=['replica-1', 'replica-2']"},
+    {"tick": 9, "event": "restart", "replica": "replica-0",
+     "detail": "incarnation=replica-0.2"},
+    {"tick": 9, "event": "rekey", "replica": "",
+     "detail": "live=['replica-0', 'replica-1', 'replica-2']"},
+]
+
+
+def test_render_timeline_dead_backoff_rekey_states():
+    text = render_timeline(_TIMELINE)
+    lines = text.splitlines()
+    assert len(lines) == len(_TIMELINE)
+    # startup events render as "startup", tick events carry the tick
+    assert "startup" in lines[0] and "spawn" in lines[0]
+    assert "tick    7" in lines[3] and "dead" in lines[3]
+    assert "restart eligible at tick 9" in lines[4]
+    assert "rekey" in lines[5] and "replica-1" in lines[5]
+    assert "tick    9" in lines[6] and "incarnation=replica-0.2" in lines[6]
+
+
+def test_render_timeline_limit_keeps_newest():
+    text = render_timeline(_TIMELINE, limit=2)
+    assert len(text.splitlines()) == 2
+    assert "restart" in text and "rekey" in text
+    assert "spawn" not in text
+
+
+def test_fleet_section_roundtrips_through_json():
+    """The flight record's ``fleet`` section is plain JSON — loading it
+    back renders the identical timeline, so scenario artifacts are a
+    complete post-mortem source with no live runtime."""
+    section = {
+        "timeline": _TIMELINE,
+        "replica_counters": {
+            "replica-0": {"sbt_sidecar_place_shards_total": 12.0},
+        },
+    }
+    loaded = json.loads(json.dumps(section))
+    assert render_timeline(loaded["timeline"]) == render_timeline(_TIMELINE)
+    assert loaded["replica_counters"]["replica-0"][
+        "sbt_sidecar_place_shards_total"
+    ] == 12.0
+
+
+def test_replica_collector_renders_federated_labels():
+    """A runtime with a federated snapshot renders
+    ``sbt_fleet_replica_<suffix>{replica=...}`` on the bridge scrape —
+    snapshot-sourced, so the scrape itself costs no RPC."""
+    with tempfile.TemporaryDirectory() as d:
+        rt = FleetRuntime(FleetConfig(replicas=0), d, clock=_Clock())
+        try:
+            rt._federated = {
+                "replica-0": {
+                    "sbt_sidecar_place_shards_total": 3.0,
+                    "sbt_sidecar_rows_total": 120.0,
+                },
+                "replica-1": {"sbt_sidecar_place_shards_total": 5.0},
+            }
+            page = REGISTRY.render()
+            assert (
+                'sbt_fleet_replica_sidecar_place_shards_total'
+                '{replica="replica-0"} 3.0' in page
+            )
+            assert (
+                'sbt_fleet_replica_sidecar_place_shards_total'
+                '{replica="replica-1"} 5.0' in page
+            )
+            assert (
+                'sbt_fleet_replica_sidecar_rows_total'
+                '{replica="replica-0"} 120.0' in page
+            )
+            assert "# TYPE sbt_fleet_replica_sidecar_rows_total counter" in page
+        finally:
+            rt.close()
+    # deregistered with the runtime: the label vanishes from the scrape
+    assert 'replica="replica-0"' not in REGISTRY.render()
+
+
+def test_obs_off_runtime_records_no_timeline():
+    with tempfile.TemporaryDirectory() as d:
+        rt = FleetRuntime(
+            FleetConfig(replicas=0), d, clock=_Clock(), obs=False
+        )
+        try:
+            rt._record(3, "dead", "replica-0", "x")
+            assert rt.timeline() == []
+            assert rt.fleet_section() == {
+                "timeline": [], "replica_counters": {}
+            }
+        finally:
+            rt.close()
+
+
+# --------------------------------------------------------------------------
+# colpool worker self-timing + fork hygiene (forced 2-worker pool)
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def pool(monkeypatch):
+    from slurm_bridge_tpu.parallel import colpool
+
+    monkeypatch.setenv("SBT_COLPOOL_WORKERS", "2")
+    colpool.reset()
+    p = colpool.active_pool()
+    assert p is not None and p.width == 2
+    yield p
+    colpool.reset()
+    colpool.set_obs(True)
+
+
+def _blobs(n=4, seed=7):
+    from tests.test_coldec import _random_response
+
+    rng = np.random.default_rng(seed)
+    return [_random_response(rng).SerializeToString() for _ in range(n)]
+
+
+def test_colpool_reply_headers_fold_into_metrics(pool):
+    before = REGISTRY.counter_totals()
+    out = pool.decode_jobs_info_many(_blobs())
+    assert len(out) == 4
+    after = REGISTRY.counter_totals()
+
+    def delta(name):
+        return after.get(name, 0.0) - before.get(name, 0.0)
+
+    assert delta("sbt_colpool_chunks_total") == 4.0
+    assert delta("sbt_colpool_worker_busy_seconds_total") > 0.0
+    assert delta("sbt_colpool_queue_wait_seconds_total") >= 0.0
+    assert delta("sbt_colpool_bytes_total") > 0.0
+
+
+def test_colpool_emits_synthetic_op_span_under_ambient(pool):
+    mem = InMemoryExporter()
+    with TRACER.recording(mem):
+        with TRACER.span("sim.tick") as root:
+            pool.decode_jobs_info_many(_blobs())
+    op_spans = [s for s in mem.spans if s.name == "colpool.decode"]
+    assert len(op_spans) == 1
+    span = op_spans[0]
+    assert span.parent_id == root.span_id
+    assert span.counters["chunks"] == 4.0
+    assert span.counters["bytes_in"] > 0
+    assert span.counters["bytes_out"] > 0
+    assert span.counters["wall_ms"] >= span.duration * 1e3 * 0.5
+    # worker busy time can never exceed the batch wall time
+    assert span.duration * 1e3 <= span.counters["wall_ms"] * 2.01
+
+
+def test_colpool_set_obs_off_suppresses_folding(pool):
+    from slurm_bridge_tpu.parallel import colpool
+
+    colpool.set_obs(False)
+    before = REGISTRY.counter_totals()
+    mem = InMemoryExporter()
+    with TRACER.recording(mem):
+        with TRACER.span("sim.tick"):
+            out = pool.decode_jobs_info_many(_blobs())
+    assert len(out) == 4  # results unaffected: headers still ride the wire
+    after = REGISTRY.counter_totals()
+    assert after.get("sbt_colpool_chunks_total", 0.0) == before.get(
+        "sbt_colpool_chunks_total", 0.0
+    )
+    assert not [s for s in mem.spans if s.name.startswith("colpool.")]
+
+
+def test_colpool_forked_worker_has_fresh_metrics_registry(pool):
+    """Fork hygiene: the worker swaps in a fresh MetricsRegistry first
+    thing post-fork, so its scrape can never double-count the parent's
+    totals — only counters created in the worker itself appear."""
+    # make the parent registry loudly nonzero before probing
+    pool.decode_jobs_info_many(_blobs())
+    parent_totals = REGISTRY.counter_totals()
+    assert parent_totals.get("sbt_colpool_chunks_total", 0.0) > 0.0
+    m = pool.worker_metrics(0)
+    assert m is not None
+    import os
+
+    assert m["pid"] != os.getpid()
+    # nothing inherited: the only counters are worker-created ones
+    assert set(m["counters"]) == {"sbt_colpool_worker_ops_total"}
+    assert m["counters"]["sbt_colpool_worker_ops_total"] >= 1.0
+
+
+def test_colpool_timing_headers_ride_every_reply(pool):
+    """The fixed-width header is on EVERY reply — error replies too —
+    so the parent strips unconditionally."""
+    from slurm_bridge_tpu.parallel.colpool import _OpStats
+
+    stats = _OpStats()
+    out = pool.decode_jobs_info_many([b"not a protobuf"])
+    from slurm_bridge_tpu.wire import coldec
+
+    assert isinstance(out[0], coldec.DecodeError)
+    # a raw round-trip confirms header fields are sane
+    st, body = pool._round_trip(0, 0x07, b"", stats)  # _OP_METRICS
+    assert st == 0
+    assert stats.chunks == 1
+    assert stats.op_ns >= 0 and stats.queue_ns >= 0
+    assert stats.bytes_in == 0 and stats.bytes_out == len(bytes(body))
+
+
+# --------------------------------------------------------------------------
+# real sidecar round-trip: worker phase timing, Healthz arrays, fleetz
+# --------------------------------------------------------------------------
+
+
+def test_solve_place_shard_fills_timing_summary():
+    from slurm_bridge_tpu.fleet.columnar import (
+        encode_place_shard,
+        solve_place_shard,
+    )
+
+    rng = np.random.default_rng(11)
+    snap, batch = _shape(rng, 16, 20)
+    req = encode_place_shard(0, "greedy", "", snap, batch, None)
+    resp = solve_place_shard(req)
+    assert resp.decode_ns > 0
+    assert resp.solve_ns > 0
+    assert resp.encode_ns > 0
+    assert resp.rows == 20
+
+
+def test_healthz_response_carries_sorted_metric_arrays():
+    from slurm_bridge_tpu.fleet.columnar import healthz_response
+
+    hz = healthz_response(
+        "solver", "r.1",
+        metrics={"sbt_b_total": 2.0, "sbt_a_total": 1.0},
+    )
+    assert list(hz.metric_name) == ["sbt_a_total", "sbt_b_total"]
+    assert list(hz.metric_total) == [1.0, 2.0]
+    # pre-ISSUE-20 shape: no metrics → empty arrays, not an error
+    hz0 = healthz_response("solver", "r.1")
+    assert list(hz0.metric_name) == []
+
+
+def test_sidecar_federation_end_to_end():
+    """Real sidecar: a remote solve lands in the sidecar's own counters,
+    the heartbeat's Healthz probe federates them, and the bridge scrape
+    + /debug/fleetz render them under the replica label."""
+    with tempfile.TemporaryDirectory() as d:
+        rt = FleetRuntime(FleetConfig(replicas=1), d, clock=_Clock())
+        rt.start()
+        try:
+            rng = np.random.default_rng(13)
+            snap, batch = _shape(rng, 16, 20)
+            assert rt.try_solve(0, "greedy", "", snap, batch, None) is not None
+            rt.heartbeat(1)
+            fed = rt.federated()
+            assert "replica-0" in fed
+            snap0 = fed["replica-0"]
+            assert snap0["sbt_sidecar_place_shards_total"] >= 1.0
+            assert snap0["sbt_sidecar_rows_total"] >= 20.0
+            assert snap0["sbt_sidecar_phase_seconds_total"] > 0.0
+            page = REGISTRY.render()
+            assert (
+                'sbt_fleet_replica_sidecar_place_shards_total'
+                '{replica="replica-0"}' in page
+            )
+            fz = rt.fleetz()
+            assert "federated sidecar counters (nonzero)" in fz
+            assert "sbt_sidecar_place_shards_total" in fz
+            assert "lifecycle timeline" in fz
+            # timeline: the startup story is already recorded
+            events = [e["event"] for e in rt.timeline()]
+            assert events[:2] == ["spawn", "ready"]
+        finally:
+            rt.close()
+
+
+def test_timeline_records_kill_backoff_restart_sequence():
+    with tempfile.TemporaryDirectory() as d:
+        rt = FleetRuntime(
+            FleetConfig(replicas=1, restart_backoff_ticks=2), d,
+            clock=_Clock(),
+        )
+        rt.start()
+        try:
+            rt.kill_replica("replica-0")
+            rt.heartbeat(1)
+            rt.heartbeat(2)  # backoff not yet elapsed
+            rt.heartbeat(3)  # restart + rejoin
+            evs = rt.timeline()
+            seq = [(e["tick"], e["event"]) for e in evs]
+            assert (-1, "spawn") in seq and (-1, "ready") in seq
+            assert (0, "kill") in seq
+            assert (1, "dead") in seq
+            assert (1, "backoff") in seq
+            assert (1, "rekey") in seq
+            assert (3, "restart") in seq
+            assert (3, "rekey") in seq
+            backoff = next(e for e in evs if e["event"] == "backoff")
+            assert backoff["detail"] == "restart eligible at tick 3"
+            restart = next(e for e in evs if e["event"] == "restart")
+            assert restart["detail"] == "incarnation=replica-0.2"
+            # the same story renders from the fleet section alone
+            text = render_timeline(rt.fleet_section()["timeline"])
+            assert "chaos: SIGKILL" in text
+            assert "restart eligible at tick 3" in text
+        finally:
+            rt.close()
+
+
+@pytest.mark.slow
+def test_fleet_obs_off_scenario_is_digest_identical():
+    """The harness threads ``fleet_obs`` end to end; both arms must land
+    the same final state (the bench gate re-proves this at smoke scale —
+    here a tiny fleet scenario keeps the tier-1 suite fast)."""
+    from slurm_bridge_tpu.sim.harness import run_scenario
+    from slurm_bridge_tpu.sim.scenarios import SCENARIOS
+
+    base = SCENARIOS["fleet_smoke"](scale=0.04)
+    on = run_scenario(dataclasses.replace(base, fleet_obs=True))
+    off = run_scenario(dataclasses.replace(base, fleet_obs=False))
+    assert (
+        on.determinism["final_state_digest"]
+        == off.determinism["final_state_digest"]
+    )
+    # the on arm carries the fleet section; the off arm does not
+    assert on.flight_record.get("fleet", {}).get("timeline")
+    assert "fleet" not in off.flight_record
